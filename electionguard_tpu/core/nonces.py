@@ -1,0 +1,26 @@
+"""Deterministic nonce sequences derived from a seed.
+
+Used by ballot encryption so an entire ballot's randomness derives from one
+master nonce (enabling the reference workflow's ``fixedNonces`` batch mode —
+reference: src/test/java/electionguard/workflow/RunRemoteWorkflowTest.java:140
+``batchEncryption(..., fixedNonces=true, ...)``).
+"""
+
+from __future__ import annotations
+
+from electionguard_tpu.core.group import ElementModQ, GroupContext
+from electionguard_tpu.core.hash import hash_elems
+
+
+class Nonces:
+    """``Nonces(seed, h1, h2, ...)[i]`` is a deterministic Z_q sequence."""
+
+    def __init__(self, seed: ElementModQ, *headers):
+        self._group = seed.group
+        self._seed = hash_elems(seed.group, seed, *headers) if headers else seed
+
+    def __getitem__(self, i: int) -> ElementModQ:
+        return hash_elems(self._group, self._seed, i)
+
+    def take(self, n: int):
+        return [self[i] for i in range(n)]

@@ -1,0 +1,165 @@
+"""Chaum–Pedersen proofs: generic, disjunctive (0-or-1), and constant.
+
+Native replacement for the reference's [ext] ``GenericChaumPedersenProof``.
+Wire contract: proofs carry (challenge, response) only — the commitment
+fields are ``reserved`` in the reference proto (reference:
+src/main/proto/common.proto:24-28), so verification *recomputes* commitments
+from (c, v) and re-derives the Fiat–Shamir challenge.
+
+Generic proof of a shared discrete log ``s`` with ``x = g1^s, y = g2^s``:
+  commitments ``a = g1^u, b = g2^u``; ``c = H(context, g1, g2, x, y, a, b)``;
+  response ``v = u - c·s``.
+Verify: ``a' = g1^v x^c``, ``b' = g2^v y^c``, accept iff c matches the hash.
+
+The disjunctive (range {0,1}) proof guards every encrypted selection and the
+constant proof guards every contest's vote limit — together they are the
+dominant verification workload the TPU plane batches (SURVEY.md §3.4 phase 5
+🔥, BASELINE.md config 3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from electionguard_tpu.core.group import ElementModP, ElementModQ, GroupContext
+from electionguard_tpu.core.hash import hash_elems
+from electionguard_tpu.core.nonces import Nonces
+from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
+
+
+@dataclass(frozen=True)
+class GenericChaumPedersenProof:
+    """Compact (challenge, response) proof that log_{g1} x == log_{g2} y."""
+
+    challenge: ElementModQ
+    response: ElementModQ
+
+    def is_valid(self, g1: ElementModP, x: ElementModP,
+                 g2: ElementModP, y: ElementModP,
+                 context: ElementModQ) -> bool:
+        g = self.challenge.group
+        a = g.mult_p(g.pow_p(g1, self.response), g.pow_p(x, self.challenge))
+        b = g.mult_p(g.pow_p(g2, self.response), g.pow_p(y, self.challenge))
+        return self.challenge == hash_elems(g, context, g1, g2, x, y, a, b)
+
+
+def make_generic_cp_proof(group: GroupContext, s: ElementModQ,
+                          g1: ElementModP, g2: ElementModP,
+                          nonce: ElementModQ,
+                          context: ElementModQ) -> GenericChaumPedersenProof:
+    x = group.pow_p(g1, s)
+    y = group.pow_p(g2, s)
+    a = group.pow_p(g1, nonce)
+    b = group.pow_p(g2, nonce)
+    c = hash_elems(group, context, g1, g2, x, y, a, b)
+    v = group.sub_q(nonce, group.mult_q(c, s))
+    return GenericChaumPedersenProof(c, v)
+
+
+@dataclass(frozen=True)
+class DisjunctiveChaumPedersenProof:
+    """Proof that an ElGamal ciphertext encrypts 0 or 1.
+
+    Stored compact: (c0, v0, c1, v1); overall challenge c = c0 + c1 must
+    equal H(context, α, β, a0, b0, a1, b1) with recomputed commitments:
+      a0 = g^v0 α^c0        b0 = K^v0 β^c0
+      a1 = g^v1 α^c1        b1 = K^v1 (β/g)^c1
+    """
+
+    proof_zero_challenge: ElementModQ
+    proof_zero_response: ElementModQ
+    proof_one_challenge: ElementModQ
+    proof_one_response: ElementModQ
+
+    def is_valid(self, ct: ElGamalCiphertext, public_key: ElementModP,
+                 context: ElementModQ) -> bool:
+        g = self.proof_zero_challenge.group
+        c0, v0 = self.proof_zero_challenge, self.proof_zero_response
+        c1, v1 = self.proof_one_challenge, self.proof_one_response
+        alpha, beta = ct.pad, ct.data
+        a0 = g.mult_p(g.g_pow_p(v0), g.pow_p(alpha, c0))
+        b0 = g.mult_p(g.pow_p(public_key, v0), g.pow_p(beta, c0))
+        a1 = g.mult_p(g.g_pow_p(v1), g.pow_p(alpha, c1))
+        beta_over_g = g.mult_p(beta, g.GINV_MOD_P)
+        b1 = g.mult_p(g.pow_p(public_key, v1), g.pow_p(beta_over_g, c1))
+        c = hash_elems(g, context, alpha, beta, a0, b0, a1, b1)
+        return g.add_q(c0, c1) == c
+
+
+def make_disjunctive_cp_proof(
+        group: GroupContext, ct: ElGamalCiphertext, nonce: ElementModQ,
+        public_key: ElementModP, context: ElementModQ, vote: int,
+        seed: ElementModQ) -> DisjunctiveChaumPedersenProof:
+    """Prove ct = (g^R, g^vote · K^R) encrypts vote ∈ {0, 1}.
+
+    The false branch is simulated with (c_f, v_f) drawn from ``seed``; the
+    real branch commits with u and closes with v = u - c_real·R.
+    """
+    if vote not in (0, 1):
+        raise ValueError("disjunctive proof requires vote in {0,1}")
+    g = group
+    alpha, beta = ct.pad, ct.data
+    nonces = Nonces(seed, "disjoint-cp")
+    u, c_fake, v_fake = nonces[0], nonces[1], nonces[2]
+    beta_over_g = g.mult_p(beta, g.GINV_MOD_P)
+
+    if vote == 0:
+        # real zero-branch commitments
+        a0, b0 = g.g_pow_p(u), g.pow_p(public_key, u)
+        # simulated one-branch: a1 = g^v1 α^c1, b1 = K^v1 (β/g)^c1
+        a1 = g.mult_p(g.g_pow_p(v_fake), g.pow_p(alpha, c_fake))
+        b1 = g.mult_p(g.pow_p(public_key, v_fake), g.pow_p(beta_over_g, c_fake))
+        c = hash_elems(g, context, alpha, beta, a0, b0, a1, b1)
+        c0 = g.sub_q(c, c_fake)
+        v0 = g.sub_q(u, g.mult_q(c0, nonce))
+        return DisjunctiveChaumPedersenProof(c0, v0, c_fake, v_fake)
+    else:
+        # simulated zero-branch
+        a0 = g.mult_p(g.g_pow_p(v_fake), g.pow_p(alpha, c_fake))
+        b0 = g.mult_p(g.pow_p(public_key, v_fake), g.pow_p(beta, c_fake))
+        # real one-branch on (α, β/g)
+        a1, b1 = g.g_pow_p(u), g.pow_p(public_key, u)
+        c = hash_elems(g, context, alpha, beta, a0, b0, a1, b1)
+        c1 = g.sub_q(c, c_fake)
+        v1 = g.sub_q(u, g.mult_q(c1, nonce))
+        return DisjunctiveChaumPedersenProof(c_fake, v_fake, c1, v1)
+
+
+@dataclass(frozen=True)
+class ConstantChaumPedersenProof:
+    """Proof that a ciphertext encrypts a known constant L (contest limit).
+
+    Proves (α, β/g^L) is an encryption of zero under K with the aggregate
+    nonce: a = g^v α^c, b = K^v (β/g^L)^c, c = H(context, L, α, β, a, b).
+    """
+
+    challenge: ElementModQ
+    response: ElementModQ
+    constant: int
+
+    def is_valid(self, ct: ElGamalCiphertext, public_key: ElementModP,
+                 context: ElementModQ) -> bool:
+        g = self.challenge.group
+        if not isinstance(self.constant, int) or not (0 <= self.constant < g.q):
+            return False  # malformed wire value must reject, not raise
+        c, v = self.challenge, self.response
+        alpha, beta = ct.pad, ct.data
+        beta_shift = g.mult_p(
+            beta, g.inv_p(g.g_pow_p(g.int_to_q(self.constant))))
+        a = g.mult_p(g.g_pow_p(v), g.pow_p(alpha, c))
+        b = g.mult_p(g.pow_p(public_key, v), g.pow_p(beta_shift, c))
+        return c == hash_elems(g, context, self.constant, alpha, beta, a, b)
+
+
+def make_constant_cp_proof(
+        group: GroupContext, ct: ElGamalCiphertext, aggregate_nonce: ElementModQ,
+        public_key: ElementModP, context: ElementModQ, constant: int,
+        seed: ElementModQ) -> ConstantChaumPedersenProof:
+    g = group
+    alpha, beta = ct.pad, ct.data
+    u = Nonces(seed, "constant-cp")[0]
+    a, b = g.g_pow_p(u), g.pow_p(public_key, u)
+    c = hash_elems(g, context, constant, alpha, beta, a, b)
+    v = g.sub_q(u, g.mult_q(c, aggregate_nonce))
+    return ConstantChaumPedersenProof(c, v, constant)

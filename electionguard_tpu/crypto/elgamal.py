@@ -1,0 +1,97 @@
+"""Exponential ElGamal over the production group.
+
+Native replacement for the reference's [ext] ``ElGamalCiphertext`` et al.
+(wire contract: pad/data pair of ElementModP — reference:
+src/main/proto/common.proto:18-22, codec ConvertCommonProto.java:60-68).
+
+Encryption of a small vote ``v`` with nonce ``R`` under joint key ``K``:
+``(α, β) = (g^R, g^v · K^R) mod p``.  Homomorphic accumulation is the
+componentwise product — the tally hot loop the TPU plane product-reduces
+(SURVEY.md §3.4 phase 3 🔥).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+from electionguard_tpu.core.dlog import DLog, default_dlog
+from electionguard_tpu.core.group import ElementModP, ElementModQ, GroupContext
+
+
+@dataclass(frozen=True)
+class ElGamalKeypair:
+    secret_key: ElementModQ
+    public_key: ElementModP
+
+    @staticmethod
+    def from_secret(s: ElementModQ) -> "ElGamalKeypair":
+        if s.value < 2:
+            raise ValueError("secret key must be >= 2")
+        return ElGamalKeypair(s, s.group.g_pow_p(s))
+
+    @staticmethod
+    def generate(group: GroupContext) -> "ElGamalKeypair":
+        return ElGamalKeypair.from_secret(group.rand_q())
+
+
+@dataclass(frozen=True)
+class ElGamalCiphertext:
+    pad: ElementModP   # α = g^R
+    data: ElementModP  # β = g^v · K^R
+
+    def mult(self, other: "ElGamalCiphertext") -> "ElGamalCiphertext":
+        """Homomorphic add of plaintexts = componentwise product."""
+        g = self.pad.group
+        return ElGamalCiphertext(g.mult_p(self.pad, other.pad),
+                                 g.mult_p(self.data, other.data))
+
+    def partial_decrypt(self, secret: ElementModQ) -> ElementModP:
+        """Mᵢ = α^sᵢ — the trustee-side share (SURVEY.md §3.2 🔥)."""
+        return self.pad.group.pow_p(self.pad, secret)
+
+    def decrypt(self, secret: ElementModQ, dlog: Optional[DLog] = None) -> int:
+        g = self.pad.group
+        m = g.div_p(self.data, self.partial_decrypt(secret))  # g^v
+        d = dlog if dlog is not None else default_dlog(g)
+        v = d.dlog(m)
+        if v is None:
+            raise ValueError("plaintext exceeds dlog table")
+        return v
+
+    def decrypt_with_shares(self, shares: Iterable[ElementModP],
+                            dlog: Optional[DLog] = None) -> int:
+        """Combine full partial decryptions: v = dlog(β / ∏ Mᵢ)."""
+        g = self.pad.group
+        m = g.div_p(self.data, g.mult_p(*shares))
+        d = dlog if dlog is not None else default_dlog(g)
+        v = d.dlog(m)
+        if v is None:
+            raise ValueError("plaintext exceeds dlog table")
+        return v
+
+    def crypto_hash(self):
+        from electionguard_tpu.core.hash import hash_digest
+        return hash_digest(self.pad, self.data)
+
+
+def elgamal_encrypt(group: GroupContext, v: int, nonce: ElementModQ,
+                    public_key: ElementModP) -> ElGamalCiphertext:
+    if v < 0:
+        raise ValueError("vote must be non-negative")
+    if nonce.is_zero():
+        raise ValueError("nonce must be nonzero")
+    pad = group.g_pow_p(nonce)
+    data = group.mult_p(group.g_pow_p(group.int_to_q(v)),
+                        group.pow_p(public_key, nonce))
+    return ElGamalCiphertext(pad, data)
+
+
+def elgamal_accumulate(cts: Iterable[ElGamalCiphertext]) -> ElGamalCiphertext:
+    cts = list(cts)
+    if not cts:
+        raise ValueError("nothing to accumulate")
+    acc = cts[0]
+    for ct in cts[1:]:
+        acc = acc.mult(ct)
+    return acc

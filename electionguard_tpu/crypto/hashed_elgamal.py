@@ -1,0 +1,54 @@
+"""Hashed ElGamal: KDF-stream encryption of byte strings to a public key.
+
+Native replacement for the reference's [ext] ``HashedElGamalCiphertext`` —
+wire form (c0, c1, c2, numBytes) (reference: src/main/proto/common.proto:30-35).
+Used in the key ceremony to encrypt the share Pᵢ(ℓ) to guardian ℓ's key
+("spec 1.03 eq 17" — reference: src/main/proto/keyceremony_trustee_rpc.proto:38-43).
+
+Scheme: session key k = H(K^ε, g^ε); keystream = KDF(k); c0 = g^ε;
+c1 = data ⊕ keystream; c2 = HMAC(mac_key, c0 || c1).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from electionguard_tpu.core.group import ElementModP, ElementModQ, GroupContext
+from electionguard_tpu.core.hash import hash_digest, hmac_digest, kdf
+
+
+@dataclass(frozen=True)
+class HashedElGamalCiphertext:
+    c0: ElementModP   # g^ε
+    c1: bytes         # data ⊕ KDF keystream
+    c2: bytes         # HMAC tag (32 bytes)
+    num_bytes: int
+
+    def decrypt(self, secret: ElementModQ,
+                context: bytes = b"") -> Optional[bytes]:
+        """Returns plaintext, or None if the MAC check fails."""
+        g = secret.group
+        if self.num_bytes != len(self.c1):
+            return None
+        shared = g.pow_p(self.c0, secret)  # K^ε = (g^ε)^s
+        session_key = hash_digest(shared, self.c0)
+        mac_key = kdf(session_key, "mac", context, 32)
+        tag = hmac_digest(mac_key, self.c0, self.c1, self.num_bytes)
+        if tag != self.c2:
+            return None
+        stream = kdf(session_key, "data", context, self.num_bytes)
+        return bytes(a ^ b for a, b in zip(self.c1, stream))
+
+
+def hashed_elgamal_encrypt(group: GroupContext, data: bytes,
+                           nonce: ElementModQ, public_key: ElementModP,
+                           context: bytes = b"") -> HashedElGamalCiphertext:
+    c0 = group.g_pow_p(nonce)
+    shared = group.pow_p(public_key, nonce)
+    session_key = hash_digest(shared, c0)
+    stream = kdf(session_key, "data", context, len(data))
+    c1 = bytes(a ^ b for a, b in zip(data, stream))
+    mac_key = kdf(session_key, "mac", context, 32)
+    c2 = hmac_digest(mac_key, c0, c1, len(data))
+    return HashedElGamalCiphertext(c0, c1, c2, len(data))

@@ -1,0 +1,45 @@
+"""Schnorr proofs of knowledge of a secret key.
+
+Native replacement for the reference's [ext] ``SchnorrProof`` — wire form is
+(challenge, response) only (reference: src/main/proto/common.proto:37-42);
+the commitment is recomputed at verification, so verify checks the
+Fiat–Shamir equation rather than comparing commitments.
+
+Prove knowledge of ``s`` with ``K = g^s``:
+  commitment ``h = g^u``; challenge ``c = H(K, h)``; response ``v = u - c·s``.
+Verify: ``h' = g^v · K^c``, accept iff ``c == H(K, h')``.
+
+Every guardian polynomial coefficient carries one of these (key ceremony
+PublicKeySet — reference: src/main/proto/keyceremony_trustee_rpc.proto:22-28);
+verification of all commitments from all guardians is a batch job
+(SURVEY.md §3.1 🔥 "verifies Schnorr proofs").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from electionguard_tpu.core.group import ElementModP, ElementModQ, GroupContext
+from electionguard_tpu.core.hash import hash_elems
+
+
+@dataclass(frozen=True)
+class SchnorrProof:
+    public_key: ElementModP
+    challenge: ElementModQ
+    response: ElementModQ
+
+    def is_valid(self) -> bool:
+        g = self.public_key.group
+        commitment = g.mult_p(g.g_pow_p(self.response),
+                              g.pow_p(self.public_key, self.challenge))
+        return self.challenge == hash_elems(g, self.public_key, commitment)
+
+
+def make_schnorr_proof(group: GroupContext, secret: ElementModQ,
+                       public_key: ElementModP,
+                       nonce: ElementModQ) -> SchnorrProof:
+    h = group.g_pow_p(nonce)
+    c = hash_elems(group, public_key, h)
+    v = group.sub_q(nonce, group.mult_q(c, secret))
+    return SchnorrProof(public_key, c, v)

@@ -1,0 +1,29 @@
+"""Test harness config: force an 8-device virtual CPU mesh.
+
+Multi-chip hardware is not available in CI; sharding tests run on
+``xla_force_host_platform_device_count=8`` CPU devices, mirroring how the
+driver dry-runs the multi-chip path (see __graft_entry__.dryrun_multichip).
+Must run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tgroup():
+    from electionguard_tpu.core.group import tiny_group
+    return tiny_group()
+
+
+@pytest.fixture(scope="session")
+def pgroup():
+    from electionguard_tpu.core.group import production_group
+    return production_group()

@@ -1,0 +1,153 @@
+"""Unit tests for protocol primitives (ElGamal, Schnorr, Chaum-Pedersen,
+HashedElGamal) on the fast test group, with a production-group smoke test."""
+
+import pytest
+
+from electionguard_tpu.core.dlog import DLog
+from electionguard_tpu.core.hash import hash_digest, hash_elems
+from electionguard_tpu.core.nonces import Nonces
+from electionguard_tpu.crypto.chaum_pedersen import (
+    ConstantChaumPedersenProof, make_constant_cp_proof,
+    make_disjunctive_cp_proof, make_generic_cp_proof)
+from electionguard_tpu.crypto.elgamal import (ElGamalKeypair,
+                                              elgamal_accumulate,
+                                              elgamal_encrypt)
+from electionguard_tpu.crypto.hashed_elgamal import hashed_elgamal_encrypt
+from electionguard_tpu.crypto.schnorr import make_schnorr_proof
+
+
+def test_hash_deterministic_and_injective(tgroup):
+    a = hash_elems(tgroup, "x", 1, tgroup.int_to_q(2))
+    b = hash_elems(tgroup, "x", 1, tgroup.int_to_q(2))
+    assert a == b
+    # type-tagged encoding distinguishes str "1" from int 1
+    assert hash_digest("1") != hash_digest(1)
+    assert hash_digest("a", "bc") != hash_digest("ab", "c")
+
+
+def test_nonces_deterministic(tgroup):
+    seed = tgroup.int_to_q(42)
+    n1, n2 = Nonces(seed, "hdr"), Nonces(seed, "hdr")
+    assert n1[0] == n2[0] and n1[5] == n2[5]
+    assert n1[0] != n1[1]
+    assert Nonces(seed, "other")[0] != n1[0]
+
+
+def test_elgamal_roundtrip(tgroup):
+    kp = ElGamalKeypair.generate(tgroup)
+    dlog = DLog(tgroup, max_exponent=1000)
+    for v in (0, 1, 5, 100):
+        ct = elgamal_encrypt(tgroup, v, tgroup.rand_q(), kp.public_key)
+        assert ct.decrypt(kp.secret_key, dlog) == v
+
+
+def test_elgamal_homomorphic(tgroup):
+    kp = ElGamalKeypair.generate(tgroup)
+    dlog = DLog(tgroup, max_exponent=1000)
+    cts = [elgamal_encrypt(tgroup, v, tgroup.rand_q(), kp.public_key)
+           for v in (1, 0, 1, 1, 7)]
+    acc = elgamal_accumulate(cts)
+    assert acc.decrypt(kp.secret_key, dlog) == 10
+
+
+def test_dlog_bsgs(tgroup):
+    dlog = DLog(tgroup, max_exponent=100000)
+    for t in (0, 1, 999, 65537, 100000):
+        assert dlog.dlog(tgroup.g_pow_p(tgroup.int_to_q(t))) == t
+
+
+def test_schnorr(tgroup):
+    kp = ElGamalKeypair.generate(tgroup)
+    proof = make_schnorr_proof(tgroup, kp.secret_key, kp.public_key,
+                               tgroup.rand_q())
+    assert proof.is_valid()
+    # tampered public key fails
+    bad = ElGamalKeypair.generate(tgroup)
+    from electionguard_tpu.crypto.schnorr import SchnorrProof
+    assert not SchnorrProof(bad.public_key, proof.challenge,
+                            proof.response).is_valid()
+
+
+def test_generic_cp(tgroup):
+    g = tgroup
+    s, u = g.rand_q(), g.rand_q()
+    g1 = g.G_MOD_P
+    g2 = g.g_pow_p(g.int_to_q(12345))
+    ctx = g.int_to_q(777)
+    proof = make_generic_cp_proof(g, s, g1, g2, u, ctx)
+    x, y = g.pow_p(g1, s), g.pow_p(g2, s)
+    assert proof.is_valid(g1, x, g2, y, ctx)
+    assert not proof.is_valid(g1, x, g2, g.mult_p(y, g.G_MOD_P), ctx)
+    assert not proof.is_valid(g1, x, g2, y, g.int_to_q(778))
+
+
+@pytest.mark.parametrize("vote", [0, 1])
+def test_disjunctive_cp(tgroup, vote):
+    g = tgroup
+    kp = ElGamalKeypair.generate(g)
+    nonce, ctx = g.rand_q(), g.int_to_q(99)
+    ct = elgamal_encrypt(g, vote, nonce, kp.public_key)
+    proof = make_disjunctive_cp_proof(g, ct, nonce, kp.public_key, ctx, vote,
+                                      g.rand_q())
+    assert proof.is_valid(ct, kp.public_key, ctx)
+    # wrong context fails
+    assert not proof.is_valid(ct, kp.public_key, g.int_to_q(100))
+
+
+def test_disjunctive_cp_rejects_two(tgroup):
+    """A vote of 2 cannot be proven in {0,1}; generation refuses, and a
+    0-proof on an encryption of 2 must not verify."""
+    g = tgroup
+    kp = ElGamalKeypair.generate(g)
+    nonce, ctx = g.rand_q(), g.int_to_q(99)
+    ct2 = elgamal_encrypt(g, 2, nonce, kp.public_key)
+    with pytest.raises(ValueError):
+        make_disjunctive_cp_proof(g, ct2, nonce, kp.public_key, ctx, 2,
+                                  g.rand_q())
+    forged = make_disjunctive_cp_proof(g, ct2, nonce, kp.public_key, ctx, 1,
+                                       g.rand_q())
+    assert not forged.is_valid(ct2, kp.public_key, ctx)
+
+
+def test_constant_cp(tgroup):
+    g = tgroup
+    kp = ElGamalKeypair.generate(g)
+    ctx = g.int_to_q(55)
+    nonces = [g.rand_q() for _ in range(3)]
+    cts = [elgamal_encrypt(g, v, n, kp.public_key)
+           for v, n in zip((1, 1, 0), nonces)]
+    acc = elgamal_accumulate(cts)
+    agg_nonce = g.add_q(*nonces)
+    proof = make_constant_cp_proof(g, acc, agg_nonce, kp.public_key, ctx, 2,
+                                   g.rand_q())
+    assert proof.is_valid(acc, kp.public_key, ctx)
+    # claiming the wrong constant fails
+    bad = ConstantChaumPedersenProof(proof.challenge, proof.response, 3)
+    assert not bad.is_valid(acc, kp.public_key, ctx)
+
+
+def test_hashed_elgamal_roundtrip(tgroup):
+    g = tgroup
+    kp = ElGamalKeypair.generate(g)
+    data = b"the quick brown fox jumps over 32+ byte payloads" * 3
+    ct = hashed_elgamal_encrypt(g, data, g.rand_q(), kp.public_key, b"ctx")
+    assert ct.decrypt(kp.secret_key, b"ctx") == data
+    # wrong context -> MAC failure -> None
+    assert ct.decrypt(kp.secret_key, b"other") is None
+    # wrong key -> None
+    assert ct.decrypt(ElGamalKeypair.generate(g).secret_key, b"ctx") is None
+
+
+@pytest.mark.slow
+def test_production_group_smoke(pgroup):
+    """End-to-end primitive check at 4096-bit production size."""
+    g = pgroup
+    kp = ElGamalKeypair.generate(g)
+    nonce, ctx = g.rand_q(), g.int_to_q(7)
+    ct = elgamal_encrypt(g, 1, nonce, kp.public_key)
+    assert ct.decrypt(kp.secret_key, DLog(g, max_exponent=10)) == 1
+    proof = make_disjunctive_cp_proof(g, ct, nonce, kp.public_key, ctx, 1,
+                                      g.rand_q())
+    assert proof.is_valid(ct, kp.public_key, ctx)
+    sp = make_schnorr_proof(g, kp.secret_key, kp.public_key, g.rand_q())
+    assert sp.is_valid()

@@ -1,0 +1,81 @@
+"""Unit tests for the scalar group plane (the missing test pyramid the
+reference delegates upstream — SURVEY.md §4)."""
+
+import pytest
+
+from electionguard_tpu.core.group import (ElementModP, ElementModQ,
+                                          production_group, tiny_group)
+
+
+@pytest.mark.parametrize("grp", ["tgroup", "pgroup"])
+def test_group_structure(grp, request):
+    g = request.getfixturevalue(grp)
+    assert (g.p - 1) % g.q == 0
+    assert g.r == (g.p - 1) // g.q
+    assert pow(g.g, g.q, g.p) == 1
+    assert g.g != 1
+
+
+def test_production_sizes(pgroup):
+    assert pgroup.p.bit_length() == 4096
+    assert pgroup.q == (1 << 256) - 189  # spec 1.03 q
+    assert pgroup.spec.p_bytes == 512 and pgroup.spec.q_bytes == 32
+
+
+def test_q_arithmetic(tgroup):
+    g = tgroup
+    a, b = g.int_to_q(1234567), g.int_to_q(7654321)
+    assert g.add_q(a, b).value == (a.value + b.value) % g.q
+    assert g.sub_q(a, b).value == (a.value - b.value) % g.q
+    assert g.mult_q(a, b).value == a.value * b.value % g.q
+    assert g.mult_q(a, g.inv_q(a)).value == 1
+    assert g.add_q(a, g.neg_q(a)).value == 0
+    assert g.a_plus_bc_q(a, b, b).value == (a.value + b.value * b.value) % g.q
+
+
+def test_p_arithmetic(tgroup):
+    g = tgroup
+    e = g.int_to_q(987654321)
+    x = g.g_pow_p(e)
+    assert x.value == pow(g.g, e.value, g.p)
+    assert g.mult_p(x, g.inv_p(x)).value == 1
+    assert g.pow_p(x, g.int_to_q(3)).value == pow(x.value, 3, g.p)
+    assert g.div_p(x, x).value == 1
+
+
+def test_subgroup_membership(tgroup):
+    g = tgroup
+    assert g.g_pow_p(g.rand_q()).is_valid_residue()
+    # an element outside the order-q subgroup fails the residue check
+    bad = ElementModP(2, g)  # 2 generates a larger group w.h.p.
+    if pow(2, g.q, g.p) != 1:
+        assert not bad.is_valid_residue()
+
+
+def test_pow_identity(tgroup):
+    g = tgroup
+    a, b = g.rand_q(), g.rand_q()
+    # g^a * g^b == g^(a+b)
+    assert g.mult_p(g.g_pow_p(a), g.g_pow_p(b)) == g.g_pow_p(g.add_q(a, b))
+
+
+def test_bytes_roundtrip(tgroup):
+    g = tgroup
+    q = g.rand_q()
+    assert g.bytes_to_q(q.to_bytes()) == q
+    p = g.g_pow_p(q)
+    assert g.bytes_to_p(p.to_bytes()) == p
+    assert len(p.to_bytes()) == g.spec.p_bytes
+
+
+def test_range_validation(tgroup):
+    with pytest.raises(ValueError):
+        ElementModQ(tgroup.q, tgroup)
+    with pytest.raises(ValueError):
+        ElementModP(tgroup.p, tgroup)
+
+
+def test_immutability(tgroup):
+    q = tgroup.int_to_q(5)
+    with pytest.raises(AttributeError):
+        q.value = 6
