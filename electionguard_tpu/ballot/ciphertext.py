@@ -1,0 +1,84 @@
+"""Encrypted ballots.
+
+Native replacement for the reference's [ext] ``EncryptedBallot`` data model
+(imported at RunRemoteDecryptor.java:9-21).  Selections carry the ElGamal
+ciphertext plus its disjunctive (0-or-1) range proof; contests carry the
+constant proof for the vote limit; the ballot carries a chained confirmation
+code.  Serialization lives in ``electionguard_tpu.publish``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+
+from electionguard_tpu.core.hash import hash_digest
+from electionguard_tpu.crypto.chaum_pedersen import (
+    ConstantChaumPedersenProof, DisjunctiveChaumPedersenProof)
+from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
+
+
+class BallotState(Enum):
+    CAST = "CAST"
+    SPOILED = "SPOILED"
+    UNKNOWN = "UNKNOWN"
+
+
+@dataclass(frozen=True)
+class EncryptedSelection:
+    selection_id: str
+    sequence_order: int
+    ciphertext: ElGamalCiphertext
+    proof: DisjunctiveChaumPedersenProof
+
+    def crypto_hash(self) -> bytes:
+        return hash_digest("enc-selection", self.selection_id,
+                           self.sequence_order, self.ciphertext.pad,
+                           self.ciphertext.data)
+
+
+@dataclass(frozen=True)
+class EncryptedContest:
+    contest_id: str
+    sequence_order: int
+    selections: tuple[EncryptedSelection, ...]
+    proof: ConstantChaumPedersenProof
+
+    def crypto_hash(self) -> bytes:
+        return hash_digest("enc-contest", self.contest_id,
+                           self.sequence_order,
+                           [s.crypto_hash() for s in self.selections])
+
+    def accumulation(self) -> ElGamalCiphertext:
+        """Homomorphic sum of the contest's selections (limit-proof target)."""
+        acc = self.selections[0].ciphertext
+        for s in self.selections[1:]:
+            acc = acc.mult(s.ciphertext)
+        return acc
+
+
+@dataclass(frozen=True)
+class EncryptedBallot:
+    ballot_id: str
+    ballot_style_id: str
+    manifest_hash: bytes
+    code_seed: bytes        # previous ballot's code (chaining)
+    code: bytes             # this ballot's confirmation code
+    timestamp: int
+    contests: tuple[EncryptedContest, ...]
+    state: BallotState = BallotState.UNKNOWN
+
+    def crypto_hash(self) -> bytes:
+        return hash_digest("enc-ballot", self.ballot_id,
+                           self.manifest_hash,
+                           [c.crypto_hash() for c in self.contests])
+
+    @staticmethod
+    def make_code(code_seed: bytes, timestamp: int,
+                  crypto_hash: bytes) -> bytes:
+        """Chained confirmation code H(seed, timestamp, ballot-hash)."""
+        return hash_digest("ballot-code", code_seed, timestamp, crypto_hash)
+
+    def is_valid_code(self) -> bool:
+        return self.code == self.make_code(self.code_seed, self.timestamp,
+                                           self.crypto_hash())

@@ -1,0 +1,215 @@
+"""Election manifest data model + input validation.
+
+Native replacement for the reference's [ext] ``Manifest`` and
+``ManifestInputValidation`` (call sites: RunRemoteKeyCeremony.java:106-112,
+RunRemoteDecryptor.java:114-127 — both validate the manifest fail-fast before
+starting a ceremony/decryption and abort on any error).
+
+The model covers what the election workflow consumes: geopolitical units,
+parties, candidates, contests with selections, and ballot styles.  JSON
+(de)serialization lives here; the election-record directory layout lives in
+``electionguard_tpu.publish``.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+from electionguard_tpu.core.hash import hash_digest
+
+
+@dataclass(frozen=True)
+class SelectionDescription:
+    object_id: str
+    sequence_order: int
+    candidate_id: str
+
+    def crypto_hash(self) -> bytes:
+        return hash_digest("selection", self.object_id, self.sequence_order,
+                           self.candidate_id)
+
+
+@dataclass(frozen=True)
+class ContestDescription:
+    object_id: str
+    sequence_order: int
+    geopolitical_unit_id: str
+    vote_variation: str          # "one_of_m" | "n_of_m"
+    votes_allowed: int
+    name: str
+    selections: tuple[SelectionDescription, ...]
+
+    def crypto_hash(self) -> bytes:
+        return hash_digest("contest", self.object_id, self.sequence_order,
+                           self.geopolitical_unit_id, self.vote_variation,
+                           self.votes_allowed, self.name,
+                           [s.crypto_hash() for s in self.selections])
+
+
+@dataclass(frozen=True)
+class BallotStyle:
+    object_id: str
+    geopolitical_unit_ids: tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class Candidate:
+    object_id: str
+    name: str
+    party_id: str = ""
+
+
+@dataclass(frozen=True)
+class GeopoliticalUnit:
+    object_id: str
+    name: str
+    type: str = "district"
+
+
+@dataclass(frozen=True)
+class Party:
+    object_id: str
+    name: str
+
+
+@dataclass(frozen=True)
+class Manifest:
+    election_scope_id: str
+    spec_version: str
+    start_date: str
+    end_date: str
+    geopolitical_units: tuple[GeopoliticalUnit, ...]
+    parties: tuple[Party, ...]
+    candidates: tuple[Candidate, ...]
+    contests: tuple[ContestDescription, ...]
+    ballot_styles: tuple[BallotStyle, ...]
+
+    def crypto_hash(self) -> bytes:
+        return hash_digest(
+            "manifest", self.election_scope_id, self.spec_version,
+            self.start_date, self.end_date,
+            [c.crypto_hash() for c in self.contests],
+            [b.object_id for b in self.ballot_styles])
+
+    # ------------------------------------------------------------------
+    def contests_for_style(self, style_id: str) -> list[ContestDescription]:
+        style = next(b for b in self.ballot_styles if b.object_id == style_id)
+        gids = set(style.geopolitical_unit_ids)
+        return [c for c in self.contests if c.geopolitical_unit_id in gids]
+
+    # ------------------------------------------------------------------
+    def to_json(self) -> str:
+        def enc(o):
+            if hasattr(o, "__dataclass_fields__"):
+                return {k: getattr(o, k) for k in o.__dataclass_fields__}
+            if isinstance(o, tuple):
+                return list(o)
+            raise TypeError(type(o))
+        return json.dumps(self, default=enc, indent=2, sort_keys=True)
+
+    @staticmethod
+    def from_json(s: str) -> "Manifest":
+        d = json.loads(s)
+        return Manifest(
+            election_scope_id=d["election_scope_id"],
+            spec_version=d["spec_version"],
+            start_date=d["start_date"],
+            end_date=d["end_date"],
+            geopolitical_units=tuple(
+                GeopoliticalUnit(**g) for g in d["geopolitical_units"]),
+            parties=tuple(Party(**p) for p in d["parties"]),
+            candidates=tuple(Candidate(**c) for c in d["candidates"]),
+            contests=tuple(
+                ContestDescription(
+                    **{**c, "selections": tuple(
+                        SelectionDescription(**s) for s in c["selections"])})
+                for c in d["contests"]),
+            ballot_styles=tuple(
+                BallotStyle(object_id=b["object_id"],
+                            geopolitical_unit_ids=tuple(
+                                b["geopolitical_unit_ids"]))
+                for b in d["ballot_styles"]),
+        )
+
+
+@dataclass
+class ValidationMessages:
+    """Mirrors the reference's ValidationMessages consumption pattern:
+    ``hasErrors`` gates startup (RunRemoteKeyCeremony.java:107-112)."""
+
+    errors: list[str] = field(default_factory=list)
+    warnings: list[str] = field(default_factory=list)
+
+    def has_errors(self) -> bool:
+        return bool(self.errors)
+
+    def __str__(self):
+        return "\n".join(["ERROR: " + e for e in self.errors]
+                         + ["WARN: " + w for w in self.warnings])
+
+
+def validate_manifest(manifest: Manifest) -> ValidationMessages:
+    """Structural validation before any ceremony starts."""
+    msgs = ValidationMessages()
+    err = msgs.errors.append
+
+    def check_unique(ids, kind):
+        seen = set()
+        for i in ids:
+            if i in seen:
+                err(f"duplicate {kind} id: {i}")
+            seen.add(i)
+        return seen
+
+    gids = check_unique([g.object_id for g in manifest.geopolitical_units],
+                        "geopolitical unit")
+    check_unique([p.object_id for p in manifest.parties], "party")
+    cand_ids = check_unique([c.object_id for c in manifest.candidates],
+                            "candidate")
+    check_unique([c.object_id for c in manifest.contests], "contest")
+    check_unique([b.object_id for b in manifest.ballot_styles], "ballot style")
+
+    if not manifest.contests:
+        err("manifest has no contests")
+    if not manifest.ballot_styles:
+        err("manifest has no ballot styles")
+
+    party_ids = {p.object_id for p in manifest.parties}
+    for cand in manifest.candidates:
+        if cand.party_id and cand.party_id not in party_ids:
+            err(f"candidate {cand.object_id} references unknown party "
+                f"{cand.party_id}")
+
+    for c in manifest.contests:
+        if c.geopolitical_unit_id not in gids:
+            err(f"contest {c.object_id} references unknown geopolitical "
+                f"unit {c.geopolitical_unit_id}")
+        if not c.selections:
+            err(f"contest {c.object_id} has no selections")
+        if c.votes_allowed < 1:
+            err(f"contest {c.object_id} votes_allowed must be >= 1")
+        if c.votes_allowed > len(c.selections):
+            err(f"contest {c.object_id} votes_allowed exceeds selection count")
+        if c.vote_variation not in ("one_of_m", "n_of_m"):
+            err(f"contest {c.object_id} unknown vote variation "
+                f"{c.vote_variation}")
+        check_unique([s.object_id for s in c.selections],
+                     f"selection in {c.object_id}")
+        seqs = [s.sequence_order for s in c.selections]
+        if len(set(seqs)) != len(seqs):
+            err(f"contest {c.object_id} has duplicate selection "
+                f"sequence orders")
+        for s in c.selections:
+            if s.candidate_id not in cand_ids:
+                err(f"selection {s.object_id} references unknown candidate "
+                    f"{s.candidate_id}")
+
+    for b in manifest.ballot_styles:
+        for gid in b.geopolitical_unit_ids:
+            if gid not in gids:
+                err(f"ballot style {b.object_id} references unknown "
+                    f"geopolitical unit {gid}")
+
+    return msgs

@@ -1,0 +1,89 @@
+"""Plaintext ballots + the random ballot provider.
+
+Native replacement for the reference's [ext] ``PlaintextBallot`` and
+``RandomBallotProvider`` (call site: RunRemoteWorkflowTest.java:133-137 —
+``new RandomBallotProvider(manifest, nballots).ballots()``).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass
+from typing import Iterator
+
+from electionguard_tpu.ballot.manifest import Manifest
+
+
+@dataclass(frozen=True)
+class PlaintextBallotSelection:
+    selection_id: str
+    vote: int
+
+
+@dataclass(frozen=True)
+class PlaintextBallotContest:
+    contest_id: str
+    selections: tuple[PlaintextBallotSelection, ...]
+
+
+@dataclass(frozen=True)
+class PlaintextBallot:
+    ballot_id: str
+    ballot_style_id: str
+    contests: tuple[PlaintextBallotContest, ...]
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "ballot_id": self.ballot_id,
+            "ballot_style_id": self.ballot_style_id,
+            "contests": [
+                {"contest_id": c.contest_id,
+                 "selections": [
+                     {"selection_id": s.selection_id, "vote": s.vote}
+                     for s in c.selections]}
+                for c in self.contests],
+        }, indent=2, sort_keys=True)
+
+    @staticmethod
+    def from_json(s: str) -> "PlaintextBallot":
+        d = json.loads(s)
+        return PlaintextBallot(
+            ballot_id=d["ballot_id"],
+            ballot_style_id=d["ballot_style_id"],
+            contests=tuple(
+                PlaintextBallotContest(
+                    contest_id=c["contest_id"],
+                    selections=tuple(
+                        PlaintextBallotSelection(s["selection_id"], s["vote"])
+                        for s in c["selections"]))
+                for c in d["contests"]),
+        )
+
+
+class RandomBallotProvider:
+    """Deterministic (seeded) fake-ballot generator for tests/benchmarks."""
+
+    def __init__(self, manifest: Manifest, nballots: int, seed: int = 0):
+        self.manifest = manifest
+        self.nballots = nballots
+        self.rng = random.Random(seed)
+
+    def ballots(self) -> Iterator[PlaintextBallot]:
+        styles = self.manifest.ballot_styles
+        for i in range(self.nballots):
+            style = styles[self.rng.randrange(len(styles))]
+            contests = []
+            for c in self.manifest.contests_for_style(style.object_id):
+                k = self.rng.randint(0, c.votes_allowed)
+                chosen = set(self.rng.sample(range(len(c.selections)), k))
+                contests.append(PlaintextBallotContest(
+                    contest_id=c.object_id,
+                    selections=tuple(
+                        PlaintextBallotSelection(
+                            s.object_id, 1 if j in chosen else 0)
+                        for j, s in enumerate(c.selections))))
+            yield PlaintextBallot(
+                ballot_id=f"ballot-{i:07d}",
+                ballot_style_id=style.object_id,
+                contests=tuple(contests))

@@ -1,0 +1,68 @@
+"""Encrypted and plaintext tallies.
+
+Native replacement for the reference's [ext] ``EncryptedTally`` /
+``PlaintextTally`` (imported at RunRemoteDecryptor.java:9-21; the encrypted
+tally is what the decryption coordinator loads and the trustees partially
+decrypt — SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from electionguard_tpu.core.group import ElementModP, ElementModQ
+from electionguard_tpu.crypto.chaum_pedersen import GenericChaumPedersenProof
+from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
+
+
+@dataclass(frozen=True)
+class EncryptedTallySelection:
+    selection_id: str
+    sequence_order: int
+    ciphertext: ElGamalCiphertext
+
+
+@dataclass(frozen=True)
+class EncryptedTallyContest:
+    contest_id: str
+    sequence_order: int
+    selections: tuple[EncryptedTallySelection, ...]
+
+
+@dataclass(frozen=True)
+class EncryptedTally:
+    tally_id: str
+    contests: tuple[EncryptedTallyContest, ...]
+    cast_ballot_count: int = 0
+
+
+@dataclass(frozen=True)
+class PartialDecryption:
+    """One guardian's (possibly compensated) share for one selection."""
+
+    guardian_id: str
+    share: ElementModP                      # Mᵢ or combined Mᵢ from shares
+    proof: Optional[GenericChaumPedersenProof] = None
+    recovered_parts: Optional[dict] = None  # ℓ -> CompensatedShare when missing
+
+
+@dataclass(frozen=True)
+class PlaintextTallySelection:
+    selection_id: str
+    tally: int                              # decoded vote count t
+    value: ElementModP                      # g^t
+    message: ElGamalCiphertext              # the encrypted accumulation
+    shares: tuple[PartialDecryption, ...]
+
+
+@dataclass(frozen=True)
+class PlaintextTallyContest:
+    contest_id: str
+    selections: tuple[PlaintextTallySelection, ...]
+
+
+@dataclass(frozen=True)
+class PlaintextTally:
+    tally_id: str
+    contests: tuple[PlaintextTallyContest, ...]

@@ -1,0 +1,268 @@
+"""Batched big-integer modular arithmetic in JAX (the TPU data plane).
+
+This is the framework's native-equivalent of the reference's hot layer: the
+JVM ``BigInteger`` intrinsics underneath ``ProductionElementModP``
+(reference: src/main/java/electionguard/util/ConvertCommonProto.java:46,55
+[ext]) — rebuilt TPU-first instead of ported (SURVEY.md §2.10).
+
+Design
+------
+* A big integer is a little-endian vector of 16-bit limbs held in ``uint32``
+  lanes: shape ``(B, n)`` for a batch of B values, ``n = ceil(bits/16)``.
+  16×16-bit products are exact in uint32; sums stay below 2**27 by keeping
+  the accumulator *redundant* (limbs may exceed 16 bits) and deferring carry
+  normalization — no data-dependent control flow in the hot loop, so XLA
+  compiles one static program (SURVEY.md §7 hard part 1).
+* Modular multiplication is Montgomery CIOS: a ``lax.scan`` over the 256
+  multiplier limbs whose body is pure elementwise vector math over the
+  batch — the batch axis rides the VPU lanes and shards over chips.
+* Modular exponentiation is a fixed 4-bit-window ladder: ``lax.scan`` over
+  64 exponent windows (256-bit exponents), each window = 4 Montgomery
+  squarings + one table-gathered multiply.  ~335 montmuls per modexp.
+
+All functions are shape-generic and jit/vmap/shard_map-compatible; they are
+closed over per-group constants by ``JaxGroupOps``
+(electionguard_tpu.core.group_jax).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+MASK16 = jnp.uint32(0xFFFF)
+U32 = jnp.uint32
+
+
+class MontCtx(NamedTuple):
+    """Static Montgomery context for a fixed odd modulus p.
+
+    ``n`` limbs of 16 bits; R = 2**(16 n) > p; all host-precomputed.
+    """
+
+    p_limbs: jax.Array        # (n,) uint32, little-endian 16-bit limbs of p
+    pinv16: jax.Array         # scalar uint32: -p^{-1} mod 2^16
+    r_mod_p: jax.Array        # (n,) mont(1) = R mod p
+    r2_mod_p: jax.Array       # (n,) R^2 mod p
+    n: int                    # limb count (static)
+
+
+# ---------------------------------------------------------------------------
+# host-side codecs (numpy, python ints)
+# ---------------------------------------------------------------------------
+
+def int_to_limbs(x: int, n: int) -> np.ndarray:
+    """Python int -> (n,) uint32 array of 16-bit little-endian limbs."""
+    if x < 0 or x >= 1 << (16 * n):
+        raise ValueError("int out of range for limb width")
+    b = x.to_bytes(2 * n, "little")
+    return np.frombuffer(b, dtype="<u2").astype(np.uint32)
+
+
+def ints_to_limbs(xs, n: int) -> np.ndarray:
+    """Iterable of ints -> (B, n) uint32."""
+    xs = list(xs)
+    out = np.empty((len(xs), n), dtype=np.uint32)
+    for i, x in enumerate(xs):
+        out[i] = int_to_limbs(x, n)
+    return out
+
+
+def limbs_to_int(a: np.ndarray) -> int:
+    a = np.asarray(a, dtype=np.uint32)
+    return int.from_bytes(a.astype("<u2").tobytes(), "little")
+
+
+def limbs_to_ints(a: np.ndarray) -> list[int]:
+    a = np.asarray(a, dtype=np.uint32)
+    flat = a.astype("<u2").tobytes()
+    w = a.shape[-1] * 2
+    return [int.from_bytes(flat[i * w:(i + 1) * w], "little")
+            for i in range(a.shape[0])]
+
+
+def make_mont_ctx(p: int, n: int | None = None) -> MontCtx:
+    if p % 2 == 0:
+        raise ValueError("Montgomery requires odd modulus")
+    if n is None:
+        n = (p.bit_length() + 15) // 16
+    R = 1 << (16 * n)
+    if R <= p:
+        raise ValueError("R must exceed p")
+    pinv16 = (-pow(p, -1, 1 << 16)) % (1 << 16)
+    return MontCtx(
+        p_limbs=jnp.asarray(int_to_limbs(p, n)),
+        pinv16=jnp.uint32(pinv16),
+        r_mod_p=jnp.asarray(int_to_limbs(R % p, n)),
+        r2_mod_p=jnp.asarray(int_to_limbs(R * R % p, n)),
+        n=n,
+    )
+
+
+# ---------------------------------------------------------------------------
+# carry handling
+# ---------------------------------------------------------------------------
+
+def normalize(t: jax.Array) -> jax.Array:
+    """Carry-propagate a redundant limb vector (..., m) to canonical 16-bit
+    limbs.  Values < 2**32 in; each pass moves carries one limb up; loops
+    until no limb exceeds 16 bits (2-3 passes in practice)."""
+
+    def has_carry(t):
+        return jnp.any(t > MASK16)
+
+    def one_pass(t):
+        lo = t & MASK16
+        hi = t >> 16
+        return lo.at[..., 1:].add(hi[..., :-1])
+        # top-limb carry must be zero by construction (moduli leave headroom)
+
+    return lax.while_loop(has_carry, one_pass, t)
+
+
+def _sub_p(t: jax.Array, p_limbs: jax.Array):
+    """Two's-complement computation of t - p over canonical limbs.
+
+    Returns ``(wrapped, ge)``: ``wrapped = (t + 2^(16n) - p) mod 2^(16n)``
+    (which equals t - p whenever t >= p) and ``ge`` (..., 1) bool, the carry
+    out of the add, true iff t >= p.
+    """
+    n = p_limbs.shape[-1]
+    comp = (MASK16 - p_limbs)  # (n,), 16-bit complement of p
+    s = t + comp
+    s = s.at[..., 0].add(U32(1))  # +1 completes two's complement of p
+    # propagate carries over a widened vector to capture the top carry
+    s = jnp.concatenate(
+        [s, jnp.zeros(s.shape[:-1] + (1,), dtype=jnp.uint32)], axis=-1)
+    s = normalize(s)
+    return s[..., :n], s[..., n:n + 1] > 0
+
+
+def _sub_if_ge(t: jax.Array, p_limbs: jax.Array) -> jax.Array:
+    """Given canonical t (..., n) with t < 2p, return t mod p."""
+    wrapped, ge = _sub_p(t, p_limbs)
+    return jnp.where(ge, wrapped, t)
+
+
+def is_lt(t: jax.Array, p_limbs: jax.Array) -> jax.Array:
+    """Batched canonical-limb comparison t < p -> (...,) bool."""
+    _, ge = _sub_p(t, p_limbs)
+    return ~ge[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# Montgomery CIOS multiply
+# ---------------------------------------------------------------------------
+
+def montmul(ctx: MontCtx, a: jax.Array, b: jax.Array) -> jax.Array:
+    """Batched Montgomery product a·b·R^{-1} mod p.
+
+    a, b: (..., n) canonical 16-bit limbs, values < p.  Returns canonical
+    limbs < p.  The scan body is carry-free: the (..., n+1) accumulator is
+    redundant; per-limb growth is < 4·2^16 per step over n steps, bounded by
+    2^27 « 2^32.
+    """
+    n = ctx.n
+    batch_shape = a.shape[:-1]
+    aT = jnp.moveaxis(a, -1, 0)  # (n, ...) iterate multiplier limbs
+
+    def step(t, a_i):
+        # t: (..., n+1) redundant accumulator
+        prod = a_i[..., None] * b                      # (..., n) exact u32
+        t = t.at[..., :n].add(prod & MASK16)
+        t = t.at[..., 1:n + 1].add(prod >> 16)
+        m = ((t[..., 0] & MASK16) * ctx.pinv16) & MASK16
+        q = m[..., None] * ctx.p_limbs                 # (..., n)
+        t = t.at[..., :n].add(q & MASK16)
+        t = t.at[..., 1:n + 1].add(q >> 16)
+        carry = t[..., 0] >> 16                        # low 16 bits now zero
+        t = jnp.concatenate(
+            [t[..., 1:], jnp.zeros(batch_shape + (1,), jnp.uint32)], axis=-1)
+        t = t.at[..., 0].add(carry)
+        return t, None
+
+    t0 = jnp.zeros(batch_shape + (n + 1,), dtype=jnp.uint32)
+    t, _ = lax.scan(step, t0, aT)
+    t = normalize(t)
+    # t < 2p over n+1 limbs; since t < 2p < 2^(16n) + p the top limb is 0 or
+    # 1, and 1 implies exactly one extra p beyond the n-limb window.
+    t_low = t[..., :n]
+    top = t[..., n:n + 1]
+    wrapped, _ = _sub_p(t_low, ctx.p_limbs)  # t_low - p mod 2^(16n)
+    t_low = jnp.where(top > 0, wrapped, t_low)
+    return _sub_if_ge(t_low, ctx.p_limbs)
+
+
+def to_mont(ctx: MontCtx, a: jax.Array) -> jax.Array:
+    return montmul(ctx, a, jnp.broadcast_to(ctx.r2_mod_p, a.shape))
+
+
+def from_mont(ctx: MontCtx, a: jax.Array) -> jax.Array:
+    one = jnp.zeros_like(a).at[..., 0].set(U32(1))
+    return montmul(ctx, a, one)
+
+
+def mulmod(ctx: MontCtx, a: jax.Array, b: jax.Array) -> jax.Array:
+    """Plain modular product a·b mod p (canonical in, canonical out)."""
+    return montmul(ctx, montmul(ctx, a, b),
+                   jnp.broadcast_to(ctx.r2_mod_p, a.shape))
+
+
+# ---------------------------------------------------------------------------
+# Montgomery-domain exponentiation
+# ---------------------------------------------------------------------------
+
+def mont_pow(ctx: MontCtx, base_mont: jax.Array, exp: jax.Array,
+             exp_bits: int) -> jax.Array:
+    """Batched modexp in the Montgomery domain.
+
+    base_mont: (..., n) Montgomery-domain bases.
+    exp:       (..., ne) 16-bit limbs of exponents (little-endian),
+               ne = ceil(exp_bits/16).
+    Returns Montgomery-domain base^exp.
+
+    Fixed 4-bit windows, MSB-first scan: acc = acc^16 · table[window].
+    """
+    n = ctx.n
+    batch_shape = base_mont.shape[:-1]
+    nwin = (exp_bits + 3) // 4
+
+    # table[d] = base^d in Montgomery domain, d = 0..15: (16, ..., n)
+    one_mont = jnp.broadcast_to(ctx.r_mod_p, base_mont.shape)
+
+    def build_row(carry, _):
+        nxt = montmul(ctx, carry, base_mont)
+        return nxt, carry
+
+    _, table = lax.scan(build_row, one_mont, None, length=16)
+    # table: (16, ..., n) with table[d] = base^d (mont)
+
+    # window digits, MSB first: digit w = bits [4w, 4w+4) of exp
+    win_idx = jnp.arange(nwin - 1, -1, -1)  # MSB-first window numbers
+
+    def step(acc, w):
+        # acc^16
+        for _ in range(4):
+            acc = montmul(ctx, acc, acc)
+        limb = exp[..., w // 4]            # (...,) uint32 16-bit limb
+        digit = (limb >> ((w % 4) * 4)) & U32(0xF)
+        # gather table[digit] per batch element
+        sel = jnp.take_along_axis(
+            table, digit[None, ..., None].astype(jnp.int32),
+            axis=0)[0]                     # (..., n)
+        acc = montmul(ctx, acc, sel)
+        return acc, None
+
+    acc0 = jnp.broadcast_to(ctx.r_mod_p, base_mont.shape)  # mont(1)
+    acc, _ = lax.scan(step, acc0, win_idx)
+    return acc
+
+
+def powmod(ctx: MontCtx, base: jax.Array, exp: jax.Array,
+           exp_bits: int) -> jax.Array:
+    """Canonical-domain batched base^exp mod p."""
+    return from_mont(ctx, mont_pow(ctx, to_mont(ctx, base), exp, exp_bits))

@@ -1,0 +1,198 @@
+"""Batch group operations on TPU: the data plane behind the workflow hot
+loops (encryption, tally accumulation, proof verification — SURVEY.md §3 🔥).
+
+``JaxGroupOps`` closes the generic limb kernels of
+``electionguard_tpu.core.bignum_jax`` over one group's constants and adds:
+
+* codecs between Python-int elements and limb arrays,
+* jitted elementwise batch ops (``powmod``, ``mulmod``, ``g_pow``),
+* PowRadix-style fixed-base exponentiation tables (the TPU answer to the
+  reference's ``PowRadixOption.LOW_MEMORY_USE`` —
+  reference: src/main/java/electionguard/util/KUtils.java:11): 8-bit windows,
+  32 gathers + 31 Montgomery multiplies per 256-bit fixed-base exponent
+  instead of ~335 for the generic ladder,
+* a log-depth Montgomery product-reduce for homomorphic tally accumulation
+  (the reference's per-ballot ``∏ ciphertexts mod p`` loop —
+  reference call site: src/test/java/electionguard/workflow/RunRemoteWorkflowTest.java:151).
+
+Everything is jit-compiled once per (batch-shape, op); the batch axis is the
+sharding axis for multi-chip meshes (see electionguard_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from electionguard_tpu.core import bignum_jax as bn
+from electionguard_tpu.core.group import GroupContext
+
+
+class JaxGroupOps:
+    """Batch plane for one ``GroupContext``.  Thread-compatible, stateless
+    after construction (all tables are device constants)."""
+
+    def __init__(self, group: GroupContext):
+        self.group = group
+        p = group.p
+        self.n = (p.bit_length() + 15) // 16          # p limbs (256 prod)
+        self.ne = (group.q.bit_length() + 15) // 16   # exponent limbs (16)
+        self.exp_bits = group.q.bit_length()
+        self.ctx = bn.make_mont_ctx(p, self.n)
+        R = 1 << (16 * self.n)
+        self._R = R
+
+        # fixed-base tables for g and (lazily) other bases: 8-bit windows
+        self.nwin8 = (self.exp_bits + 7) // 8
+        self._fixed_tables: dict[int, jax.Array] = {}
+        self.g_table = self._make_fixed_table(group.g)
+
+        # jitted entry points
+        self._powmod_j = jax.jit(self._powmod_impl)
+        self._mulmod_j = jax.jit(functools.partial(bn.mulmod, self.ctx))
+        self._fixed_pow_j = jax.jit(self._fixed_pow_impl)
+        self._prod_reduce_j = jax.jit(self._prod_reduce_impl)
+        self._verify_residue_j = jax.jit(self._verify_residue_impl)
+
+    # ------------------------------------------------------------------
+    # codecs
+    # ------------------------------------------------------------------
+    def to_limbs_p(self, xs: Iterable[int]) -> np.ndarray:
+        return bn.ints_to_limbs(xs, self.n)
+
+    def to_limbs_q(self, xs: Iterable[int]) -> np.ndarray:
+        return bn.ints_to_limbs(xs, self.ne)
+
+    def from_limbs(self, arr) -> list[int]:
+        return bn.limbs_to_ints(np.asarray(arr))
+
+    # ------------------------------------------------------------------
+    # fixed-base tables (PowRadix)
+    # ------------------------------------------------------------------
+    def _make_fixed_table(self, base: int) -> jax.Array:
+        """table[w, d] = mont(base^(d * 2^(8w))), shape (nwin8, 256, n).
+
+        Host-built with Python ints (one-time, ~8k modmuls), stored on
+        device in the Montgomery domain.
+        """
+        p, R = self.group.p, self._R
+        rows = np.empty((self.nwin8, 256, self.n), dtype=np.uint32)
+        step = base % p  # base^(2^(8w)) for current w
+        for w in range(self.nwin8):
+            acc = 1
+            for d in range(256):
+                rows[w, d] = bn.int_to_limbs(acc * R % p, self.n)
+                acc = acc * step % p
+            step = acc  # after 256 iters acc = step^256 = base^(2^(8(w+1)))
+        return jnp.asarray(rows)
+
+    def fixed_table(self, base: int) -> jax.Array:
+        t = self._fixed_tables.get(base)
+        if t is None:
+            t = self._make_fixed_table(base)
+            self._fixed_tables[base] = t
+        return t
+
+    def _fixed_pow_impl(self, table: jax.Array, exp: jax.Array) -> jax.Array:
+        """Canonical base^exp for a fixed-base table; exp (B, ne) limbs."""
+        ctx = self.ctx
+        acc = None
+        for w in range(self.nwin8):
+            limb = exp[..., w // 2]
+            digit = ((limb >> ((w % 2) * 8)) & jnp.uint32(0xFF)).astype(jnp.int32)
+            sel = table[w][digit]          # (B, n) gather over 256 rows
+            acc = sel if acc is None else bn.montmul(ctx, acc, sel)
+        return bn.from_mont(ctx, acc)
+
+    # ------------------------------------------------------------------
+    # op implementations
+    # ------------------------------------------------------------------
+    def _powmod_impl(self, base: jax.Array, exp: jax.Array) -> jax.Array:
+        return bn.powmod(self.ctx, base, exp, self.exp_bits)
+
+    def _prod_reduce_impl(self, x: jax.Array) -> jax.Array:
+        """Product over axis 0 of (M, B, n) canonical values -> (B, n).
+
+        Log-depth Montgomery tree: M->M/2->...->1, padding odd levels with
+        mont(1).  Exact shape program per static M.
+        """
+        ctx = self.ctx
+        x = bn.to_mont(ctx, x)
+        m = x.shape[0]
+        while m > 1:
+            if m % 2 == 1:
+                pad = jnp.broadcast_to(ctx.r_mod_p, (1,) + x.shape[1:])
+                x = jnp.concatenate([x, pad], axis=0)
+                m += 1
+            x = bn.montmul(ctx, x[0::2], x[1::2])
+            m //= 2
+        return bn.from_mont(ctx, x[0])
+
+    def _verify_residue_impl(self, x: jax.Array, q_exp: jax.Array) -> jax.Array:
+        """Subgroup membership: 0 < x < p and x^q == 1, batched.
+
+        The range check matches the scalar plane's
+        ``ElementModP.is_valid_residue`` so non-canonical limb encodings
+        (e.g. x = p + 1) are rejected, not silently reduced."""
+        in_range = bn.is_lt(x, self.ctx.p_limbs) & jnp.any(x != 0, axis=-1)
+        y = bn.powmod(self.ctx, x, q_exp, self.group.q.bit_length())
+        one = jnp.zeros_like(y).at[..., 0].set(jnp.uint32(1))
+        return in_range & jnp.all(y == one, axis=-1)
+
+    # ------------------------------------------------------------------
+    # public array API (jnp/np arrays of limbs in and out)
+    # ------------------------------------------------------------------
+    def powmod(self, base, exp):
+        """Elementwise batch base^exp mod p; base (B,n), exp (B,ne)."""
+        return self._powmod_j(jnp.asarray(base), jnp.asarray(exp))
+
+    def mulmod(self, a, b):
+        return self._mulmod_j(jnp.asarray(a), jnp.asarray(b))
+
+    def g_pow(self, exp):
+        """g^exp via the PowRadix table; exp (B, ne)."""
+        return self._fixed_pow_j(self.g_table, jnp.asarray(exp))
+
+    def base_pow(self, base: int, exp):
+        """base^exp for a host-known base (K, g^{-1}, ...) via cached table."""
+        return self._fixed_pow_j(self.fixed_table(base), jnp.asarray(exp))
+
+    def prod_reduce(self, x):
+        """Product over axis 0: (M, B, n) -> (B, n)."""
+        return self._prod_reduce_j(jnp.asarray(x))
+
+    def is_valid_residue(self, x):
+        """Batched subgroup membership x^q == 1 (and 0 < x < p)."""
+        x = jnp.asarray(x)
+        q_exp = jnp.broadcast_to(
+            jnp.asarray(bn.int_to_limbs(self.group.q, self.ne)),
+            x.shape[:-1] + (self.ne,))
+        return self._verify_residue_j(x, q_exp)
+
+    # ------------------------------------------------------------------
+    # int-facing convenience (tests, small control-plane batches)
+    # ------------------------------------------------------------------
+    def powmod_ints(self, bases: Sequence[int], exps: Sequence[int]) -> list[int]:
+        return self.from_limbs(
+            self.powmod(self.to_limbs_p(bases), self.to_limbs_q(exps)))
+
+    def mulmod_ints(self, a: Sequence[int], b: Sequence[int]) -> list[int]:
+        return self.from_limbs(
+            self.mulmod(self.to_limbs_p(a), self.to_limbs_p(b)))
+
+    def g_pow_ints(self, exps: Sequence[int]) -> list[int]:
+        return self.from_limbs(self.g_pow(self.to_limbs_q(exps)))
+
+    def prod_ints(self, xs: Sequence[Sequence[int]]) -> list[int]:
+        arr = np.stack([self.to_limbs_p(row) for row in xs])  # (M, B, n)
+        return self.from_limbs(self.prod_reduce(arr))
+
+
+@functools.lru_cache(maxsize=None)
+def jax_ops(group: GroupContext) -> JaxGroupOps:
+    """Process-wide cached batch plane per group."""
+    return JaxGroupOps(group)

@@ -8,11 +8,21 @@ Must run before the first ``import jax`` anywhere in the test session.
 
 import os
 
+# Detach from the axon TPU tunnel entirely: tests are CPU-only, and a wedged
+# relay otherwise hangs `import jax` (the axon plugin dials the relay at
+# backend init regardless of JAX_PLATFORMS).
+for _k in list(os.environ):
+    if "AXON" in _k or "PALLAS" in _k or _k.startswith("TPU"):
+        os.environ.pop(_k)
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
