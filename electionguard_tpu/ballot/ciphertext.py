@@ -30,11 +30,16 @@ class EncryptedSelection:
     sequence_order: int
     ciphertext: ElGamalCiphertext
     proof: DisjunctiveChaumPedersenProof
+    # placeholder selections pad every contest so the selection sum always
+    # equals the vote limit; excluded from reported tallies
+    is_placeholder: bool = False
 
     def crypto_hash(self) -> bytes:
+        # is_placeholder is hashed: the flag decides tally membership, so it
+        # must be bound to the ballot's confirmation code
         return hash_digest("enc-selection", self.selection_id,
-                           self.sequence_order, self.ciphertext.pad,
-                           self.ciphertext.data)
+                           self.sequence_order, int(self.is_placeholder),
+                           self.ciphertext.pad, self.ciphertext.data)
 
 
 @dataclass(frozen=True)

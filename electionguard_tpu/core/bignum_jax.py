@@ -154,6 +154,28 @@ def is_lt(t: jax.Array, p_limbs: jax.Array) -> jax.Array:
     return ~ge[..., 0]
 
 
+def add_mod(a: jax.Array, b: jax.Array, p_limbs: jax.Array) -> jax.Array:
+    """(a + b) mod p for canonical a, b < p.  Sum < 2p fits n+1 limbs."""
+    s = a + b  # limbs < 2^17, redundant
+    s = jnp.concatenate(
+        [s, jnp.zeros(s.shape[:-1] + (1,), jnp.uint32)], axis=-1)
+    s = normalize(s)
+    n = p_limbs.shape[-1]
+    low, top = s[..., :n], s[..., n:n + 1]
+    wrapped, _ = _sub_p(low, p_limbs)
+    low = jnp.where(top > 0, wrapped, low)
+    return _sub_if_ge(low, p_limbs)
+
+
+def sub_mod(a: jax.Array, b: jax.Array, p_limbs: jax.Array) -> jax.Array:
+    """(a - b) mod p for canonical a, b < p, via a + (p - b)."""
+    p_minus_b, _ = _sub_p(jnp.broadcast_to(p_limbs, b.shape), b)  # p - b
+    # b == 0 makes p - b == p (not canonical); map it back to 0
+    b_zero = jnp.all(b == 0, axis=-1, keepdims=True)
+    p_minus_b = jnp.where(b_zero, jnp.zeros_like(p_minus_b), p_minus_b)
+    return add_mod(a, p_minus_b, p_limbs)
+
+
 # ---------------------------------------------------------------------------
 # Montgomery CIOS multiply
 # ---------------------------------------------------------------------------

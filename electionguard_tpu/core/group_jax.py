@@ -192,7 +192,63 @@ class JaxGroupOps:
         return self.from_limbs(self.prod_reduce(arr))
 
 
+class JaxExponentOps:
+    """Batched Z_q (exponent field) arithmetic: the 256-bit side plane used
+    by proof generation/verification pipelines (response and challenge
+    algebra: v = u - c·s mod q, nonce products, Lagrange weights)."""
+
+    def __init__(self, group: GroupContext):
+        self.group = group
+        self.ne = (group.q.bit_length() + 15) // 16
+        self.ctx = bn.make_mont_ctx(group.q, self.ne)
+        self._mul_j = jax.jit(functools.partial(bn.mulmod, self.ctx))
+        self._add_j = jax.jit(
+            functools.partial(bn.add_mod, p_limbs=self.ctx.p_limbs))
+        self._sub_j = jax.jit(
+            functools.partial(bn.sub_mod, p_limbs=self.ctx.p_limbs))
+
+    def to_limbs(self, xs: Iterable[int]) -> np.ndarray:
+        return bn.ints_to_limbs(xs, self.ne)
+
+    def from_limbs(self, arr) -> list[int]:
+        return bn.limbs_to_ints(np.asarray(arr))
+
+    def mul(self, a, b):
+        return self._mul_j(jnp.asarray(a), jnp.asarray(b))
+
+    def add(self, a, b):
+        return self._add_j(jnp.asarray(a), jnp.asarray(b))
+
+    def sub(self, a, b):
+        return self._sub_j(jnp.asarray(a), jnp.asarray(b))
+
+    def a_minus_bc(self, a, b, c):
+        """a - b·c mod q, the response equation of every proof."""
+        return self.sub(a, self.mul(b, c))
+
+
+def limbs_to_bytes_be(arr: np.ndarray) -> np.ndarray:
+    """(B, n) uint32 16-bit little-endian limbs -> (B, 2n) uint8 big-endian
+    byte images (the wire/hash encoding of common.proto:6-16)."""
+    arr = np.asarray(arr, dtype=np.uint32)
+    le16 = arr.astype("<u2")[..., ::-1]          # big-endian limb order
+    return le16.astype(">u2").view(np.uint8).reshape(arr.shape[0], -1)
+
+
+def bytes_be_to_limbs(b: np.ndarray) -> np.ndarray:
+    """(B, 2n) uint8 big-endian bytes -> (B, n) uint32 limbs."""
+    b = np.ascontiguousarray(b, dtype=np.uint8)
+    be16 = b.view(">u2").reshape(b.shape[0], -1)
+    return be16[..., ::-1].astype(np.uint32)
+
+
 @functools.lru_cache(maxsize=None)
 def jax_ops(group: GroupContext) -> JaxGroupOps:
     """Process-wide cached batch plane per group."""
     return JaxGroupOps(group)
+
+
+@functools.lru_cache(maxsize=None)
+def jax_exp_ops(group: GroupContext) -> JaxExponentOps:
+    """Process-wide cached exponent plane per group."""
+    return JaxExponentOps(group)

@@ -1,0 +1,228 @@
+"""Coordinator-side decryption: share gathering, Lagrange combine, decode.
+
+Native replacement for the reference's [ext] ``Decryption`` —
+``Decryption(group, electionInit, trustees, missingGuardians)`` with
+``.decrypt(tally)`` / ``.decryptBallot(ballot)`` / ``.getAvailableGuardians()``
+(call site: src/main/java/electionguard/decrypt/RunRemoteDecryptor.java:261-273).
+
+For every selection (A, B):
+  * each available guardian i contributes Mᵢ = A^{a_i0} (direct),
+  * each missing guardian m is reconstructed from quorum backups:
+    M_m = Π_ℓ (A^{P_m(ℓ)})^{w_ℓ} with Lagrange coefficients
+    w_ℓ = Π_{j≠ℓ} x_j/(x_j − x_ℓ) mod q — the cryptographic fault tolerance
+    of SURVEY.md §5.3,
+  * B / Π M = g^t, and t is decoded with the small-exponent dlog table
+    (SURVEY.md §3.2 🔥).
+
+All trustee calls are batched over the whole tally (one round trip per
+trustee per protocol leg, matching the reference's batch rpcs); every proof
+is verified on arrival — a bad trustee is detected here, not in the final
+verifier.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+from electionguard_tpu.ballot.ciphertext import EncryptedBallot
+from electionguard_tpu.ballot.tally import (EncryptedTally, PartialDecryption,
+                                            PlaintextTally,
+                                            PlaintextTallyContest,
+                                            PlaintextTallySelection)
+from electionguard_tpu.core.dlog import DLog
+from electionguard_tpu.core.group import (ElementModP, ElementModQ,
+                                          GroupContext)
+from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
+from electionguard_tpu.decrypt.interface import DecryptingTrusteeIF
+from electionguard_tpu.keyceremony.interface import Result
+from electionguard_tpu.keyceremony.trustee import commitment_product
+from electionguard_tpu.publish.election_record import (DecryptingGuardian,
+                                                       ElectionInitialized)
+
+
+def lagrange_coefficient(group: GroupContext, xs: Sequence[int],
+                         x_l: int) -> ElementModQ:
+    """w_ℓ = Π_{j≠ℓ} x_j / (x_j − x_ℓ) mod q."""
+    num, den = 1, 1
+    for x_j in xs:
+        if x_j == x_l:
+            continue
+        num = num * x_j % group.q
+        den = den * (x_j - x_l) % group.q
+    return group.mult_q(group.int_to_q(num),
+                        group.inv_q(group.int_to_q(den)))
+
+
+class DecryptionError(Exception):
+    pass
+
+
+class Decryption:
+    def __init__(self, group: GroupContext, election_init: ElectionInitialized,
+                 trustees: Sequence[DecryptingTrusteeIF],
+                 missing_guardian_ids: Sequence[str],
+                 dlog: Optional[DLog] = None):
+        self.group = group
+        self.init = election_init
+        self.trustees = list(trustees)
+        self.missing = list(missing_guardian_ids)
+        self.dlog = dlog if dlog is not None else DLog(group)
+
+        n = election_init.config.n_guardians
+        quorum = election_init.config.quorum
+        if len(self.trustees) < quorum:
+            raise DecryptionError(
+                f"navailable {len(self.trustees)} < quorum {quorum}")
+        if len(self.trustees) + len(self.missing) != n:
+            raise DecryptionError("available + missing != nguardians")
+        known = {g.guardian_id for g in election_init.guardians}
+        for t in self.trustees:
+            if t.id not in known:
+                raise DecryptionError(f"unknown trustee {t.id}")
+            rec = election_init.guardian(t.id)
+            if rec.x_coordinate != t.x_coordinate:
+                raise DecryptionError(f"trustee {t.id} x mismatch")
+            if rec.coefficient_commitments[0] != t.election_public_key:
+                raise DecryptionError(f"trustee {t.id} public key mismatch")
+        for m in self.missing:
+            if m not in known:
+                raise DecryptionError(f"unknown missing guardian {m}")
+
+        xs = [t.x_coordinate for t in self.trustees]
+        self.lagrange = {
+            t.id: lagrange_coefficient(group, xs, t.x_coordinate)
+            for t in self.trustees}
+
+    # ------------------------------------------------------------------
+    def get_available_guardians(self) -> list[DecryptingGuardian]:
+        return [DecryptingGuardian(t.id, t.x_coordinate, self.lagrange[t.id])
+                for t in self.trustees]
+
+    # ------------------------------------------------------------------
+    def _decrypt_batch(
+            self, texts: list[ElGamalCiphertext]
+    ) -> list[tuple[int, ElementModP, tuple[PartialDecryption, ...]]]:
+        """Decrypt a batch of ciphertexts; returns (t, g^t, shares) each."""
+        g = self.group
+        qbar = self.init.extended_base_hash
+
+        # direct shares: one batched call per available trustee
+        direct: dict[str, list] = {}
+        for t in self.trustees:
+            res = t.direct_decrypt(texts, qbar)
+            if isinstance(res, Result):
+                raise DecryptionError(f"{t.id} directDecrypt: {res.error}")
+            if len(res) != len(texts):
+                raise DecryptionError(f"{t.id} returned wrong batch size")
+            rec = self.init.guardian(t.id)
+            for ct, d in zip(texts, res):
+                if not d.proof.is_valid(g.G_MOD_P,
+                                        rec.coefficient_commitments[0],
+                                        ct.pad, d.partial_decryption, qbar):
+                    raise DecryptionError(
+                        f"direct decryption proof of {t.id} invalid")
+            direct[t.id] = res
+
+        # compensated shares: per missing guardian, per available trustee
+        compensated: dict[str, dict[str, list]] = {}
+        for m in self.missing:
+            m_rec = self.init.guardian(m)
+            per_trustee = {}
+            for t in self.trustees:
+                res = t.compensated_decrypt(m, texts, qbar)
+                if isinstance(res, Result):
+                    raise DecryptionError(
+                        f"{t.id} compensatedDecrypt({m}): {res.error}")
+                if len(res) != len(texts):
+                    raise DecryptionError(
+                        f"{t.id} returned wrong batch size for {m}")
+                expected_recovery = commitment_product(
+                    g, m_rec.coefficient_commitments, t.x_coordinate)
+                for ct, c in zip(texts, res):
+                    if c.recovered_public_key_share != expected_recovery:
+                        raise DecryptionError(
+                            f"recovery key of {t.id} for {m} mismatches "
+                            f"public commitments")
+                    if not c.proof.is_valid(
+                            g.G_MOD_P, c.recovered_public_key_share,
+                            ct.pad, c.partial_decryption, qbar):
+                        raise DecryptionError(
+                            f"compensated proof of {t.id} for {m} invalid")
+                per_trustee[t.id] = res
+            compensated[m] = per_trustee
+
+        # combine per ciphertext
+        out = []
+        for idx, ct in enumerate(texts):
+            shares: list[PartialDecryption] = []
+            m_total = g.ONE_MOD_P
+            for t in self.trustees:
+                d = direct[t.id][idx]
+                m_total = g.mult_p(m_total, d.partial_decryption)
+                shares.append(PartialDecryption(
+                    t.id, d.partial_decryption, d.proof))
+            for m in self.missing:
+                recovered = g.ONE_MOD_P
+                parts = {}
+                for t in self.trustees:
+                    c = compensated[m][t.id][idx]
+                    recovered = g.mult_p(
+                        recovered,
+                        g.pow_p(c.partial_decryption, self.lagrange[t.id]))
+                    parts[t.id] = c
+                m_total = g.mult_p(m_total, recovered)
+                shares.append(PartialDecryption(
+                    m, recovered, None, parts))
+            value = g.div_p(ct.data, m_total)  # g^t
+            t_val = self.dlog.dlog(value)
+            if t_val is None:
+                raise DecryptionError("tally exceeds dlog table")
+            out.append((t_val, value, tuple(shares)))
+        return out
+
+    # ------------------------------------------------------------------
+    def decrypt(self, tally: EncryptedTally) -> PlaintextTally:
+        texts, keys = [], []
+        for c in tally.contests:
+            for s in c.selections:
+                texts.append(s.ciphertext)
+                keys.append((c.contest_id, s.selection_id))
+        results = self._decrypt_batch(texts)
+        by_key = dict(zip(keys, results))
+        contests = tuple(
+            PlaintextTallyContest(
+                contest_id=c.contest_id,
+                selections=tuple(
+                    PlaintextTallySelection(
+                        selection_id=s.selection_id,
+                        tally=by_key[(c.contest_id, s.selection_id)][0],
+                        value=by_key[(c.contest_id, s.selection_id)][1],
+                        message=s.ciphertext,
+                        shares=by_key[(c.contest_id, s.selection_id)][2])
+                    for s in c.selections))
+            for c in tally.contests)
+        return PlaintextTally(tally.tally_id, contests)
+
+    def decrypt_ballot(self, ballot: EncryptedBallot) -> PlaintextTally:
+        """Decrypt one (spoiled) ballot as a single-ballot tally
+        (reference: RunRemoteDecryptor.java:264-269)."""
+        texts, keys = [], []
+        for c in ballot.contests:
+            for s in c.selections:
+                texts.append(s.ciphertext)
+                keys.append((c.contest_id, s.selection_id))
+        results = self._decrypt_batch(texts)
+        by_key = dict(zip(keys, results))
+        contests = tuple(
+            PlaintextTallyContest(
+                contest_id=c.contest_id,
+                selections=tuple(
+                    PlaintextTallySelection(
+                        selection_id=s.selection_id,
+                        tally=by_key[(c.contest_id, s.selection_id)][0],
+                        value=by_key[(c.contest_id, s.selection_id)][1],
+                        message=s.ciphertext,
+                        shares=by_key[(c.contest_id, s.selection_id)][2])
+                    for s in c.selections))
+            for c in ballot.contests)
+        return PlaintextTally(ballot.ballot_id, contests)

@@ -1,0 +1,65 @@
+"""Decryption-side trustee interface + result types.
+
+Mirrors the reference's [ext] ``DecryptingTrusteeIF`` surface
+(``id, xCoordinate, electionPublicKey, directDecrypt, compensatedDecrypt`` —
+reference: src/main/java/electionguard/decrypt/RemoteDecryptingTrusteeProxy.java:33-115)
+so the coordinator's combine logic is location-transparent: in-process
+trustees, gRPC proxies, and the TPU batch backend all implement it.
+
+Requests are *batched*: one call covers a whole tally's selections, exactly
+the reference's batch-rpc shape (repeated ElGamalCiphertext —
+src/main/proto/decrypting_trustee_rpc.proto:17,33).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Protocol, Sequence, Union
+
+from electionguard_tpu.core.group import ElementModP, ElementModQ
+from electionguard_tpu.crypto.chaum_pedersen import GenericChaumPedersenProof
+from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
+from electionguard_tpu.keyceremony.interface import Result
+
+
+@dataclass(frozen=True)
+class DirectDecryptionAndProof:
+    """Mᵢ = A^{sᵢ} plus the Chaum-Pedersen proof of correct decryption
+    (reference [ext] DirectDecryptionAndProof,
+    RunRemoteDecryptingTrustee.java:210-215)."""
+
+    partial_decryption: ElementModP
+    proof: GenericChaumPedersenProof
+
+
+@dataclass(frozen=True)
+class CompensatedDecryptionAndProof:
+    """Mᵢ,ℓ = A^{P_i(ℓ)} plus proof plus the recovered public key share
+    g^{P_i(ℓ)} (reference [ext] CompensatedDecryptionAndProof,
+    RunRemoteDecryptingTrustee.java:249-255)."""
+
+    partial_decryption: ElementModP
+    proof: GenericChaumPedersenProof
+    recovered_public_key_share: ElementModP
+
+
+class DecryptingTrusteeIF(Protocol):
+    @property
+    def id(self) -> str: ...
+
+    @property
+    def x_coordinate(self) -> int: ...
+
+    @property
+    def election_public_key(self) -> ElementModP: ...
+
+    def direct_decrypt(
+            self, texts: Sequence[ElGamalCiphertext],
+            extended_base_hash: ElementModQ,
+    ) -> Union[list[DirectDecryptionAndProof], Result]: ...
+
+    def compensated_decrypt(
+            self, missing_guardian_id: str,
+            texts: Sequence[ElGamalCiphertext],
+            extended_base_hash: ElementModQ,
+    ) -> Union[list[CompensatedDecryptionAndProof], Result]: ...

@@ -1,0 +1,130 @@
+"""The decrypting trustee: holds a guardian's decryption secrets.
+
+Native replacement for the reference's [ext] ``DecryptingTrustee`` —
+deserialized from the key ceremony's saved state and served over gRPC
+(reference: src/main/java/electionguard/decrypt/RunRemoteDecryptingTrustee.java:24,90
+``readTrustee(group, trusteeFile)``).
+
+Holds: the guardian's own secret ``a_{i0}``, the received backup shares
+``P_i(ℓ)`` for every other guardian i (enabling compensated decryption for
+missing guardians), and everyone's public commitments (for recovery keys).
+Secrets never leave; only shares Mᵢ = A^s and proofs do (SURVEY.md §7 hard
+part 5).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Sequence, Union
+
+from electionguard_tpu.core.group import (ElementModP, ElementModQ,
+                                          GroupContext)
+from electionguard_tpu.crypto.chaum_pedersen import (
+    GenericChaumPedersenProof, make_generic_cp_proof)
+from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
+from electionguard_tpu.decrypt.interface import (
+    CompensatedDecryptionAndProof, DecryptingTrusteeIF,
+    DirectDecryptionAndProof)
+from electionguard_tpu.keyceremony.interface import Result
+from electionguard_tpu.keyceremony.trustee import commitment_product
+
+
+class DecryptingTrustee(DecryptingTrusteeIF):
+    def __init__(self, group: GroupContext, guardian_id: str,
+                 x_coordinate: int, secret_key: ElementModQ,
+                 received_shares: dict[str, ElementModQ],
+                 public_commitments: dict[str, list[ElementModP]],
+                 own_commitments: list[ElementModP]):
+        self.group = group
+        self._id = guardian_id
+        self._x = x_coordinate
+        self._secret = secret_key
+        self._received_shares = dict(received_shares)
+        self._public_commitments = dict(public_commitments)
+        self._own_commitments = list(own_commitments)
+
+    # ------------------------------------------------------------------
+    @property
+    def id(self) -> str:
+        return self._id
+
+    @property
+    def x_coordinate(self) -> int:
+        return self._x
+
+    @property
+    def election_public_key(self) -> ElementModP:
+        return self._own_commitments[0]
+
+    # ------------------------------------------------------------------
+    def direct_decrypt(
+            self, texts: Sequence[ElGamalCiphertext],
+            extended_base_hash: ElementModQ,
+    ) -> Union[list[DirectDecryptionAndProof], Result]:
+        """Mᵢ = A^{a_i0} + CP proof, for every ciphertext in the batch
+        (the trustee-side hot loop — SURVEY.md §3.2 🔥)."""
+        g = self.group
+        out = []
+        for ct in texts:
+            share = g.pow_p(ct.pad, self._secret)
+            proof = make_generic_cp_proof(
+                g, self._secret, g.G_MOD_P, ct.pad, g.rand_q(),
+                extended_base_hash)
+            out.append(DirectDecryptionAndProof(share, proof))
+        return out
+
+    def compensated_decrypt(
+            self, missing_guardian_id: str,
+            texts: Sequence[ElGamalCiphertext],
+            extended_base_hash: ElementModQ,
+    ) -> Union[list[CompensatedDecryptionAndProof], Result]:
+        """Mᵢ,ℓ = A^{P_i(ℓ)} for a missing guardian i, plus the recovery key
+        g^{P_i(ℓ)} recomputed from i's public commitments."""
+        g = self.group
+        backup = self._received_shares.get(missing_guardian_id)
+        if backup is None:
+            return Result.Err(
+                f"{self._id} holds no backup for {missing_guardian_id}")
+        commitments = self._public_commitments.get(missing_guardian_id)
+        if commitments is None:
+            return Result.Err(
+                f"{self._id} has no commitments for {missing_guardian_id}")
+        recovery = commitment_product(g, tuple(commitments), self._x)
+        if g.g_pow_p(backup) != recovery:
+            return Result.Err(
+                f"backup for {missing_guardian_id} fails commitment check")
+        out = []
+        for ct in texts:
+            share = g.pow_p(ct.pad, backup)
+            proof = make_generic_cp_proof(
+                g, backup, g.G_MOD_P, ct.pad, g.rand_q(),
+                extended_base_hash)
+            out.append(CompensatedDecryptionAndProof(share, proof, recovery))
+        return out
+
+    # ------------------------------------------------------------------
+    # persistence (the trustee-file checkpoint of SURVEY.md §5.4)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_state(group: GroupContext, state: dict) -> "DecryptingTrustee":
+        return DecryptingTrustee(
+            group=group,
+            guardian_id=state["guardian_id"],
+            x_coordinate=state["x_coordinate"],
+            secret_key=group.int_to_q(state["secret_key"]),
+            received_shares={
+                gid: group.int_to_q(v)
+                for gid, v in state["received_shares"].items()},
+            public_commitments={
+                gid: [ElementModP(v, group) for v in ks]
+                for gid, ks in state["public_commitments"].items()},
+            own_commitments=[ElementModP(v, group)
+                             for v in state["own_commitments"]],
+        )
+
+
+def read_trustee(group: GroupContext, path: str) -> DecryptingTrustee:
+    """Mirror of the reference's [ext] ``readTrustee(group, file)``
+    (RunRemoteDecryptingTrustee.java:90)."""
+    with open(path) as f:
+        return DecryptingTrustee.from_state(group, json.load(f))

@@ -1,0 +1,321 @@
+"""Batch ballot encryption: the TPU-vmapped replacement for the reference's
+[ext] ``batchEncryption(group, in, out, ballots, invalid, fixedNonces,
+nthreads=11, createdBy, check)`` (call site:
+src/test/java/electionguard/workflow/RunRemoteWorkflowTest.java:140 — the
+reference scales this with an 11-thread CPU pool; we scale it with the batch
+axis on the chip, SURVEY.md §5.7).
+
+TPU-first structure: because the encryptor KNOWS every nonce R, *every*
+group exponentiation in the pipeline — ciphertext pads/datas, real proof
+commitments, and even the simulated-branch commitments
+``a_f = g^{v_f} α^{c_f} = g^{v_f + R c_f}`` — is a fixed-base power of g or
+K.  One batched PowRadix pass over [all ballots × contests × selections]
+computes everything; host work is only SHA-256 challenges and bookkeeping.
+
+Per selection: 4 g-powers + 3 K-powers + 2 modmuls.
+Per contest:   2 g-powers + 2 K-powers (limit proof + direct accumulation
+               A = g^{ΣR}, B = g^{ΣV} K^{ΣR}).
+
+Contests are padded with ``votes_allowed`` placeholder selections so the
+selection sum always equals the limit; overvoted ballots are returned on the
+invalid list (the reference's invalidDir)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from electionguard_tpu.ballot.ciphertext import (BallotState, EncryptedBallot,
+                                                 EncryptedContest,
+                                                 EncryptedSelection)
+from electionguard_tpu.ballot.manifest import Manifest
+from electionguard_tpu.ballot.plaintext import PlaintextBallot
+from electionguard_tpu.core.group import ElementModP, ElementModQ
+from electionguard_tpu.core.group_jax import (JaxExponentOps, JaxGroupOps,
+                                              jax_exp_ops, jax_ops,
+                                              limbs_to_bytes_be)
+from electionguard_tpu.core.hash import hash_digest, hash_elems
+from electionguard_tpu.crypto.chaum_pedersen import (
+    ConstantChaumPedersenProof, DisjunctiveChaumPedersenProof)
+from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
+from electionguard_tpu.publish.election_record import ElectionInitialized
+
+
+@dataclass
+class _FlatSelections:
+    """Columnar view of one batch: all selections of all ballots."""
+
+    ballot_idx: list[int]
+    contest_idx: list[int]          # index into per-ballot contest list
+    selection_ids: list[str]
+    sequence_orders: list[int]
+    votes: list[int]
+    is_placeholder: list[bool]
+
+
+class BatchEncryptor:
+    def __init__(self, election_init: ElectionInitialized,
+                 group=None):
+        self.init = election_init
+        self.group = group if group is not None else \
+            election_init.joint_public_key.group
+        self.manifest = election_init.config.manifest
+        self.K = election_init.joint_public_key
+        self.qbar = election_init.extended_base_hash
+        self.ops: JaxGroupOps = jax_ops(self.group)
+        self.eops: JaxExponentOps = jax_exp_ops(self.group)
+        # build/cache the K fixed-base table once
+        self.ops.fixed_table(self.K.value)
+
+    # ------------------------------------------------------------------
+    def encrypt_ballots(
+            self, ballots: Sequence[PlaintextBallot],
+            seed: Optional[ElementModQ] = None,
+            code_seed: Optional[bytes] = None,
+    ) -> tuple[list[EncryptedBallot], list[tuple[PlaintextBallot, str]]]:
+        """Encrypt a batch.  Returns (encrypted, invalid) where invalid is
+        [(ballot, reason)] — mirroring batchEncryption's invalidDir."""
+        g = self.group
+        seed = seed if seed is not None else g.rand_q()
+        code_seed = code_seed if code_seed is not None else \
+            hash_digest("code-chain-start", self.init.manifest_hash)
+
+        # ---- flatten: selections (with placeholders) and contests -------
+        valid: list[PlaintextBallot] = []
+        invalid: list[tuple[PlaintextBallot, str]] = []
+        flat = _FlatSelections([], [], [], [], [], [])
+        contest_rows: list[tuple[int, int, str, int, int]] = []
+        # (ballot_idx, contest_idx, contest_id, seq, limit)
+        contests_by_id = {c.object_id: c for c in self.manifest.contests}
+
+        for b in ballots:
+            reason = None
+            for c in b.contests:
+                desc = contests_by_id.get(c.contest_id)
+                if desc is None:
+                    reason = f"unknown contest {c.contest_id}"
+                    break
+                known_sels = {s.object_id for s in desc.selections}
+                bad = [s.selection_id for s in c.selections
+                       if s.selection_id not in known_sels]
+                if bad:
+                    reason = f"unknown selection {bad[0]} in {c.contest_id}"
+                    break
+                votes = [s.vote for s in c.selections]
+                if any(v not in (0, 1) for v in votes):
+                    reason = f"non-binary vote in {c.contest_id}"
+                    break
+                if sum(votes) > desc.votes_allowed:
+                    reason = f"overvote in {c.contest_id}"
+                    break
+            if reason is not None:
+                invalid.append((b, reason))
+                continue
+            bi = len(valid)
+            valid.append(b)
+            for ci, c in enumerate(b.contests):
+                desc = contests_by_id[c.contest_id]
+                limit = desc.votes_allowed
+                votes = [s.vote for s in c.selections]
+                n_real = len(votes)
+                pad_votes = [0] * limit
+                for j in range(limit - sum(votes)):
+                    pad_votes[j] = 1  # placeholders top the sum up to limit
+                contest_rows.append((bi, ci, c.contest_id,
+                                     desc.sequence_order, limit))
+                for si, s in enumerate(c.selections):
+                    flat.ballot_idx.append(bi)
+                    flat.contest_idx.append(len(contest_rows) - 1)
+                    flat.selection_ids.append(s.selection_id)
+                    flat.sequence_orders.append(si)
+                    flat.votes.append(s.vote)
+                    flat.is_placeholder.append(False)
+                for j, pv in enumerate(pad_votes):
+                    flat.ballot_idx.append(bi)
+                    flat.contest_idx.append(len(contest_rows) - 1)
+                    flat.selection_ids.append(
+                        f"{c.contest_id}-placeholder-{j}")
+                    flat.sequence_orders.append(n_real + j)
+                    flat.votes.append(pv)
+                    flat.is_placeholder.append(True)
+
+        S = len(flat.votes)
+        C = len(contest_rows)
+        if S == 0:
+            return [], invalid
+
+        # ---- host: nonce + fake-branch scalar streams -------------------
+        q = g.q
+        R = np.empty(S, dtype=object)
+        U = np.empty(S, dtype=object)
+        CF = np.empty(S, dtype=object)
+        VF = np.empty(S, dtype=object)
+        for i in range(S):
+            h = hash_elems(g, seed, valid[flat.ballot_idx[i]].ballot_id,
+                           flat.contest_idx[i], flat.selection_ids[i])
+            R[i] = h.value
+            U[i] = hash_elems(g, h, "u").value
+            CF[i] = hash_elems(g, h, "cf").value
+            VF[i] = hash_elems(g, h, "vf").value
+
+        votes = np.array(flat.votes, dtype=np.int64)
+
+        # ---- device: exponent algebra then one big fixed-base pass ------
+        eo = self.ops
+        ee = self.eops
+        R_l = ee.to_limbs(R)
+        U_l = ee.to_limbs(U)
+        CF_l = ee.to_limbs(CF)
+        VF_l = ee.to_limbs(VF)
+        # w = v_f + R*c_f mod q
+        W_l = np.asarray(ee.add(VF_l, ee.mul(R_l, CF_l)))
+        # s = +c_f (vote==1) or q - c_f (vote==0), exponent of g in b_fake
+        CF_np = CF_l
+        negCF = np.asarray(ee.sub(ee.to_limbs([0] * S), CF_l))
+        S_l = np.where((votes == 1)[:, None], CF_np, negCF).astype(np.uint32)
+
+        g_exps = np.concatenate([R_l, U_l, W_l, S_l])      # (4S, ne)
+        k_exps = np.concatenate([R_l, U_l, W_l])           # (3S, ne)
+        g_pows = np.asarray(eo.g_pow(g_exps))
+        k_pows = np.asarray(eo.base_pow(self.K.value, k_exps))
+        alpha = g_pows[:S]
+        a_real = g_pows[S:2 * S]
+        a_fake = g_pows[2 * S:3 * S]
+        g_s = g_pows[3 * S:]
+        beta_k = k_pows[:S]
+        b_real = k_pows[S:2 * S]
+        k_w = k_pows[2 * S:]
+
+        g_limbs = eo.to_limbs_p([g.g])[0]
+        beta1 = np.asarray(eo.mulmod(
+            beta_k, np.broadcast_to(g_limbs, beta_k.shape)))
+        beta = np.where((votes == 1)[:, None], beta1, beta_k).astype(np.uint32)
+        b_fake = np.asarray(eo.mulmod(g_s, k_w))
+
+        # ---- host: Fiat-Shamir challenges -------------------------------
+        alpha_b = limbs_to_bytes_be(alpha)
+        beta_b = limbs_to_bytes_be(beta)
+        a_real_b = limbs_to_bytes_be(a_real)
+        b_real_b = limbs_to_bytes_be(b_real)
+        a_fake_b = limbs_to_bytes_be(a_fake)
+        b_fake_b = limbs_to_bytes_be(b_fake)
+
+        C_chal = np.empty(S, dtype=object)
+        for i in range(S):
+            if votes[i] == 0:
+                a0, b0, a1, b1 = (a_real_b[i], b_real_b[i],
+                                  a_fake_b[i], b_fake_b[i])
+            else:
+                a0, b0, a1, b1 = (a_fake_b[i], b_fake_b[i],
+                                  a_real_b[i], b_real_b[i])
+            C_chal[i] = _hash_disjunctive(
+                g, self.qbar, alpha_b[i], beta_b[i], a0, b0, a1, b1)
+
+        # c_real = c - c_f ; v_real = u - c_real * R  (device, mod q)
+        C_l = ee.to_limbs(C_chal)
+        CR_l = np.asarray(ee.sub(C_l, CF_l))
+        VR_l = np.asarray(ee.a_minus_bc(U_l, CR_l, R_l))
+
+        # ---- contests: accumulation + limit proof -----------------------
+        R_sum = [0] * C
+        V_sum = [0] * C
+        for i in range(S):
+            R_sum[flat.contest_idx[i]] = (R_sum[flat.contest_idx[i]] + R[i]) % q
+            V_sum[flat.contest_idx[i]] += flat.votes[i]
+        U2 = [hash_elems(g, seed, "contest-u", ci,
+                         valid[row[0]].ballot_id).value
+              for ci, row in enumerate(contest_rows)]
+        RS_l = ee.to_limbs(R_sum)
+        U2_l = ee.to_limbs(U2)
+        VS_l = ee.to_limbs(V_sum)
+        g_exps2 = np.concatenate([RS_l, U2_l, VS_l])
+        k_exps2 = np.concatenate([RS_l, U2_l])
+        g_pows2 = np.asarray(eo.g_pow(g_exps2))
+        k_pows2 = np.asarray(eo.base_pow(self.K.value, k_exps2))
+        A_c = g_pows2[:C]
+        a_c = g_pows2[C:2 * C]
+        gV = g_pows2[2 * C:]
+        BK_c = k_pows2[:C]
+        b_c = k_pows2[C:2 * C]
+        B_c = np.asarray(eo.mulmod(gV, BK_c))
+
+        A_b = limbs_to_bytes_be(A_c)
+        B_b = limbs_to_bytes_be(B_c)
+        a_cb = limbs_to_bytes_be(a_c)
+        b_cb = limbs_to_bytes_be(b_c)
+        C2 = np.empty(C, dtype=object)
+        for ci, row in enumerate(contest_rows):
+            C2[ci] = _hash_constant(g, self.qbar, row[4], A_b[ci], B_b[ci],
+                                    a_cb[ci], b_cb[ci])
+        C2_l = ee.to_limbs(C2)
+        V2_l = np.asarray(ee.a_minus_bc(U2_l, C2_l, RS_l))
+
+        # ---- materialize ballots ---------------------------------------
+        alpha_i = self.ops.from_limbs(alpha)
+        beta_i = self.ops.from_limbs(beta)
+        A_i = self.ops.from_limbs(A_c)
+        B_i = self.ops.from_limbs(B_c)
+        CR = ee.from_limbs(CR_l)
+        VR = ee.from_limbs(VR_l)
+        CF_i = [int(x) for x in CF]
+        VF_i = [int(x) for x in VF]
+        C2_i = [int(x) for x in C2]
+        V2 = ee.from_limbs(V2_l)
+
+        sel_by_contest: dict[int, list[EncryptedSelection]] = {}
+        for i in range(S):
+            ct = ElGamalCiphertext(ElementModP(alpha_i[i], g),
+                                   ElementModP(beta_i[i], g))
+            if votes[i] == 0:
+                proof = DisjunctiveChaumPedersenProof(
+                    g.int_to_q(CR[i]), g.int_to_q(VR[i]),
+                    g.int_to_q(CF_i[i]), g.int_to_q(VF_i[i]))
+            else:
+                proof = DisjunctiveChaumPedersenProof(
+                    g.int_to_q(CF_i[i]), g.int_to_q(VF_i[i]),
+                    g.int_to_q(CR[i]), g.int_to_q(VR[i]))
+            sel = EncryptedSelection(
+                flat.selection_ids[i], flat.sequence_orders[i], ct, proof,
+                flat.is_placeholder[i])
+            sel_by_contest.setdefault(flat.contest_idx[i], []).append(sel)
+
+        contests_by_ballot: dict[int, list[EncryptedContest]] = {}
+        for ci, row in enumerate(contest_rows):
+            bi, _, contest_id, seq, limit = row
+            proof = ConstantChaumPedersenProof(
+                g.int_to_q(C2_i[ci]), g.int_to_q(V2[ci]), limit)
+            contests_by_ballot.setdefault(bi, []).append(
+                EncryptedContest(contest_id, seq,
+                                 tuple(sel_by_contest[ci]), proof))
+
+        out: list[EncryptedBallot] = []
+        prev_code = code_seed
+        timestamp = int(time.time())
+        for bi, b in enumerate(valid):
+            contests = tuple(contests_by_ballot.get(bi, []))
+            partial = EncryptedBallot(
+                b.ballot_id, b.ballot_style_id, self.init.manifest_hash,
+                prev_code, b"", timestamp, contests, BallotState.CAST)
+            code = EncryptedBallot.make_code(prev_code, timestamp,
+                                             partial.crypto_hash())
+            out.append(EncryptedBallot(
+                b.ballot_id, b.ballot_style_id, self.init.manifest_hash,
+                prev_code, code, timestamp, contests, BallotState.CAST))
+            prev_code = code
+        return out, invalid
+
+
+def _hash_disjunctive(g, qbar, alpha_b, beta_b, a0, b0, a1, b1) -> int:
+    """Challenge c = H(Q̄, α, β, a0, b0, a1, b1) over byte images; must match
+    DisjunctiveChaumPedersenProof.is_valid's hash_elems call exactly."""
+    return hash_elems(g, qbar, *(g.bytes_to_p(bytes(x)) for x in
+                                 (alpha_b, beta_b, a0, b0, a1, b1))).value
+
+
+def _hash_constant(g, qbar, constant, A_b, B_b, a_b, b_b) -> int:
+    return hash_elems(g, qbar, constant,
+                      *(g.bytes_to_p(bytes(x)) for x in
+                        (A_b, B_b, a_b, b_b))).value

@@ -1,0 +1,139 @@
+"""The key ceremony exchange: round-robin over all trustee pairs.
+
+Native replacement for the reference's [ext] ``keyCeremonyExchange`` +
+``KeyCeremonyResults`` (call site:
+src/main/java/electionguard/keyceremony/RunRemoteKeyCeremony.java:206,224-228).
+Drives any mix of in-process trustees and remote proxies through the
+``KeyCeremonyTrusteeIF`` surface — O(n²) pairwise exchange, exactly the
+traffic pattern of SURVEY.md §3.1.
+
+Beyond the reference, a failed share verification triggers the challenge
+path (plaintext coordinate revealed and publicly checked against the
+commitments) instead of aborting outright — the reference defines these
+messages but never wires them (keyceremony_trustee_rpc.proto:52-62).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Union
+
+from electionguard_tpu.core.group import ElementModP, GroupContext
+from electionguard_tpu.core.hash import hash_elems
+from electionguard_tpu.keyceremony.interface import (KeyCeremonyTrusteeIF,
+                                                     KeyShareChallengeResponse,
+                                                     PublicKeys, Result,
+                                                     SecretKeyShare)
+from electionguard_tpu.keyceremony.trustee import commitment_product
+from electionguard_tpu.publish.election_record import (ElectionConfig,
+                                                       ElectionInitialized,
+                                                       GuardianRecord)
+
+
+@dataclass
+class KeyCeremonyResults:
+    public_keys: dict[str, PublicKeys]
+
+    @property
+    def joint_public_key(self) -> ElementModP:
+        """K = Π K_i0 mod p."""
+        keys = list(self.public_keys.values())
+        group = keys[0].election_public_key.group
+        return group.mult_p(*(k.election_public_key for k in keys))
+
+    def make_election_initialized(
+            self, config: ElectionConfig,
+            metadata: Optional[dict[str, str]] = None) -> ElectionInitialized:
+        """Mirror of KeyCeremonyResults.makeElectionInitialized(config, meta)
+        (reference: RunRemoteKeyCeremony.java:224-228)."""
+        group = self.joint_public_key.group
+        manifest_hash = config.manifest.crypto_hash()
+        crypto_base_hash = hash_elems(
+            group, group.p, group.q, group.g, config.n_guardians,
+            config.quorum, manifest_hash)
+        extended_base_hash = hash_elems(
+            group, crypto_base_hash, self.joint_public_key)
+        guardians = tuple(
+            GuardianRecord(
+                guardian_id=pk.guardian_id,
+                x_coordinate=pk.x_coordinate,
+                coefficient_commitments=pk.coefficient_commitments,
+                coefficient_proofs=pk.coefficient_proofs)
+            for pk in sorted(self.public_keys.values(),
+                             key=lambda p: p.x_coordinate))
+        return ElectionInitialized(
+            config=config,
+            joint_public_key=self.joint_public_key,
+            manifest_hash=manifest_hash,
+            crypto_base_hash=crypto_base_hash,
+            extended_base_hash=extended_base_hash,
+            guardians=guardians,
+            metadata=dict(metadata or {}),
+        )
+
+
+def key_ceremony_exchange(
+        trustees: Sequence[KeyCeremonyTrusteeIF],
+        group: GroupContext) -> Union[KeyCeremonyResults, Result]:
+    """Run the full pairwise ceremony; returns results or an Err Result."""
+    if len({t.id for t in trustees}) != len(trustees):
+        return Result.Err("duplicate trustee ids")
+    if len({t.x_coordinate for t in trustees}) != len(trustees):
+        return Result.Err("duplicate x coordinates")
+
+    # round 1: collect all public key sets
+    all_keys: dict[str, PublicKeys] = {}
+    for t in trustees:
+        keys = t.send_public_keys()
+        if isinstance(keys, Result):
+            return Result.Err(f"{t.id} sendPublicKeys: {keys.error}")
+        val = keys.validate()
+        if not val.ok:
+            return Result.Err(f"{t.id} public keys invalid: {val.error}")
+        all_keys[t.id] = keys
+
+    # round 2: distribute all key sets to all other trustees
+    for t in trustees:
+        for other_id, keys in all_keys.items():
+            if other_id == t.id:
+                continue
+            res = t.receive_public_keys(keys)
+            if not res.ok:
+                return Result.Err(
+                    f"{t.id} rejected keys of {other_id}: {res.error}")
+
+    # round 3: pairwise encrypted share exchange, with challenge fallback
+    for sender in trustees:
+        for receiver in trustees:
+            if sender.id == receiver.id:
+                continue
+            share = sender.send_secret_key_share(receiver.id)
+            if isinstance(share, Result):
+                return Result.Err(
+                    f"{sender.id} sendSecretKeyShare({receiver.id}): "
+                    f"{share.error}")
+            res = receiver.receive_secret_key_share(share)
+            if not res.ok:
+                # challenge path: sender must reveal the coordinate; everyone
+                # can check it against the public commitments.
+                challenge = sender.challenge_share(receiver.id)
+                if isinstance(challenge, Result):
+                    return Result.Err(
+                        f"{sender.id} failed challenge for {receiver.id}: "
+                        f"{challenge.error} (original: {res.error})")
+                expected = commitment_product(
+                    group, all_keys[sender.id].coefficient_commitments,
+                    receiver.x_coordinate)
+                if group.g_pow_p(challenge.coordinate) != expected:
+                    return Result.Err(
+                        f"challenge verification failed: {sender.id}'s "
+                        f"share for {receiver.id} does not match its "
+                        f"commitments (original: {res.error})")
+                # coordinate is publicly verified; receiver ingests it
+                accept = receiver.receive_challenged_share(challenge)
+                if not accept.ok:
+                    return Result.Err(
+                        f"{receiver.id} rejects {sender.id}'s challenged "
+                        f"share: {accept.error}")
+
+    return KeyCeremonyResults(all_keys)

@@ -1,0 +1,119 @@
+"""Key ceremony data types + the location-transparent trustee interface.
+
+The reference's key design move is that remote proxies implement the *same
+interface* as in-process trustees, so the ceremony algorithm cannot tell
+local from remote (``RemoteTrusteeProxy implements KeyCeremonyTrusteeIF`` —
+reference: src/main/java/electionguard/keyceremony/RemoteTrusteeProxy.java:28,
+interface surface :34-153).  We keep that move: ``KeyCeremonyTrusteeIF`` is
+implemented by ``KeyCeremonyTrustee`` (in-process) and by the gRPC proxy in
+``electionguard_tpu.remote``.
+
+Errors are values (``Result``) rather than exceptions, mirroring the
+reference's in-band error strings (src/main/proto/common_rpc.proto:10-12).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Protocol, Union
+
+from electionguard_tpu.core.group import ElementModP, ElementModQ
+from electionguard_tpu.crypto.hashed_elgamal import HashedElGamalCiphertext
+from electionguard_tpu.crypto.schnorr import SchnorrProof
+
+
+@dataclass(frozen=True)
+class Result:
+    """Ok/Err result carried in-band (common_rpc.proto ErrorResponse)."""
+
+    ok: bool
+    error: str = ""
+
+    @staticmethod
+    def Ok() -> "Result":
+        return Result(True)
+
+    @staticmethod
+    def Err(msg: str) -> "Result":
+        return Result(False, msg)
+
+
+@dataclass(frozen=True)
+class PublicKeys:
+    """A guardian's public commitments (PublicKeySet on the wire —
+    reference: src/main/proto/keyceremony_trustee_rpc.proto:22-28)."""
+
+    guardian_id: str
+    x_coordinate: int
+    coefficient_commitments: tuple[ElementModP, ...]  # K_ij = g^{a_ij}
+    coefficient_proofs: tuple[SchnorrProof, ...]
+
+    @property
+    def election_public_key(self) -> ElementModP:
+        return self.coefficient_commitments[0]
+
+    def validate(self) -> Result:
+        if not self.coefficient_commitments:
+            return Result.Err("no coefficient commitments")
+        if len(self.coefficient_commitments) != len(self.coefficient_proofs):
+            return Result.Err("commitment/proof count mismatch")
+        for j, (k, pr) in enumerate(zip(self.coefficient_commitments,
+                                        self.coefficient_proofs)):
+            if pr.public_key != k:
+                return Result.Err(f"proof {j} is not for commitment {j}")
+            if not k.is_valid_residue():
+                return Result.Err(f"commitment {j} not in subgroup")
+            if not pr.is_valid():
+                return Result.Err(f"Schnorr proof {j} invalid for "
+                                  f"{self.guardian_id}")
+        return Result.Ok()
+
+
+@dataclass(frozen=True)
+class SecretKeyShare:
+    """Encrypted share Eℓ(Pᵢ(ℓ)) (PartialKeyBackup on the wire —
+    reference: src/main/proto/keyceremony_trustee_rpc.proto:34-43)."""
+
+    generating_guardian_id: str
+    designated_guardian_id: str
+    designated_guardian_x: int
+    encrypted_coordinate: HashedElGamalCiphertext
+
+
+@dataclass(frozen=True)
+class KeyShareChallengeResponse:
+    """Plaintext Pᵢ(ℓ) revealed under challenge.
+
+    The reference *defines* the challenge messages but never wires them to
+    an rpc (keyceremony_trustee_rpc.proto:52-62, SURVEY.md §2 row 13); we
+    wire the full path.
+    """
+
+    generating_guardian_id: str
+    designated_guardian_id: str
+    coordinate: ElementModQ
+
+
+class KeyCeremonyTrusteeIF(Protocol):
+    """The surface ``keyCeremonyExchange`` drives (reference:
+    RemoteTrusteeProxy.java:34-153)."""
+
+    @property
+    def id(self) -> str: ...
+
+    @property
+    def x_coordinate(self) -> int: ...
+
+    def send_public_keys(self) -> Union[PublicKeys, Result]: ...
+
+    def receive_public_keys(self, keys: PublicKeys) -> Result: ...
+
+    def send_secret_key_share(self, other_id: str) -> Union[SecretKeyShare, Result]: ...
+
+    def receive_secret_key_share(self, share: SecretKeyShare) -> Result: ...
+
+    def challenge_share(self, challenger_id: str) -> Union[KeyShareChallengeResponse, Result]: ...
+
+    def receive_challenged_share(self, response: KeyShareChallengeResponse) -> Result: ...
+
+    def save_state(self, out_dir: str) -> Result: ...

@@ -1,0 +1,227 @@
+"""The key ceremony trustee: secret polynomial, commitments, share exchange.
+
+Native replacement for the reference's [ext] ``KeyCeremonyTrustee`` —
+constructed ``(group, id, xCoordinate, quorum)``
+(reference: src/main/java/electionguard/keyceremony/RunRemoteTrustee.java:184)
+and driven through the six trustee operations by the ceremony exchange.
+
+A guardian i holds a random degree-(k-1) polynomial
+``P_i(x) = Σ_j a_ij x^j mod q`` with public commitments ``K_ij = g^{a_ij}``
+and Schnorr proofs for each.  Its share for guardian ℓ is ``P_i(ℓ)``,
+encrypted to ℓ's election public key with hashed ElGamal (spec 1.03 eq 17
+shape — reference: src/main/proto/keyceremony_trustee_rpc.proto:34-43) and
+verified against the commitments: ``g^{P_i(ℓ)} == Π_j K_ij^{ℓ^j}``.
+
+Guardian secrets never leave this object except (a) encrypted shares and
+(b) the plaintext coordinate under an explicit challenge — preserving the
+reference's process-level trust boundary (SURVEY.md §7 hard part 5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Union
+
+from electionguard_tpu.core.group import (ElementModP, ElementModQ,
+                                          GroupContext)
+from electionguard_tpu.crypto.hashed_elgamal import (HashedElGamalCiphertext,
+                                                     hashed_elgamal_encrypt)
+from electionguard_tpu.crypto.schnorr import SchnorrProof, make_schnorr_proof
+from electionguard_tpu.keyceremony.interface import (KeyCeremonyTrusteeIF,
+                                                     KeyShareChallengeResponse,
+                                                     PublicKeys, Result,
+                                                     SecretKeyShare)
+
+
+def compute_polynomial(group: GroupContext, coefficients: list[ElementModQ],
+                       x: int) -> ElementModQ:
+    """P(x) = Σ a_j x^j mod q (Horner)."""
+    acc = 0
+    for a in reversed(coefficients):
+        acc = (acc * x + a.value) % group.q
+    return group.int_to_q(acc)
+
+
+def commitment_product(group: GroupContext,
+                       commitments: tuple[ElementModP, ...],
+                       x: int) -> ElementModP:
+    """g^{P(x)} from public commitments: Π_j K_j^{x^j} mod p."""
+    acc = 1
+    xj = 1
+    for k in commitments:
+        acc = acc * pow(k.value, xj, group.p) % group.p
+        xj = xj * x % group.q
+    return ElementModP(acc, group)
+
+
+class KeyCeremonyTrustee(KeyCeremonyTrusteeIF):
+    def __init__(self, group: GroupContext, guardian_id: str,
+                 x_coordinate: int, quorum: int,
+                 coefficients: Optional[list[ElementModQ]] = None):
+        if x_coordinate < 1:
+            raise ValueError("x coordinate must be >= 1")
+        if quorum < 1:
+            raise ValueError("quorum must be >= 1")
+        self.group = group
+        self._id = guardian_id
+        self._x = x_coordinate
+        self.quorum = quorum
+        # secret polynomial coefficients a_0 .. a_{k-1}
+        self._coefficients = (coefficients if coefficients is not None
+                              else [group.rand_q() for _ in range(quorum)])
+        if len(self._coefficients) != quorum:
+            raise ValueError("coefficient count must equal quorum")
+        self._commitments = tuple(
+            group.g_pow_p(a) for a in self._coefficients)
+        self._proofs = tuple(
+            make_schnorr_proof(group, a, k, group.rand_q())
+            for a, k in zip(self._coefficients, self._commitments))
+        # state accumulated during the ceremony
+        self.other_public_keys: dict[str, PublicKeys] = {}
+        self.received_shares: dict[str, ElementModQ] = {}  # P_i(self.x) by i
+        self._revealed_to: set[str] = set()  # challenge-reveal audit trail
+
+    # ------------------------------------------------------------------
+    @property
+    def id(self) -> str:
+        return self._id
+
+    @property
+    def x_coordinate(self) -> int:
+        return self._x
+
+    @property
+    def coefficient_commitments(self) -> tuple[ElementModP, ...]:
+        return self._commitments
+
+    @property
+    def election_public_key(self) -> ElementModP:
+        return self._commitments[0]
+
+    # ------------------------------------------------------------------
+    def send_public_keys(self) -> Union[PublicKeys, Result]:
+        return PublicKeys(self._id, self._x, self._commitments, self._proofs)
+
+    def receive_public_keys(self, keys: PublicKeys) -> Result:
+        if keys.guardian_id == self._id:
+            return Result.Err("guardian cannot receive its own keys")
+        res = keys.validate()
+        if not res.ok:
+            return res
+        if len(keys.coefficient_commitments) != self.quorum:
+            return Result.Err(
+                f"expected {self.quorum} commitments, "
+                f"got {len(keys.coefficient_commitments)}")
+        self.other_public_keys[keys.guardian_id] = keys
+        return Result.Ok()
+
+    def send_secret_key_share(self, other_id: str) -> Union[SecretKeyShare, Result]:
+        keys = self.other_public_keys.get(other_id)
+        if keys is None:
+            return Result.Err(f"no public keys for {other_id}")
+        coordinate = compute_polynomial(self.group, self._coefficients,
+                                        keys.x_coordinate)
+        ctx = f"{self._id}->{other_id}".encode()
+        enc = hashed_elgamal_encrypt(
+            self.group, coordinate.to_bytes(), self.group.rand_q(),
+            keys.election_public_key, ctx)
+        return SecretKeyShare(self._id, other_id, keys.x_coordinate, enc)
+
+    def receive_secret_key_share(self, share: SecretKeyShare) -> Result:
+        if share.designated_guardian_id != self._id:
+            return Result.Err("share not addressed to this guardian")
+        gen = self.other_public_keys.get(share.generating_guardian_id)
+        if gen is None:
+            return Result.Err(
+                f"no public keys for {share.generating_guardian_id}")
+        ctx = f"{share.generating_guardian_id}->{self._id}".encode()
+        data = share.encrypted_coordinate.decrypt(self._coefficients[0], ctx)
+        if data is None:
+            return Result.Err("share decryption failed (bad MAC)")
+        coordinate = self.group.bytes_to_q(data)
+        # verify against commitments: g^{P_i(ℓ)} == Π_j K_ij^{ℓ^j}
+        expected = commitment_product(self.group,
+                                      gen.coefficient_commitments, self._x)
+        if self.group.g_pow_p(coordinate) != expected:
+            return Result.Err(
+                f"share from {share.generating_guardian_id} fails "
+                f"commitment check")
+        self.received_shares[share.generating_guardian_id] = coordinate
+        return Result.Ok()
+
+    def challenge_share(self, challenger_id: str) -> Union[KeyShareChallengeResponse, Result]:
+        """Reveal P_self(challenger) in the clear (challenge path the
+        reference left unwired — keyceremony_trustee_rpc.proto:52-62).
+
+        Each reveal publishes one point of the secret polynomial (the point
+        the challenger legitimately owns anyway), but quorum-many distinct
+        reveals would reconstruct the secret — so a trustee answers at most
+        ONE challenge per ceremony; a ceremony with more disputes must abort
+        and re-key with a fresh polynomial.
+        """
+        keys = self.other_public_keys.get(challenger_id)
+        if keys is None:
+            return Result.Err(f"no public keys for {challenger_id}")
+        if self._revealed_to and challenger_id not in self._revealed_to:
+            return Result.Err(
+                "refusing second challenge reveal: restart the ceremony "
+                "with a fresh polynomial")
+        self._revealed_to.add(challenger_id)
+        coordinate = compute_polynomial(self.group, self._coefficients,
+                                        keys.x_coordinate)
+        return KeyShareChallengeResponse(self._id, challenger_id, coordinate)
+
+    def receive_challenged_share(self, response: KeyShareChallengeResponse) -> Result:
+        """Accept a plaintext coordinate revealed under challenge, after
+        verifying it against the generator's public commitments."""
+        if response.designated_guardian_id != self._id:
+            return Result.Err("challenged share not addressed to this guardian")
+        gen = self.other_public_keys.get(response.generating_guardian_id)
+        if gen is None:
+            return Result.Err(
+                f"no public keys for {response.generating_guardian_id}")
+        expected = commitment_product(self.group,
+                                      gen.coefficient_commitments, self._x)
+        if self.group.g_pow_p(response.coordinate) != expected:
+            return Result.Err("challenged coordinate fails commitment check")
+        self.received_shares[response.generating_guardian_id] = \
+            response.coordinate
+        return Result.Ok()
+
+    # ------------------------------------------------------------------
+    # post-ceremony: the trustee's decryption state
+    # ------------------------------------------------------------------
+    def secret_key_share_sum(self) -> ElementModQ:
+        """s_ℓ = P_ℓ(ℓ) + Σ_{i≠ℓ} P_i(ℓ) mod q (full share of the joint key
+        evaluated at this x — used for share-based decryption paths)."""
+        own = compute_polynomial(self.group, self._coefficients, self._x)
+        return self.group.add_q(own, *self.received_shares.values())
+
+    def decrypting_trustee_state(self) -> dict:
+        """Private state persisted by saveState and reloaded by the
+        decrypting trustee binary (reference: RunRemoteTrustee.java:329
+        publisher.writeTrustee -> RunRemoteDecryptingTrustee.java:90
+        readTrustee)."""
+        return {
+            "guardian_id": self._id,
+            "x_coordinate": self._x,
+            "quorum": self.quorum,
+            "secret_key": self._coefficients[0].value,
+            "received_shares": {
+                gid: q.value for gid, q in self.received_shares.items()},
+            "public_commitments": {
+                gid: [k.value for k in pk.coefficient_commitments]
+                for gid, pk in self.other_public_keys.items()},
+            "own_commitments": [k.value for k in self._commitments],
+        }
+
+    def save_state(self, out_dir: str) -> Result:
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            path = os.path.join(out_dir, f"trustee-{self._id}.json")
+            with open(path, "w") as f:
+                json.dump(self.decrypting_trustee_state(), f)
+            return Result.Ok()
+        except OSError as e:
+            return Result.Err(f"save_state failed: {e}")

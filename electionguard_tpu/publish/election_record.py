@@ -1,0 +1,107 @@
+"""Election record data model: config, initialization, tally/decryption
+results.
+
+Native replacement for the reference's [ext] record types
+(``ElectionInitialized``, ``TallyResult``, ``DecryptionResult``,
+``DecryptingGuardian`` — imported at RunRemoteDecryptor.java:9-21, published
+at RunRemoteKeyCeremony.java:224-228 and RunRemoteDecryptor.java:306-321).
+The record directory layout and (de)serialization live in
+``electionguard_tpu.publish.publisher``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from electionguard_tpu.ballot.manifest import Manifest
+from electionguard_tpu.ballot.tally import EncryptedTally, PlaintextTally
+from electionguard_tpu.core.group import ElementModP, ElementModQ
+from electionguard_tpu.crypto.schnorr import SchnorrProof
+
+
+@dataclass(frozen=True)
+class ElectionConfig:
+    """Manifest + ceremony parameters (what the key ceremony consumes)."""
+
+    manifest: Manifest
+    n_guardians: int
+    quorum: int
+
+    def __post_init__(self):
+        if not (1 <= self.quorum <= self.n_guardians):
+            raise ValueError("require 1 <= quorum <= n_guardians")
+
+
+@dataclass(frozen=True)
+class GuardianRecord:
+    """Public record of one guardian (commitments + proofs)."""
+
+    guardian_id: str
+    x_coordinate: int
+    coefficient_commitments: tuple[ElementModP, ...]
+    coefficient_proofs: tuple[SchnorrProof, ...]
+
+
+@dataclass(frozen=True)
+class ElectionInitialized:
+    """Published after the key ceremony
+    (reference: RunRemoteKeyCeremony.java:224-228)."""
+
+    config: ElectionConfig
+    joint_public_key: ElementModP       # K = Π K_i0
+    manifest_hash: bytes
+    crypto_base_hash: ElementModQ       # Q
+    extended_base_hash: ElementModQ     # Q̄ = H(Q, K)
+    guardians: tuple[GuardianRecord, ...]
+    metadata: dict[str, str] = field(default_factory=dict)
+
+    def guardian(self, guardian_id: str) -> Optional[GuardianRecord]:
+        for g in self.guardians:
+            if g.guardian_id == guardian_id:
+                return g
+        return None
+
+
+@dataclass(frozen=True)
+class TallyResult:
+    """Encrypted tally + the initialization it was accumulated under."""
+
+    election_init: ElectionInitialized
+    encrypted_tally: EncryptedTally
+    tally_ids: tuple[str, ...] = ()
+    metadata: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class DecryptingGuardian:
+    """A guardian that participated in decryption, with its Lagrange
+    coefficient (reference [ext] ``DecryptingGuardian``,
+    RunRemoteDecryptor.java:296-304)."""
+
+    guardian_id: str
+    x_coordinate: int
+    lagrange_coefficient: ElementModQ
+
+
+@dataclass(frozen=True)
+class DecryptionResult:
+    """Published after decryption
+    (reference: RunRemoteDecryptor.java:306-321)."""
+
+    tally_result: TallyResult
+    decrypted_tally: PlaintextTally
+    decrypting_guardians: tuple[DecryptingGuardian, ...]
+    metadata: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ElectionRecord:
+    """Everything a phase reads/writes: the record directory *is* the
+    checkpoint system (SURVEY.md §5.4).  Later phases may be None."""
+
+    election_init: ElectionInitialized
+    encrypted_ballots: list = field(default_factory=list)
+    tally_result: Optional[TallyResult] = None
+    decryption_result: Optional[DecryptionResult] = None
+    spoiled_ballot_tallies: list = field(default_factory=list)

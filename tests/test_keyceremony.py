@@ -1,0 +1,265 @@
+"""Key ceremony + threshold decryption tests (tiny group, in-process).
+
+Covers the full trust protocol the reference drives over gRPC
+(SURVEY.md §3.1/§3.2), including the compensated-decryption quorum path and
+the challenge path the reference never wired.
+"""
+
+import json
+
+import pytest
+
+from electionguard_tpu.ballot.manifest import (BallotStyle, Candidate,
+                                               ContestDescription,
+                                               GeopoliticalUnit, Manifest,
+                                               Party, SelectionDescription)
+from electionguard_tpu.ballot.tally import (EncryptedTally,
+                                            EncryptedTallyContest,
+                                            EncryptedTallySelection)
+from electionguard_tpu.core.dlog import DLog
+from electionguard_tpu.crypto.elgamal import elgamal_accumulate, elgamal_encrypt
+from electionguard_tpu.decrypt.decryption import (Decryption, DecryptionError,
+                                                  lagrange_coefficient)
+from electionguard_tpu.decrypt.trustee import DecryptingTrustee, read_trustee
+from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+from electionguard_tpu.keyceremony.interface import Result, SecretKeyShare
+from electionguard_tpu.keyceremony.trustee import (KeyCeremonyTrustee,
+                                                   commitment_product,
+                                                   compute_polynomial)
+from electionguard_tpu.publish.election_record import ElectionConfig
+
+
+def tiny_manifest() -> Manifest:
+    sels = tuple(SelectionDescription(f"sel-{i}", i, f"cand-{i}")
+                 for i in range(2))
+    contest = ContestDescription("contest-0", 0, "gp-0", "one_of_m", 1,
+                                 "The Contest", sels)
+    return Manifest(
+        election_scope_id="test-election", spec_version="tpu-1.0",
+        start_date="2026-07-01", end_date="2026-07-29",
+        geopolitical_units=(GeopoliticalUnit("gp-0", "District 0"),),
+        parties=(Party("party-0", "Party"),),
+        candidates=tuple(Candidate(f"cand-{i}", f"Candidate {i}")
+                         for i in range(2)),
+        contests=(contest,),
+        ballot_styles=(BallotStyle("style-0", ("gp-0",)),),
+    )
+
+
+def run_ceremony(group, n=5, k=3):
+    trustees = [KeyCeremonyTrustee(group, f"guardian-{i}", i + 1, k)
+                for i in range(n)]
+    results = key_ceremony_exchange(trustees, group)
+    assert not isinstance(results, Result), results
+    return trustees, results
+
+
+def test_polynomial_and_commitments(tgroup):
+    g = tgroup
+    t = KeyCeremonyTrustee(g, "g1", 1, 3)
+    for x in (1, 2, 7):
+        px = compute_polynomial(g, t._coefficients, x)
+        assert g.g_pow_p(px) == commitment_product(
+            g, t.coefficient_commitments, x)
+
+
+def test_ceremony_joint_key(tgroup):
+    g = tgroup
+    trustees, results = run_ceremony(g)
+    # K = g^{Σ a_i0}
+    secret_sum = g.add_q(*(t._coefficients[0] for t in trustees))
+    assert results.joint_public_key == g.g_pow_p(secret_sum)
+    # every trustee received n-1 verified shares
+    for t in trustees:
+        assert len(t.received_shares) == 4
+
+
+def test_election_initialized(tgroup):
+    g = tgroup
+    _, results = run_ceremony(g, 3, 2)
+    config = ElectionConfig(tiny_manifest(), 3, 2)
+    init = results.make_election_initialized(config, {"by": "test"})
+    assert init.joint_public_key == results.joint_public_key
+    assert len(init.guardians) == 3
+    assert [gr.x_coordinate for gr in init.guardians] == [1, 2, 3]
+    assert init.crypto_base_hash != init.extended_base_hash
+    assert init.guardian("guardian-1") is not None
+    assert init.guardian("nope") is None
+
+
+def test_duplicate_ids_rejected(tgroup):
+    g = tgroup
+    t1 = KeyCeremonyTrustee(g, "same", 1, 2)
+    t2 = KeyCeremonyTrustee(g, "same", 2, 2)
+    res = key_ceremony_exchange([t1, t2], g)
+    assert isinstance(res, Result) and not res.ok
+
+
+def test_corrupt_share_challenge_path(tgroup):
+    """A share corrupted in transport triggers the challenge path; the
+    honest sender's revealed coordinate passes the commitment check and the
+    ceremony completes."""
+    g = tgroup
+
+    class FlakyTrustee(KeyCeremonyTrustee):
+        def send_secret_key_share(self, other_id):
+            share = super().send_secret_key_share(other_id)
+            if other_id == "guardian-1":  # corrupt one edge
+                bad = bytes(b ^ 0xFF for b in share.encrypted_coordinate.c1)
+                from electionguard_tpu.crypto.hashed_elgamal import \
+                    HashedElGamalCiphertext
+                share = SecretKeyShare(
+                    share.generating_guardian_id,
+                    share.designated_guardian_id,
+                    share.designated_guardian_x,
+                    HashedElGamalCiphertext(
+                        share.encrypted_coordinate.c0, bad,
+                        share.encrypted_coordinate.c2,
+                        share.encrypted_coordinate.num_bytes))
+            return share
+
+    trustees = [FlakyTrustee(g, "guardian-0", 1, 2),
+                KeyCeremonyTrustee(g, "guardian-1", 2, 2),
+                KeyCeremonyTrustee(g, "guardian-2", 3, 2)]
+    results = key_ceremony_exchange(trustees, g)
+    assert not isinstance(results, Result), results
+    assert len(trustees[1].received_shares) == 2
+
+
+def test_lying_trustee_detected(tgroup):
+    """A trustee whose polynomial doesn't match its commitments is caught
+    at challenge verification."""
+    g = tgroup
+
+    class LyingTrustee(KeyCeremonyTrustee):
+        def send_secret_key_share(self, other_id):
+            share = super().send_secret_key_share(other_id)
+            if other_id == "guardian-1":
+                keys = self.other_public_keys[other_id]
+                from electionguard_tpu.crypto.hashed_elgamal import \
+                    hashed_elgamal_encrypt
+                wrong = self.group.int_to_q(12345)
+                enc = hashed_elgamal_encrypt(
+                    self.group, wrong.to_bytes(), self.group.rand_q(),
+                    keys.election_public_key,
+                    f"{self.id}->{other_id}".encode())
+                share = SecretKeyShare(self.id, other_id,
+                                       keys.x_coordinate, enc)
+            return share
+
+        def challenge_share(self, challenger_id):
+            # keeps lying under challenge
+            from electionguard_tpu.keyceremony.interface import \
+                KeyShareChallengeResponse
+            return KeyShareChallengeResponse(
+                self.id, challenger_id, self.group.int_to_q(12345))
+
+    trustees = [LyingTrustee(g, "guardian-0", 1, 2),
+                KeyCeremonyTrustee(g, "guardian-1", 2, 2),
+                KeyCeremonyTrustee(g, "guardian-2", 3, 2)]
+    res = key_ceremony_exchange(trustees, g)
+    assert isinstance(res, Result) and not res.ok
+    assert "challenge verification failed" in res.error
+
+
+# ---------------------------------------------------------------------------
+# threshold decryption
+# ---------------------------------------------------------------------------
+
+def make_tally(group, public_key, votes):
+    """Encrypt per-selection vote counts as a 1-contest tally."""
+    cts = []
+    for i, v in enumerate(votes):
+        parts = [elgamal_encrypt(group, 1 if j < v else 0, group.rand_q(),
+                                 public_key) for j in range(max(votes))]
+        cts.append(elgamal_accumulate(parts) if parts else None)
+    sels = tuple(
+        EncryptedTallySelection(f"sel-{i}", i, ct)
+        for i, ct in enumerate(cts))
+    return EncryptedTally(
+        "tally-0", (EncryptedTallyContest("contest-0", 0, sels),),
+        cast_ballot_count=sum(votes))
+
+
+def setup_election(tgroup, n=5, k=3):
+    trustees, results = run_ceremony(tgroup, n, k)
+    config = ElectionConfig(tiny_manifest(), n, k)
+    init = results.make_election_initialized(config)
+    dec_trustees = [
+        DecryptingTrustee.from_state(
+            tgroup, t.decrypting_trustee_state())
+        for t in trustees]
+    return trustees, dec_trustees, init
+
+
+def test_direct_decryption_all_available(tgroup):
+    g = tgroup
+    _, dec, init = setup_election(g)
+    tally = make_tally(g, init.joint_public_key, [7, 3])
+    d = Decryption(g, init, dec, [], DLog(g, max_exponent=100))
+    out = d.decrypt(tally)
+    got = [s.tally for s in out.contests[0].selections]
+    assert got == [7, 3]
+    assert all(len(s.shares) == 5 for s in out.contests[0].selections)
+
+
+@pytest.mark.parametrize("missing_idx", [[0], [0, 4], [1, 3]])
+def test_compensated_decryption(tgroup, missing_idx):
+    g = tgroup
+    _, dec, init = setup_election(g, 5, 3)
+    tally = make_tally(g, init.joint_public_key, [4, 9])
+    missing = [dec[i].id for i in missing_idx]
+    avail = [t for i, t in enumerate(dec) if i not in missing_idx]
+    d = Decryption(g, init, avail, missing, DLog(g, max_exponent=100))
+    out = d.decrypt(tally)
+    got = [s.tally for s in out.contests[0].selections]
+    assert got == [4, 9]
+    # missing guardians appear as reconstructed shares
+    for s in out.contests[0].selections:
+        ids = {sh.guardian_id for sh in s.shares}
+        assert set(missing) <= ids
+
+
+def test_quorum_enforced(tgroup):
+    g = tgroup
+    _, dec, init = setup_election(g, 5, 3)
+    with pytest.raises(DecryptionError, match="quorum"):
+        Decryption(g, init, dec[:2], [t.id for t in dec[2:]])
+
+
+def test_lagrange_interpolation(tgroup):
+    """Σ w_ℓ P(x_ℓ) == P(0) for any polynomial of degree < #points."""
+    g = tgroup
+    coeffs = [g.rand_q() for _ in range(3)]
+    xs = [1, 3, 7]
+    total = 0
+    for x in xs:
+        w = lagrange_coefficient(g, xs, x)
+        px = compute_polynomial(g, coeffs, x)
+        total = (total + w.value * px.value) % g.q
+    assert total == coeffs[0].value
+
+
+def test_trustee_file_roundtrip(tgroup, tmp_path):
+    g = tgroup
+    trustees, _ = run_ceremony(g, 3, 2)
+    res = trustees[0].save_state(str(tmp_path))
+    assert res.ok
+    loaded = read_trustee(g, str(tmp_path / "trustee-guardian-0.json"))
+    assert loaded.id == "guardian-0"
+    assert loaded.x_coordinate == 1
+    assert loaded.election_public_key == trustees[0].election_public_key
+    assert set(loaded._received_shares) == set(trustees[0].received_shares)
+
+
+def test_available_guardians_record(tgroup):
+    g = tgroup
+    _, dec, init = setup_election(g, 4, 2)
+    d = Decryption(g, init, dec[:2], [t.id for t in dec[2:]],
+                   DLog(g, max_exponent=10))
+    ags = d.get_available_guardians()
+    assert len(ags) == 2
+    xs = [t.x_coordinate for t in dec[:2]]
+    for ag in ags:
+        assert ag.lagrange_coefficient == lagrange_coefficient(
+            g, xs, ag.x_coordinate)

@@ -1,0 +1,183 @@
+"""In-process 5-phase workflow E2E on the tiny group: ceremony → batch
+encrypt → accumulate → threshold decrypt → full verify.
+
+This is the de-facto ``train()`` of the framework (SURVEY.md §3.4) minus the
+process boundaries, on fast parameters.  The batch (device) encryption
+pipeline must produce proofs that the *scalar* verifiers accept, and the
+full Verifier must pass end-to-end — the hash-seam compatibility test.
+"""
+
+import pytest
+
+from electionguard_tpu.ballot.ciphertext import BallotState
+from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+from electionguard_tpu.core.dlog import DLog
+from electionguard_tpu.decrypt.decryption import Decryption
+from electionguard_tpu.decrypt.trustee import DecryptingTrustee
+from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+from electionguard_tpu.publish.election_record import (DecryptionResult,
+                                                       ElectionConfig,
+                                                       ElectionRecord)
+from electionguard_tpu.tally.accumulate import accumulate_ballots
+from electionguard_tpu.verify.verifier import Verifier
+from tests.test_keyceremony import tiny_manifest
+
+
+@pytest.fixture(scope="module")
+def election(request):
+    """Full workflow artifacts on the tiny group, 3 guardians quorum 2."""
+    from electionguard_tpu.core.group import tiny_group
+    g = tiny_group()
+    manifest = tiny_manifest()
+    trustees = [KeyCeremonyTrustee(g, f"guardian-{i}", i + 1, 2)
+                for i in range(3)]
+    results = key_ceremony_exchange(trustees, g)
+    init = results.make_election_initialized(
+        ElectionConfig(manifest, 3, 2), {"created_by": "test"})
+
+    ballots = list(RandomBallotProvider(manifest, 20, seed=7).ballots())
+    enc = BatchEncryptor(init, g)
+    encrypted, invalid = enc.encrypt_ballots(ballots, seed=g.int_to_q(99))
+    assert not invalid
+
+    tally_result = accumulate_ballots(init, encrypted)
+
+    dec_trustees = [DecryptingTrustee.from_state(
+        g, t.decrypting_trustee_state()) for t in trustees]
+    decryption = Decryption(g, init, dec_trustees[:2],
+                            [dec_trustees[2].id], DLog(g, max_exponent=100))
+    decrypted = decryption.decrypt(tally_result.encrypted_tally)
+    dr = DecryptionResult(
+        tally_result, decrypted,
+        tuple(decryption.get_available_guardians()))
+    return dict(group=g, manifest=manifest, init=init, ballots=ballots,
+                encrypted=encrypted, tally_result=tally_result,
+                decryption_result=dr, trustees=trustees)
+
+
+def test_encryption_shapes(election):
+    encrypted = election["encrypted"]
+    assert len(encrypted) == 20
+    for b in encrypted:
+        assert len(b.contests) == 1
+        c = b.contests[0]
+        # 2 real + 1 placeholder (votes_allowed=1)
+        assert len(c.selections) == 3
+        assert sum(s.is_placeholder for s in c.selections) == 1
+
+
+def test_scalar_proof_compat(election):
+    """Device-generated proofs verify with the scalar is_valid path."""
+    g, init = election["group"], election["init"]
+    qbar = init.extended_base_hash
+    K = init.joint_public_key
+    b = election["encrypted"][0]
+    c = b.contests[0]
+    for s in c.selections:
+        assert s.proof.is_valid(s.ciphertext, K, qbar), s.selection_id
+    assert c.proof.is_valid(c.accumulation(), K, qbar)
+
+
+def test_ballot_codes_chain(election):
+    encrypted = election["encrypted"]
+    assert all(b.is_valid_code() for b in encrypted)
+    for prev, cur in zip(encrypted, encrypted[1:]):
+        assert cur.code_seed == prev.code
+
+
+def test_tally_matches_plaintext(election):
+    """Decrypted tally equals the plaintext vote sums."""
+    want = {}
+    for pb in election["ballots"]:
+        for c in pb.contests:
+            for s in c.selections:
+                want[(c.contest_id, s.selection_id)] = \
+                    want.get((c.contest_id, s.selection_id), 0) + s.vote
+    decrypted = election["decryption_result"].decrypted_tally
+    got = {(c.contest_id, s.selection_id): s.tally
+           for c in decrypted.contests for s in c.selections}
+    assert got == want
+
+
+def test_full_verifier_passes(election):
+    record = ElectionRecord(
+        election_init=election["init"],
+        encrypted_ballots=election["encrypted"],
+        tally_result=election["tally_result"],
+        decryption_result=election["decryption_result"])
+    res = Verifier(record, election["group"]).verify()
+    assert res.ok, res.summary()
+    assert len(res.checks) >= 12
+
+
+def test_verifier_catches_tampered_ballot(election):
+    import dataclasses
+    record = ElectionRecord(
+        election_init=election["init"],
+        encrypted_ballots=list(election["encrypted"]),
+        tally_result=election["tally_result"],
+        decryption_result=election["decryption_result"])
+    # swap two selections' ciphertexts inside a ballot (proofs now mismatch)
+    b = record.encrypted_ballots[3]
+    c = b.contests[0]
+    s0, s1 = c.selections[0], c.selections[1]
+    tampered_sels = (
+        dataclasses.replace(s0, ciphertext=s1.ciphertext),
+        dataclasses.replace(s1, ciphertext=s0.ciphertext),
+        c.selections[2])
+    tampered = dataclasses.replace(
+        b, contests=(dataclasses.replace(c, selections=tampered_sels),))
+    record.encrypted_ballots[3] = tampered
+    res = Verifier(record, election["group"]).verify()
+    assert not res.ok
+    assert not res.checks["V4.selection_proofs"]
+
+
+def test_verifier_catches_tally_tamper(election):
+    import dataclasses
+    tr = election["tally_result"]
+    g = election["group"]
+    t = tr.encrypted_tally
+    c0 = t.contests[0]
+    s0 = c0.selections[0]
+    from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
+    bad_ct = ElGamalCiphertext(s0.ciphertext.pad,
+                               g.mult_p(s0.ciphertext.data, g.G_MOD_P))
+    bad_tally = dataclasses.replace(
+        t, contests=(dataclasses.replace(
+            c0, selections=(dataclasses.replace(s0, ciphertext=bad_ct),)
+            + c0.selections[1:]),))
+    record = ElectionRecord(
+        election_init=election["init"],
+        encrypted_ballots=election["encrypted"],
+        tally_result=dataclasses.replace(tr, encrypted_tally=bad_tally))
+    res = Verifier(record, election["group"]).verify()
+    assert not res.ok
+    assert not res.checks["V7.aggregation"]
+
+
+def test_verifier_catches_placeholder_flip(election):
+    """Flipping is_placeholder on a real vote-1 selection must fail
+    verification (it would silently delete the vote from the tally)."""
+    import dataclasses
+    record = ElectionRecord(
+        election_init=election["init"],
+        encrypted_ballots=list(election["encrypted"]),
+        tally_result=election["tally_result"],
+        decryption_result=election["decryption_result"])
+    b = record.encrypted_ballots[0]
+    c = b.contests[0]
+    real = next(s for s in c.selections if not s.is_placeholder)
+    flipped_sels = tuple(
+        dataclasses.replace(s, is_placeholder=True) if s is real else s
+        for s in c.selections)
+    tampered = dataclasses.replace(
+        b, contests=(dataclasses.replace(c, selections=flipped_sels),))
+    record.encrypted_ballots[0] = tampered
+    res = Verifier(record, election["group"]).verify()
+    assert not res.ok
+    # caught by the id/flag consistency check and/or the broken ballot code
+    assert (not res.checks["V4.selection_proofs"]
+            or not res.checks["V6.ballot_chaining"])
