@@ -1,0 +1,64 @@
+"""Shared CLI plumbing: flags, logging, manifest loading.
+
+The reference uses JCommander @Parameter flags per binary (SURVEY.md §5.6);
+we mirror the flag names (-in, -out, -nguardians, ...) with argparse.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+from electionguard_tpu.ballot.manifest import Manifest, validate_manifest
+from electionguard_tpu.core.group import GroupContext, production_group, tiny_group
+
+
+def setup_logging(name: str) -> logging.Logger:
+    logging.basicConfig(
+        level=os.environ.get("EGTPU_LOG", "INFO"),
+        format="%(asctime)s [%(levelname)s] %(name)s: %(message)s",
+        stream=sys.stdout)
+    return logging.getLogger(name)
+
+
+def add_group_flag(ap: argparse.ArgumentParser):
+    ap.add_argument("-group", choices=["production", "tiny"],
+                    default="production",
+                    help="group context (tiny = fast 64-bit test group)")
+
+
+def resolve_group(args) -> GroupContext:
+    return tiny_group() if args.group == "tiny" else production_group()
+
+
+def load_manifest(input_dir: str) -> Manifest:
+    path = os.path.join(input_dir, "manifest.json")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no manifest.json in {input_dir}")
+    with open(path) as f:
+        manifest = Manifest.from_json(f.read())
+    msgs = validate_manifest(manifest)
+    if msgs.has_errors():
+        # fail fast before any ceremony starts, like the reference
+        # (RunRemoteKeyCeremony.java:107-112)
+        raise ValueError(f"manifest validation failed:\n{msgs}")
+    return manifest
+
+
+class Stopwatch:
+    """Per-phase wall-clock timing, mirroring the reference's Guava
+    Stopwatch prints (SURVEY.md §5.1)."""
+
+    def __init__(self):
+        self.t0 = time.time()
+
+    def elapsed(self) -> float:
+        return time.time() - self.t0
+
+    def took(self, what: str, n: int = 0) -> str:
+        dt = self.elapsed()
+        per = f" ({dt / n:.3f}s each)" if n else ""
+        return f"{what} took {dt:.2f}s{per}"

@@ -1,0 +1,45 @@
+"""Tally accumulation binary (workflow phase 3).
+
+Mirror of the reference's [ext] ``runAccumulateBallots(group, inDir, outDir,
+name, createdBy)`` (call site: RunRemoteWorkflowTest.java:151).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from electionguard_tpu.cli.common import (Stopwatch, add_group_flag,
+                                          resolve_group, setup_logging)
+from electionguard_tpu.publish.publisher import Consumer, Publisher
+from electionguard_tpu.tally.accumulate import accumulate_ballots
+
+
+def main(argv=None) -> int:
+    log = setup_logging("RunAccumulateTally")
+    ap = argparse.ArgumentParser("RunAccumulateTally")
+    ap.add_argument("-in", dest="input", required=True,
+                    help="record dir with encrypted_ballots.pb")
+    ap.add_argument("-out", dest="output", required=True)
+    ap.add_argument("-name", default="tally")
+    add_group_flag(ap)
+    args = ap.parse_args(argv)
+
+    group = resolve_group(args)
+    consumer = Consumer(args.input, group)
+    init = consumer.read_election_initialized()
+    ballots = list(consumer.iterate_encrypted_ballots())
+    publisher = Publisher(args.output)
+
+    sw = Stopwatch()
+    result = accumulate_ballots(init, ballots, args.name,
+                                {"created_by": "RunAccumulateTally"})
+    publisher.write_tally_result(result)
+    log.info("%s; %d cast ballots accumulated",
+             sw.took("accumulation", max(len(ballots), 1)),
+             result.encrypted_tally.cast_ballot_count)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""Decryption guardian binary.
+
+Mirror of the reference's ``RunRemoteDecryptingTrustee``
+(src/main/java/electionguard/decrypt/RunRemoteDecryptingTrustee.java:28-279):
+loads the serialized trustee from its ceremony state file, registers with
+the coordinator (bringing its own identity: id, url, x, public key), serves
+batch direct/compensated decryption, and exits when the coordinator calls
+finish.
+
+Flags mirror the reference (:32-44): -trusteeFile -port -serverPort.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from electionguard_tpu.cli.common import (add_group_flag, resolve_group,
+                                          setup_logging)
+from electionguard_tpu.decrypt.trustee import read_trustee
+from electionguard_tpu.remote.decrypting_remote import DecryptingTrusteeServer
+
+
+def main(argv=None) -> int:
+    log = setup_logging("RunRemoteDecryptingTrustee")
+    ap = argparse.ArgumentParser("RunRemoteDecryptingTrustee")
+    ap.add_argument("-trusteeFile", dest="trustee_file", required=True)
+    ap.add_argument("-port", type=int, default=0)
+    ap.add_argument("-serverPort", dest="server_port", type=int,
+                    default=17711)
+    ap.add_argument("-serverHost", dest="server_host", default="localhost")
+    add_group_flag(ap)
+    args = ap.parse_args(argv)
+
+    group = resolve_group(args)
+    trustee = read_trustee(group, args.trustee_file)
+    server = DecryptingTrusteeServer(
+        group, trustee, f"{args.server_host}:{args.server_port}",
+        port=args.port)
+    log.info("decrypting trustee %s serving on %s", trustee.id, server.url)
+    ok = server.wait_until_finished()
+    log.info("decrypting trustee %s finished: all_ok=%s", trustee.id, ok)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,97 @@
+"""Decryption coordinator binary.
+
+Mirror of the reference's ``RunRemoteDecryptor``
+(src/main/java/electionguard/decrypt/RunRemoteDecryptor.java:55-373): loads
+the encrypted tally + election init from the record, waits for
+``navailable`` registrations (quorum ≤ navailable ≤ nguardians), computes
+the missing-guardian list, decrypts the tally (and optionally spoiled
+ballots), and publishes ``DecryptionResult``.
+
+Flags mirror the reference (:58-77): -in -out -navailable -port
+-decryptSpoiled.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from electionguard_tpu.ballot.ciphertext import BallotState
+from electionguard_tpu.cli.common import (Stopwatch, add_group_flag,
+                                          resolve_group, setup_logging)
+from electionguard_tpu.decrypt.decryption import Decryption, DecryptionError
+from electionguard_tpu.publish.election_record import DecryptionResult
+from electionguard_tpu.publish.publisher import Consumer, Publisher
+from electionguard_tpu.remote.decrypting_remote import DecryptionCoordinator
+
+
+def main(argv=None) -> int:
+    log = setup_logging("RunRemoteDecryptor")
+    ap = argparse.ArgumentParser("RunRemoteDecryptor")
+    ap.add_argument("-in", dest="input", required=True,
+                    help="election record dir (with tally_result.pb)")
+    ap.add_argument("-out", dest="output", required=True)
+    ap.add_argument("-navailable", type=int, required=True)
+    ap.add_argument("-port", type=int, default=17711)
+    ap.add_argument("-decryptSpoiled", dest="decrypt_spoiled",
+                    action="store_true")
+    ap.add_argument("-timeout", type=float, default=300.0)
+    add_group_flag(ap)
+    args = ap.parse_args(argv)
+
+    group = resolve_group(args)
+    consumer = Consumer(args.input, group)
+    tally_result = consumer.read_tally_result()
+    init = tally_result.election_init
+    publisher = Publisher(args.output)
+
+    n, quorum = init.config.n_guardians, init.config.quorum
+    if not (quorum <= args.navailable <= n):
+        log.error("require quorum (%d) <= navailable (%d) <= nguardians (%d)",
+                  quorum, args.navailable, n)
+        return 2
+
+    sw = Stopwatch()
+    coord = DecryptionCoordinator(group, args.navailable, args.port)
+    log.info("waiting for %d decrypting trustees on port %d ...",
+             args.navailable, coord.port)
+    all_ok = False
+    try:
+        if not coord.wait_for_registrations(args.timeout):
+            log.error("timed out with %d/%d registrations",
+                      coord.ready(), args.navailable)
+            return 2
+        coord.mark_started()
+        registered = {p.id for p in coord.proxies}
+        missing = [g.guardian_id for g in init.guardians
+                   if g.guardian_id not in registered]
+        log.info("registered=%s missing=%s", sorted(registered), missing)
+
+        decryption = Decryption(group, init, coord.proxies, missing)
+        decrypted = decryption.decrypt(tally_result.encrypted_tally)
+        result = DecryptionResult(
+            tally_result, decrypted,
+            tuple(decryption.get_available_guardians()),
+            {"created_by": "RunRemoteDecryptor"})
+        publisher.write_decryption_result(result)
+
+        if args.decrypt_spoiled:
+            spoiled = [b for b in consumer.iterate_encrypted_ballots()
+                       if b.state == BallotState.SPOILED]
+            tallies = [decryption.decrypt_ballot(b) for b in spoiled]
+            n_sp = publisher.write_spoiled_ballot_tallies(tallies)
+            log.info("decrypted %d spoiled ballots", n_sp)
+
+        log.info("published DecryptionResult to %s (%s)",
+                 args.output, sw.took("decryption"))
+        all_ok = True
+        return 0
+    except DecryptionError as e:
+        log.error("decryption failed: %s", e)
+        return 3
+    finally:
+        coord.shutdown(all_ok)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

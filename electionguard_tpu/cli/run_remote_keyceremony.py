@@ -1,0 +1,79 @@
+"""Key ceremony coordinator binary.
+
+Mirror of the reference's ``RunRemoteKeyCeremony``
+(src/main/java/electionguard/keyceremony/RunRemoteKeyCeremony.java:49-313):
+loads + validates the manifest, starts the registration server, waits for
+``nguardians`` trustees, runs the exchange, orders remote saveState, and
+publishes ``ElectionInitialized``.
+
+Flags mirror the reference (:52-71): -in -out -nguardians -quorum -port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from electionguard_tpu.cli.common import (Stopwatch, add_group_flag,
+                                          load_manifest, resolve_group,
+                                          setup_logging)
+from electionguard_tpu.keyceremony.interface import Result
+from electionguard_tpu.publish.election_record import ElectionConfig
+from electionguard_tpu.publish.publisher import Publisher
+from electionguard_tpu.remote.keyceremony_remote import KeyCeremonyCoordinator
+
+
+def main(argv=None) -> int:
+    log = setup_logging("RunRemoteKeyCeremony")
+    ap = argparse.ArgumentParser("RunRemoteKeyCeremony")
+    ap.add_argument("-in", dest="input", required=True,
+                    help="directory containing manifest.json")
+    ap.add_argument("-out", dest="output", required=True,
+                    help="election record output directory")
+    ap.add_argument("-nguardians", type=int, required=True)
+    ap.add_argument("-quorum", type=int, required=True)
+    ap.add_argument("-port", type=int, default=17111)
+    ap.add_argument("-trusteeDir", dest="trustee_dir", default=None,
+                    help="where trustees save private state "
+                         "(default <out>/private/trustees)")
+    ap.add_argument("-timeout", type=float, default=300.0,
+                    help="registration wait timeout seconds")
+    add_group_flag(ap)
+    args = ap.parse_args(argv)
+
+    group = resolve_group(args)
+    manifest = load_manifest(args.input)
+    config = ElectionConfig(manifest, args.nguardians, args.quorum)
+    publisher = Publisher(args.output)  # fail-fast before serving
+    trustee_dir = args.trustee_dir or f"{args.output}/private/trustees"
+
+    sw = Stopwatch()
+    coord = KeyCeremonyCoordinator(group, args.nguardians, args.quorum,
+                                   args.port)
+    log.info("waiting for %d guardians on port %d ...",
+             args.nguardians, coord.port)
+    all_ok = False
+    try:
+        if not coord.wait_for_registrations(args.timeout):
+            log.error("timed out with %d/%d registrations",
+                      coord.ready(), args.nguardians)
+            return 2
+        log.info("all %d guardians registered (%s)", args.nguardians,
+                 sw.took("registration"))
+        results = coord.run_key_ceremony(trustee_dir)
+        if isinstance(results, Result):
+            log.error("key ceremony failed: %s", results.error)
+            return 3
+        init = results.make_election_initialized(
+            config, {"created_by": "RunRemoteKeyCeremony"})
+        publisher.write_election_initialized(init)
+        log.info("published ElectionInitialized to %s (%s)",
+                 args.output, sw.took("key ceremony"))
+        all_ok = True
+        return 0
+    finally:
+        coord.shutdown(all_ok)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,49 @@
+"""Key ceremony guardian binary.
+
+Mirror of the reference's ``RunRemoteTrustee``
+(src/main/java/electionguard/keyceremony/RunRemoteTrustee.java:33-361):
+binds a free port, registers with the coordinator (which assigns the
+x-coordinate and quorum), serves the trustee rpcs, and blocks until the
+coordinator calls finish.
+
+Flags mirror the reference (:37-52): -name -port -serverPort -out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from electionguard_tpu.cli.common import (add_group_flag, resolve_group,
+                                          setup_logging)
+from electionguard_tpu.remote.keyceremony_remote import KeyCeremonyTrusteeServer
+
+
+def main(argv=None) -> int:
+    log = setup_logging("RunRemoteTrustee")
+    ap = argparse.ArgumentParser("RunRemoteTrustee")
+    ap.add_argument("-name", required=True, help="guardian id")
+    ap.add_argument("-port", type=int, default=0,
+                    help="listen port (0 = random free port)")
+    ap.add_argument("-serverPort", dest="server_port", type=int,
+                    default=17111, help="coordinator port")
+    ap.add_argument("-serverHost", dest="server_host", default="localhost")
+    ap.add_argument("-out", dest="output", default=None,
+                    help="default dir for saveState")
+    add_group_flag(ap)
+    args = ap.parse_args(argv)
+
+    group = resolve_group(args)
+    server = KeyCeremonyTrusteeServer(
+        group, args.name,
+        f"{args.server_host}:{args.server_port}",
+        out_dir=args.output, port=args.port)
+    log.info("trustee %s serving on %s (x=%d, quorum=%d)", args.name,
+             server.url, server.x_coordinate, server.quorum)
+    ok = server.wait_until_finished()
+    log.info("trustee %s finished: all_ok=%s", args.name, ok)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

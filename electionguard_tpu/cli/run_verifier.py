@@ -1,0 +1,45 @@
+"""Election-record verifier binary (workflow phase 5).
+
+Mirror of the reference's [ext] ``Verifier(record, nthreads).verify()``
+(call site: RunRemoteWorkflowTest.java:179-182) — the final ground truth of
+the workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from electionguard_tpu.cli.common import (Stopwatch, add_group_flag,
+                                          resolve_group, setup_logging)
+from electionguard_tpu.publish.publisher import (Consumer,
+                                                 election_record_from_consumer)
+from electionguard_tpu.verify.verifier import Verifier
+
+
+def main(argv=None) -> int:
+    log = setup_logging("RunVerifier")
+    ap = argparse.ArgumentParser("RunVerifier")
+    ap.add_argument("-in", dest="input", required=True,
+                    help="election record dir")
+    add_group_flag(ap)
+    args = ap.parse_args(argv)
+
+    group = resolve_group(args)
+    try:
+        record = election_record_from_consumer(Consumer(args.input, group))
+    except Exception as e:  # corrupt/truncated record is a verification FAIL
+        log.error("record unreadable (corrupt or truncated): %s", e)
+        return 1
+
+    sw = Stopwatch()
+    res = Verifier(record, group).verify()
+    print(res.summary())
+    log.info("%s; ok=%s",
+             sw.took("verification", max(len(record.encrypted_ballots), 1)),
+             res.ok)
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

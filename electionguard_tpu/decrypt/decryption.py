@@ -181,15 +181,16 @@ class Decryption:
         return out
 
     # ------------------------------------------------------------------
-    def decrypt(self, tally: EncryptedTally) -> PlaintextTally:
+    def _decrypt_contests(self, tally_id: str, contests) -> PlaintextTally:
+        """Shared assembly for tally and single-ballot decryption; contest
+        items need (contest_id, selections[(selection_id, ciphertext)])."""
         texts, keys = [], []
-        for c in tally.contests:
+        for c in contests:
             for s in c.selections:
                 texts.append(s.ciphertext)
                 keys.append((c.contest_id, s.selection_id))
-        results = self._decrypt_batch(texts)
-        by_key = dict(zip(keys, results))
-        contests = tuple(
+        by_key = dict(zip(keys, self._decrypt_batch(texts)))
+        out = tuple(
             PlaintextTallyContest(
                 contest_id=c.contest_id,
                 selections=tuple(
@@ -200,29 +201,13 @@ class Decryption:
                         message=s.ciphertext,
                         shares=by_key[(c.contest_id, s.selection_id)][2])
                     for s in c.selections))
-            for c in tally.contests)
-        return PlaintextTally(tally.tally_id, contests)
+            for c in contests)
+        return PlaintextTally(tally_id, out)
+
+    def decrypt(self, tally: EncryptedTally) -> PlaintextTally:
+        return self._decrypt_contests(tally.tally_id, tally.contests)
 
     def decrypt_ballot(self, ballot: EncryptedBallot) -> PlaintextTally:
         """Decrypt one (spoiled) ballot as a single-ballot tally
         (reference: RunRemoteDecryptor.java:264-269)."""
-        texts, keys = [], []
-        for c in ballot.contests:
-            for s in c.selections:
-                texts.append(s.ciphertext)
-                keys.append((c.contest_id, s.selection_id))
-        results = self._decrypt_batch(texts)
-        by_key = dict(zip(keys, results))
-        contests = tuple(
-            PlaintextTallyContest(
-                contest_id=c.contest_id,
-                selections=tuple(
-                    PlaintextTallySelection(
-                        selection_id=s.selection_id,
-                        tally=by_key[(c.contest_id, s.selection_id)][0],
-                        value=by_key[(c.contest_id, s.selection_id)][1],
-                        message=s.ciphertext,
-                        shares=by_key[(c.contest_id, s.selection_id)][2])
-                    for s in c.selections))
-            for c in ballot.contests)
-        return PlaintextTally(ballot.ballot_id, contests)
+        return self._decrypt_contests(ballot.ballot_id, ballot.contests)

@@ -93,10 +93,18 @@ class BatchEncryptor:
 
         for b in ballots:
             reason = None
+            cids = [c.contest_id for c in b.contests]
+            if len(set(cids)) != len(cids):
+                invalid.append((b, "duplicate contest ids"))
+                continue
             for c in b.contests:
                 desc = contests_by_id.get(c.contest_id)
                 if desc is None:
                     reason = f"unknown contest {c.contest_id}"
+                    break
+                sids = [s.selection_id for s in c.selections]
+                if len(set(sids)) != len(sids):
+                    reason = f"duplicate selection ids in {c.contest_id}"
                     break
                 known_sels = {s.object_id for s in desc.selections}
                 bad = [s.selection_id for s in c.selections

@@ -87,6 +87,13 @@ def key_ceremony_exchange(
         keys = t.send_public_keys()
         if isinstance(keys, Result):
             return Result.Err(f"{t.id} sendPublicKeys: {keys.error}")
+        # identity binding: a (possibly remote) trustee must answer with the
+        # identity it registered under, or it could impersonate another
+        # guardian and corrupt everyone's commitment bookkeeping
+        if keys.guardian_id != t.id or keys.x_coordinate != t.x_coordinate:
+            return Result.Err(
+                f"trustee {t.id} (x={t.x_coordinate}) answered with "
+                f"identity {keys.guardian_id} (x={keys.x_coordinate})")
         val = keys.validate()
         if not val.ok:
             return Result.Err(f"{t.id} public keys invalid: {val.error}")

@@ -1,0 +1,84 @@
+"""Runtime-generated protobuf message classes.
+
+The image ships bare ``protoc`` (no grpcio-tools) and a protobuf 6.x Python
+runtime that rejects 3.x gencode — so instead of checked-in ``*_pb2.py`` we
+compile the .proto files to a ``FileDescriptorSet`` (``descriptors.pb``,
+regenerated automatically when the protos change) and materialize message
+classes through ``message_factory`` at import time.  gRPC services are built
+from the same descriptors with hand-rolled method handlers
+(electionguard_tpu.remote), so the .proto files remain the single wire
+contract — mirroring the reference where the protos define the protocol
+(reference: src/main/proto/*.proto).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+_PROTO_DIR = os.path.join(os.path.dirname(__file__), "proto")
+_DESC_PATH = os.path.join(_PROTO_DIR, "descriptors.pb")
+_PROTO_FILES = ["common.proto", "election_record.proto", "remote_rpc.proto"]
+
+
+def _ensure_descriptors() -> bytes:
+    protos = [os.path.join(_PROTO_DIR, f) for f in _PROTO_FILES]
+    stale = (not os.path.exists(_DESC_PATH) or
+             any(os.path.getmtime(p) > os.path.getmtime(_DESC_PATH)
+                 for p in protos))
+    if stale:
+        subprocess.run(
+            ["protoc", f"--descriptor_set_out={_DESC_PATH}",
+             "--include_imports", "-I", _PROTO_DIR] + _PROTO_FILES,
+            check=True, cwd=_PROTO_DIR)
+    with open(_DESC_PATH, "rb") as f:
+        return f.read()
+
+
+_fds = descriptor_pb2.FileDescriptorSet()
+_fds.ParseFromString(_ensure_descriptors())
+POOL = descriptor_pool.DescriptorPool()
+for _f in _fds.file:
+    POOL.Add(_f)
+
+_messages = message_factory.GetMessageClassesForFiles(
+    [f.name for f in _fds.file], POOL)
+
+
+def msg(name: str):
+    """Message class by short name, e.g. msg('ElementModP')."""
+    return _messages[f"electionguard_tpu.{name}"]
+
+
+def service_descriptor(name: str):
+    return POOL.FindServiceByName(f"electionguard_tpu.{name}")
+
+
+# commonly used classes, bound once
+ElementModP = msg("ElementModP")
+ElementModQ = msg("ElementModQ")
+UInt256 = msg("UInt256")
+ElGamalCiphertext = msg("ElGamalCiphertext")
+GenericChaumPedersenProof = msg("GenericChaumPedersenProof")
+DisjunctiveChaumPedersenProof = msg("DisjunctiveChaumPedersenProof")
+ConstantChaumPedersenProof = msg("ConstantChaumPedersenProof")
+HashedElGamalCiphertext = msg("HashedElGamalCiphertext")
+SchnorrProof = msg("SchnorrProof")
+GuardianRecord = msg("GuardianRecord")
+ElectionInitialized = msg("ElectionInitialized")
+EncryptedSelection = msg("EncryptedSelection")
+EncryptedContest = msg("EncryptedContest")
+EncryptedBallot = msg("EncryptedBallot")
+EncryptedTallySelection = msg("EncryptedTallySelection")
+EncryptedTallyContest = msg("EncryptedTallyContest")
+EncryptedTally = msg("EncryptedTally")
+TallyResult = msg("TallyResult")
+CompensatedShare = msg("CompensatedShare")
+PartialDecryption = msg("PartialDecryption")
+PlaintextTallySelection = msg("PlaintextTallySelection")
+PlaintextTallyContest = msg("PlaintextTallyContest")
+PlaintextTally = msg("PlaintextTally")
+DecryptingGuardian = msg("DecryptingGuardian")
+DecryptionResult = msg("DecryptionResult")

@@ -1,0 +1,206 @@
+"""Publisher/Consumer: the election-record directory store.
+
+Native replacement for the reference's [ext] ``Publisher(dir, mode)`` /
+``Consumer(dir, group)`` surface (``writeElectionInitialized``,
+``writeDecryptionResult``, ``writeTrustee``, ``writePlaintextBallot``;
+``electionRecordFromConsumer``, ``readElectionInitialized``,
+``readTallyResult``, ``iterateSpoiledBallots`` — call sites:
+RunRemoteKeyCeremony.java:106-110,188-193,224, RunRemoteDecryptor.java:112-127,
+237,265, RunRemoteTrustee.java:329).
+
+The record directory IS the checkpoint system (SURVEY.md §5.4): each phase
+reads its predecessor's artifacts and writes its own.  Layout::
+
+    <dir>/election_initialized.pb
+    <dir>/encrypted_ballots.pb          length-prefixed EncryptedBallot stream
+    <dir>/tally_result.pb
+    <dir>/decryption_result.pb
+    <dir>/spoiled_ballot_tallies.pb     length-prefixed PlaintextTally stream
+    <dir>/plaintext_ballots/*.json      input staging
+    <dir>/invalid_ballots/*.json
+    <trustee_dir>/trustee-<id>.json     PRIVATE guardian state (kept outside
+                                        the public record, like the
+                                        reference's -out trustee dir)
+
+Streams are framed as 4-byte big-endian length + message bytes, so million-
+ballot records stream without loading everything in memory.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, Optional
+
+from electionguard_tpu.ballot.ciphertext import EncryptedBallot
+from electionguard_tpu.ballot.plaintext import PlaintextBallot
+from electionguard_tpu.ballot.tally import PlaintextTally
+from electionguard_tpu.core.group import GroupContext
+from electionguard_tpu.publish import pb, serialize
+from electionguard_tpu.publish.election_record import (DecryptionResult,
+                                                       ElectionInitialized,
+                                                       ElectionRecord,
+                                                       TallyResult)
+
+_INIT = "election_initialized.pb"
+_BALLOTS = "encrypted_ballots.pb"
+_TALLY = "tally_result.pb"
+_DECRYPTION = "decryption_result.pb"
+_SPOILED = "spoiled_ballot_tallies.pb"
+
+
+def _write_frame(f, data: bytes):
+    f.write(struct.pack(">I", len(data)))
+    f.write(data)
+
+
+def _read_frames(path: str) -> Iterator[bytes]:
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(4)
+            if not hdr:
+                return
+            if len(hdr) != 4:
+                raise IOError(f"truncated frame header in {path}")
+            (n,) = struct.unpack(">I", hdr)
+            data = f.read(n)
+            if len(data) != n:
+                raise IOError(f"truncated frame in {path}")
+            yield data
+
+
+class Publisher:
+    """Writes phase artifacts.  ``create_new=True`` mirrors the reference's
+    fail-fast ``validateOutputDir`` (RunRemoteKeyCeremony.java:188-193)."""
+
+    def __init__(self, out_dir: str, create_new: bool = False):
+        if create_new and os.path.exists(out_dir) and os.listdir(out_dir):
+            raise FileExistsError(
+                f"output dir {out_dir} exists and is not empty")
+        os.makedirs(out_dir, exist_ok=True)
+        if not os.access(out_dir, os.W_OK):
+            raise PermissionError(f"output dir {out_dir} not writable")
+        self.dir = out_dir
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.dir, name)
+
+    def write_election_initialized(self, init: ElectionInitialized):
+        with open(self._path(_INIT), "wb") as f:
+            f.write(serialize.publish_election_initialized(
+                init).SerializeToString())
+
+    def write_encrypted_ballots(self, ballots) -> int:
+        n = 0
+        with open(self._path(_BALLOTS), "wb") as f:
+            for b in ballots:
+                _write_frame(
+                    f, serialize.publish_encrypted_ballot(
+                        b).SerializeToString())
+                n += 1
+        return n
+
+    def write_tally_result(self, tally: TallyResult):
+        with open(self._path(_TALLY), "wb") as f:
+            f.write(serialize.publish_tally_result(tally).SerializeToString())
+
+    def write_decryption_result(self, result: DecryptionResult):
+        with open(self._path(_DECRYPTION), "wb") as f:
+            f.write(serialize.publish_decryption_result(
+                result).SerializeToString())
+
+    def write_spoiled_ballot_tallies(self, tallies) -> int:
+        n = 0
+        with open(self._path(_SPOILED), "wb") as f:
+            for t in tallies:
+                _write_frame(f, serialize.publish_plaintext_tally(
+                    t).SerializeToString())
+                n += 1
+        return n
+
+    def write_plaintext_ballot(self, subdir: str, ballot: PlaintextBallot):
+        d = self._path(subdir)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, f"{ballot.ballot_id}.json"), "w") as f:
+            f.write(ballot.to_json())
+
+
+class Consumer:
+    """Reads phase artifacts back (group-validating on import)."""
+
+    def __init__(self, in_dir: str, group: GroupContext):
+        if not os.path.isdir(in_dir):
+            raise FileNotFoundError(f"record dir {in_dir} does not exist")
+        self.dir = in_dir
+        self.group = group
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.dir, name)
+
+    def has_election_initialized(self) -> bool:
+        return os.path.exists(self._path(_INIT))
+
+    def read_election_initialized(self) -> ElectionInitialized:
+        m = pb.ElectionInitialized()
+        with open(self._path(_INIT), "rb") as f:
+            m.ParseFromString(f.read())
+        return serialize.import_election_initialized(self.group, m)
+
+    def iterate_encrypted_ballots(self) -> Iterator[EncryptedBallot]:
+        path = self._path(_BALLOTS)
+        if not os.path.exists(path):
+            return
+        for frame in _read_frames(path):
+            m = pb.EncryptedBallot()
+            m.ParseFromString(frame)
+            yield serialize.import_encrypted_ballot(self.group, m)
+
+    def read_tally_result(self) -> TallyResult:
+        m = pb.TallyResult()
+        with open(self._path(_TALLY), "rb") as f:
+            m.ParseFromString(f.read())
+        return serialize.import_tally_result(self.group, m)
+
+    def has_tally_result(self) -> bool:
+        return os.path.exists(self._path(_TALLY))
+
+    def read_decryption_result(self) -> DecryptionResult:
+        m = pb.DecryptionResult()
+        with open(self._path(_DECRYPTION), "rb") as f:
+            m.ParseFromString(f.read())
+        return serialize.import_decryption_result(self.group, m)
+
+    def has_decryption_result(self) -> bool:
+        return os.path.exists(self._path(_DECRYPTION))
+
+    def iterate_spoiled_ballot_tallies(self) -> Iterator[PlaintextTally]:
+        path = self._path(_SPOILED)
+        if not os.path.exists(path):
+            return
+        for frame in _read_frames(path):
+            m = pb.PlaintextTally()
+            m.ParseFromString(frame)
+            yield serialize.import_plaintext_tally(self.group, m)
+
+    def iterate_plaintext_ballots(self, subdir: str) -> Iterator[PlaintextBallot]:
+        d = self._path(subdir)
+        if not os.path.isdir(d):
+            return
+        for name in sorted(os.listdir(d)):
+            if name.endswith(".json"):
+                with open(os.path.join(d, name)) as f:
+                    yield PlaintextBallot.from_json(f.read())
+
+
+def election_record_from_consumer(consumer: Consumer) -> ElectionRecord:
+    """Mirror of the reference's [ext] ``electionRecordFromConsumer``
+    (RunRemoteKeyCeremony.java:106)."""
+    record = ElectionRecord(consumer.read_election_initialized())
+    record.encrypted_ballots = list(consumer.iterate_encrypted_ballots())
+    if consumer.has_tally_result():
+        record.tally_result = consumer.read_tally_result()
+    if consumer.has_decryption_result():
+        record.decryption_result = consumer.read_decryption_result()
+    record.spoiled_ballot_tallies = list(
+        consumer.iterate_spoiled_ballot_tallies())
+    return record

@@ -1,0 +1,352 @@
+"""Dataclass ↔ proto converters: the framework's ConvertCommonProto.
+
+Native replacement for the reference's codec
+(src/main/java/electionguard/util/ConvertCommonProto.java:23-153): paired
+``import_*`` (proto → domain, validating) and ``publish_*`` (domain → proto)
+functions for every wire type.  Big-endian unsigned byte encodings, 512/32
+bytes wide (reference: common.proto:6-16, ConvertCommonProto.java:46,55).
+"""
+
+from __future__ import annotations
+
+from electionguard_tpu.ballot.ciphertext import (BallotState, EncryptedBallot,
+                                                 EncryptedContest,
+                                                 EncryptedSelection)
+from electionguard_tpu.ballot.manifest import Manifest
+from electionguard_tpu.ballot.tally import (EncryptedTally,
+                                            EncryptedTallyContest,
+                                            EncryptedTallySelection,
+                                            PartialDecryption,
+                                            PlaintextTally,
+                                            PlaintextTallyContest,
+                                            PlaintextTallySelection)
+from electionguard_tpu.core.group import (ElementModP, ElementModQ,
+                                          GroupContext)
+from electionguard_tpu.crypto.chaum_pedersen import (
+    ConstantChaumPedersenProof, DisjunctiveChaumPedersenProof,
+    GenericChaumPedersenProof)
+from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
+from electionguard_tpu.crypto.hashed_elgamal import HashedElGamalCiphertext
+from electionguard_tpu.crypto.schnorr import SchnorrProof
+from electionguard_tpu.decrypt.interface import CompensatedDecryptionAndProof
+from electionguard_tpu.publish import pb
+from electionguard_tpu.publish.election_record import (DecryptingGuardian,
+                                                       DecryptionResult,
+                                                       ElectionConfig,
+                                                       ElectionInitialized,
+                                                       GuardianRecord,
+                                                       TallyResult)
+
+# ---------------------------------------------------------------------------
+# crypto primitives
+# ---------------------------------------------------------------------------
+
+
+def publish_p(e: ElementModP):
+    return pb.ElementModP(value=e.to_bytes())
+
+
+def import_p(g: GroupContext, m) -> ElementModP:
+    if len(m.value) != g.spec.p_bytes:
+        raise ValueError(f"ElementModP wire width {len(m.value)} != "
+                         f"{g.spec.p_bytes}")
+    return g.bytes_to_p(m.value)
+
+
+def publish_q(e: ElementModQ):
+    return pb.ElementModQ(value=e.to_bytes())
+
+
+def import_q(g: GroupContext, m) -> ElementModQ:
+    if len(m.value) != g.spec.q_bytes:
+        raise ValueError(f"ElementModQ wire width {len(m.value)} != "
+                         f"{g.spec.q_bytes}")
+    return g.bytes_to_q(m.value)
+
+
+def publish_ciphertext(c: ElGamalCiphertext):
+    return pb.ElGamalCiphertext(pad=publish_p(c.pad), data=publish_p(c.data))
+
+
+def import_ciphertext(g: GroupContext, m) -> ElGamalCiphertext:
+    return ElGamalCiphertext(import_p(g, m.pad), import_p(g, m.data))
+
+
+def publish_generic_proof(p: GenericChaumPedersenProof):
+    return pb.GenericChaumPedersenProof(
+        challenge=publish_q(p.challenge), response=publish_q(p.response))
+
+
+def import_generic_proof(g: GroupContext, m) -> GenericChaumPedersenProof:
+    return GenericChaumPedersenProof(
+        import_q(g, m.challenge), import_q(g, m.response))
+
+
+def publish_disjunctive_proof(p: DisjunctiveChaumPedersenProof):
+    return pb.DisjunctiveChaumPedersenProof(
+        proof_zero_challenge=publish_q(p.proof_zero_challenge),
+        proof_zero_response=publish_q(p.proof_zero_response),
+        proof_one_challenge=publish_q(p.proof_one_challenge),
+        proof_one_response=publish_q(p.proof_one_response))
+
+
+def import_disjunctive_proof(g: GroupContext, m) -> DisjunctiveChaumPedersenProof:
+    return DisjunctiveChaumPedersenProof(
+        import_q(g, m.proof_zero_challenge),
+        import_q(g, m.proof_zero_response),
+        import_q(g, m.proof_one_challenge),
+        import_q(g, m.proof_one_response))
+
+
+def publish_constant_proof(p: ConstantChaumPedersenProof):
+    return pb.ConstantChaumPedersenProof(
+        challenge=publish_q(p.challenge), response=publish_q(p.response),
+        constant=p.constant)
+
+
+def import_constant_proof(g: GroupContext, m) -> ConstantChaumPedersenProof:
+    return ConstantChaumPedersenProof(
+        import_q(g, m.challenge), import_q(g, m.response), int(m.constant))
+
+
+def publish_hashed_ciphertext(h: HashedElGamalCiphertext):
+    return pb.HashedElGamalCiphertext(
+        c0=publish_p(h.c0), c1=h.c1, c2=h.c2, num_bytes=h.num_bytes)
+
+
+def import_hashed_ciphertext(g: GroupContext, m) -> HashedElGamalCiphertext:
+    return HashedElGamalCiphertext(
+        import_p(g, m.c0), bytes(m.c1), bytes(m.c2), int(m.num_bytes))
+
+
+def publish_schnorr(p: SchnorrProof):
+    return pb.SchnorrProof(public_key=publish_p(p.public_key),
+                           challenge=publish_q(p.challenge),
+                           response=publish_q(p.response))
+
+
+def import_schnorr(g: GroupContext, m) -> SchnorrProof:
+    return SchnorrProof(import_p(g, m.public_key),
+                        import_q(g, m.challenge), import_q(g, m.response))
+
+
+def publish_u256(b: bytes):
+    if len(b) != 32:
+        raise ValueError("UInt256 must be exactly 32 bytes")
+    return pb.UInt256(value=b)
+
+
+def import_u256(m) -> bytes:
+    if len(m.value) != 32:
+        raise ValueError("UInt256 must be exactly 32 bytes")
+    return bytes(m.value)
+
+
+# ---------------------------------------------------------------------------
+# election record
+# ---------------------------------------------------------------------------
+
+
+def publish_guardian_record(r: GuardianRecord):
+    return pb.GuardianRecord(
+        guardian_id=r.guardian_id, x_coordinate=r.x_coordinate,
+        coefficient_commitments=[publish_p(k)
+                                 for k in r.coefficient_commitments],
+        coefficient_proofs=[publish_schnorr(p)
+                            for p in r.coefficient_proofs])
+
+
+def import_guardian_record(g: GroupContext, m) -> GuardianRecord:
+    return GuardianRecord(
+        guardian_id=m.guardian_id, x_coordinate=int(m.x_coordinate),
+        coefficient_commitments=tuple(
+            import_p(g, k) for k in m.coefficient_commitments),
+        coefficient_proofs=tuple(
+            import_schnorr(g, p) for p in m.coefficient_proofs))
+
+
+def publish_election_initialized(e: ElectionInitialized):
+    return pb.ElectionInitialized(
+        manifest_json=e.config.manifest.to_json(),
+        n_guardians=e.config.n_guardians,
+        quorum=e.config.quorum,
+        joint_public_key=publish_p(e.joint_public_key),
+        manifest_hash=publish_u256(e.manifest_hash),
+        crypto_base_hash=publish_q(e.crypto_base_hash),
+        extended_base_hash=publish_q(e.extended_base_hash),
+        guardians=[publish_guardian_record(r) for r in e.guardians],
+        metadata=dict(e.metadata))
+
+
+def import_election_initialized(g: GroupContext, m) -> ElectionInitialized:
+    return ElectionInitialized(
+        config=ElectionConfig(Manifest.from_json(m.manifest_json),
+                              int(m.n_guardians), int(m.quorum)),
+        joint_public_key=import_p(g, m.joint_public_key),
+        manifest_hash=import_u256(m.manifest_hash),
+        crypto_base_hash=import_q(g, m.crypto_base_hash),
+        extended_base_hash=import_q(g, m.extended_base_hash),
+        guardians=tuple(import_guardian_record(g, r) for r in m.guardians),
+        metadata=dict(m.metadata))
+
+
+def publish_encrypted_ballot(b: EncryptedBallot):
+    return pb.EncryptedBallot(
+        ballot_id=b.ballot_id, ballot_style_id=b.ballot_style_id,
+        manifest_hash=publish_u256(b.manifest_hash),
+        code_seed=publish_u256(b.code_seed), code=publish_u256(b.code),
+        timestamp=b.timestamp,
+        contests=[pb.EncryptedContest(
+            contest_id=c.contest_id, sequence_order=c.sequence_order,
+            selections=[pb.EncryptedSelection(
+                selection_id=s.selection_id,
+                sequence_order=s.sequence_order,
+                ciphertext=publish_ciphertext(s.ciphertext),
+                proof=publish_disjunctive_proof(s.proof),
+                is_placeholder=s.is_placeholder)
+                for s in c.selections],
+            proof=publish_constant_proof(c.proof))
+            for c in b.contests],
+        state=pb.EncryptedBallot.BallotState.Value(b.state.value))
+
+
+def import_encrypted_ballot(g: GroupContext, m) -> EncryptedBallot:
+    return EncryptedBallot(
+        ballot_id=m.ballot_id, ballot_style_id=m.ballot_style_id,
+        manifest_hash=import_u256(m.manifest_hash),
+        code_seed=import_u256(m.code_seed), code=import_u256(m.code),
+        timestamp=int(m.timestamp),
+        contests=tuple(EncryptedContest(
+            contest_id=c.contest_id, sequence_order=int(c.sequence_order),
+            selections=tuple(EncryptedSelection(
+                selection_id=s.selection_id,
+                sequence_order=int(s.sequence_order),
+                ciphertext=import_ciphertext(g, s.ciphertext),
+                proof=import_disjunctive_proof(g, s.proof),
+                is_placeholder=bool(s.is_placeholder))
+                for s in c.selections),
+            proof=import_constant_proof(g, c.proof))
+            for c in m.contests),
+        state=BallotState(
+            pb.EncryptedBallot.BallotState.Name(m.state)))
+
+
+def publish_encrypted_tally(t: EncryptedTally):
+    return pb.EncryptedTally(
+        tally_id=t.tally_id,
+        contests=[pb.EncryptedTallyContest(
+            contest_id=c.contest_id, sequence_order=c.sequence_order,
+            selections=[pb.EncryptedTallySelection(
+                selection_id=s.selection_id,
+                sequence_order=s.sequence_order,
+                ciphertext=publish_ciphertext(s.ciphertext))
+                for s in c.selections])
+            for c in t.contests],
+        cast_ballot_count=t.cast_ballot_count)
+
+
+def import_encrypted_tally(g: GroupContext, m) -> EncryptedTally:
+    return EncryptedTally(
+        tally_id=m.tally_id,
+        contests=tuple(EncryptedTallyContest(
+            contest_id=c.contest_id, sequence_order=int(c.sequence_order),
+            selections=tuple(EncryptedTallySelection(
+                selection_id=s.selection_id,
+                sequence_order=int(s.sequence_order),
+                ciphertext=import_ciphertext(g, s.ciphertext))
+                for s in c.selections))
+            for c in m.contests),
+        cast_ballot_count=int(m.cast_ballot_count))
+
+
+def publish_tally_result(t: TallyResult):
+    return pb.TallyResult(
+        election_init=publish_election_initialized(t.election_init),
+        encrypted_tally=publish_encrypted_tally(t.encrypted_tally),
+        tally_ids=list(t.tally_ids), metadata=dict(t.metadata))
+
+
+def import_tally_result(g: GroupContext, m) -> TallyResult:
+    return TallyResult(
+        election_init=import_election_initialized(g, m.election_init),
+        encrypted_tally=import_encrypted_tally(g, m.encrypted_tally),
+        tally_ids=tuple(m.tally_ids), metadata=dict(m.metadata))
+
+
+def publish_plaintext_tally(t: PlaintextTally):
+    def pub_share(sh: PartialDecryption):
+        m = pb.PartialDecryption(guardian_id=sh.guardian_id,
+                                 share=publish_p(sh.share))
+        if sh.proof is not None:
+            m.proof.CopyFrom(publish_generic_proof(sh.proof))
+        if sh.recovered_parts:
+            for tid, part in sorted(sh.recovered_parts.items()):
+                m.recovered_parts.append(pb.CompensatedShare(
+                    trustee_id=tid,
+                    partial_decryption=publish_p(part.partial_decryption),
+                    proof=publish_generic_proof(part.proof),
+                    recovered_public_key_share=publish_p(
+                        part.recovered_public_key_share)))
+        return m
+
+    return pb.PlaintextTally(
+        tally_id=t.tally_id,
+        contests=[pb.PlaintextTallyContest(
+            contest_id=c.contest_id,
+            selections=[pb.PlaintextTallySelection(
+                selection_id=s.selection_id, tally=s.tally,
+                value=publish_p(s.value),
+                message=publish_ciphertext(s.message),
+                shares=[pub_share(sh) for sh in s.shares])
+                for s in c.selections])
+            for c in t.contests])
+
+
+def import_plaintext_tally(g: GroupContext, m) -> PlaintextTally:
+    def imp_share(sm) -> PartialDecryption:
+        proof = (import_generic_proof(g, sm.proof)
+                 if sm.HasField("proof") else None)
+        parts = None
+        if sm.recovered_parts:
+            parts = {
+                p.trustee_id: CompensatedDecryptionAndProof(
+                    import_p(g, p.partial_decryption),
+                    import_generic_proof(g, p.proof),
+                    import_p(g, p.recovered_public_key_share))
+                for p in sm.recovered_parts}
+        return PartialDecryption(sm.guardian_id, import_p(g, sm.share),
+                                 proof, parts)
+
+    return PlaintextTally(
+        tally_id=m.tally_id,
+        contests=tuple(PlaintextTallyContest(
+            contest_id=c.contest_id,
+            selections=tuple(PlaintextTallySelection(
+                selection_id=s.selection_id, tally=int(s.tally),
+                value=import_p(g, s.value),
+                message=import_ciphertext(g, s.message),
+                shares=tuple(imp_share(sh) for sh in s.shares))
+                for s in c.selections))
+            for c in m.contests))
+
+
+def publish_decryption_result(d: DecryptionResult):
+    return pb.DecryptionResult(
+        tally_result=publish_tally_result(d.tally_result),
+        decrypted_tally=publish_plaintext_tally(d.decrypted_tally),
+        decrypting_guardians=[pb.DecryptingGuardian(
+            guardian_id=a.guardian_id, x_coordinate=a.x_coordinate,
+            lagrange_coefficient=publish_q(a.lagrange_coefficient))
+            for a in d.decrypting_guardians],
+        metadata=dict(d.metadata))
+
+
+def import_decryption_result(g: GroupContext, m) -> DecryptionResult:
+    return DecryptionResult(
+        tally_result=import_tally_result(g, m.tally_result),
+        decrypted_tally=import_plaintext_tally(g, m.decrypted_tally),
+        decrypting_guardians=tuple(DecryptingGuardian(
+            guardian_id=a.guardian_id, x_coordinate=int(a.x_coordinate),
+            lagrange_coefficient=import_q(g, a.lagrange_coefficient))
+            for a in m.decrypting_guardians),
+        metadata=dict(m.metadata))

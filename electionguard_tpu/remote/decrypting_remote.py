@@ -1,0 +1,299 @@
+"""Remote decryption: coordinator + decrypting-trustee servers and proxies.
+
+Mirrors the reference's four decryption classes (SURVEY.md §2 rows 5,7-9):
+
+* ``DecryptionCoordinator`` — registration service + decryption driver
+  (reference: RunRemoteDecryptor.java:55-373): waits for ``navailable``
+  registrations (quorum ≤ navailable ≤ nguardians), computes the missing-
+  guardian list from the election record, runs ``Decryption`` over proxies,
+  publishes ``DecryptionResult``.
+* ``RemoteDecryptingTrusteeProxy`` — coordinator-resident
+  ``DecryptingTrusteeIF`` over gRPC (reference:
+  RemoteDecryptingTrusteeProxy.java:30-212).  Unlike the reference, errors
+  are surfaced as Result values, not silently mapped to empty lists
+  (the reference's silent-degrade quirk at :66,74).
+* ``DecryptingTrusteeServer`` — guardian process serving batch
+  direct/compensated decryption around a ``DecryptingTrustee`` loaded from
+  its ceremony state file (reference: RunRemoteDecryptingTrustee.java:28-279).
+* ``RemoteDecryptorProxy`` — trustee-side registration client
+  (reference: RemoteDecryptorProxy.java:15-66).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional, Sequence, Union
+
+import grpc
+
+from electionguard_tpu.core.group import ElementModP, ElementModQ, GroupContext
+from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
+from electionguard_tpu.decrypt.interface import (
+    CompensatedDecryptionAndProof, DecryptingTrusteeIF,
+    DirectDecryptionAndProof)
+from electionguard_tpu.decrypt.trustee import DecryptingTrustee
+from electionguard_tpu.keyceremony.interface import Result
+from electionguard_tpu.publish import pb, serialize
+from electionguard_tpu.remote import rpc_util
+
+log = logging.getLogger("egtpu.remote.decrypt")
+
+
+# ---------------------------------------------------------------------------
+# coordinator side
+# ---------------------------------------------------------------------------
+
+class RemoteDecryptingTrusteeProxy(DecryptingTrusteeIF):
+    def __init__(self, group: GroupContext, guardian_id: str,
+                 x_coordinate: int, public_key: ElementModP, url: str):
+        self.group = group
+        self._id = guardian_id
+        self._x = x_coordinate
+        self._public_key = public_key
+        self.url = url
+        self._channel = rpc_util.make_channel(url)
+        self._stub = rpc_util.Stub(self._channel, "DecryptingTrusteeService")
+
+    @property
+    def id(self) -> str:
+        return self._id
+
+    @property
+    def x_coordinate(self) -> int:
+        return self._x
+
+    @property
+    def election_public_key(self) -> ElementModP:
+        return self._public_key
+
+    def direct_decrypt(self, texts: Sequence[ElGamalCiphertext],
+                       extended_base_hash: ElementModQ
+                       ) -> Union[list[DirectDecryptionAndProof], Result]:
+        req = pb.msg("DirectDecryptionRequest")(
+            texts=[serialize.publish_ciphertext(t) for t in texts],
+            extended_base_hash=serialize.publish_q(extended_base_hash))
+        try:
+            resp = self._stub.call("directDecrypt", req, timeout=600.0)
+        except grpc.RpcError as e:
+            return Result.Err(f"directDecrypt rpc to {self._id}: {e.code()}")
+        if resp.error:
+            return Result.Err(resp.error)
+        return [DirectDecryptionAndProof(
+            serialize.import_p(self.group, r.partial_decryption),
+            serialize.import_generic_proof(self.group, r.proof))
+            for r in resp.results]
+
+    def compensated_decrypt(self, missing_guardian_id: str,
+                            texts: Sequence[ElGamalCiphertext],
+                            extended_base_hash: ElementModQ
+                            ) -> Union[list[CompensatedDecryptionAndProof], Result]:
+        req = pb.msg("CompensatedDecryptionRequest")(
+            missing_guardian_id=missing_guardian_id,
+            texts=[serialize.publish_ciphertext(t) for t in texts],
+            extended_base_hash=serialize.publish_q(extended_base_hash))
+        try:
+            resp = self._stub.call("compensatedDecrypt", req, timeout=600.0)
+        except grpc.RpcError as e:
+            return Result.Err(
+                f"compensatedDecrypt rpc to {self._id}: {e.code()}")
+        if resp.error:
+            return Result.Err(resp.error)
+        return [CompensatedDecryptionAndProof(
+            serialize.import_p(self.group, r.partial_decryption),
+            serialize.import_generic_proof(self.group, r.proof),
+            serialize.import_p(self.group, r.recovered_public_key_share))
+            for r in resp.results]
+
+    def finish(self, all_ok: bool) -> Result:
+        try:
+            resp = self._stub.call("finish",
+                                   pb.msg("FinishRequest")(all_ok=all_ok))
+            return Result(resp.ok, resp.error)
+        except grpc.RpcError as e:
+            return Result.Err(f"finish rpc to {self._id}: {e.code()}")
+
+    def shutdown(self):
+        self._channel.close()
+
+
+class DecryptionCoordinator:
+    """Registration server for decrypting trustees
+    (reference: RunRemoteDecryptor.java:164-182,325-369)."""
+
+    def __init__(self, group: GroupContext, navailable: int,
+                 port: int = 17711):
+        self.group = group
+        self.navailable = navailable
+        self.proxies: list[RemoteDecryptingTrusteeProxy] = []
+        self._lock = threading.Lock()
+        self._started = False
+        self.server, self.port = rpc_util.make_server(
+            port, rpc_util.MAX_REGISTRATION_MESSAGE)
+        self.server.add_generic_rpc_handlers((rpc_util.generic_service(
+            "DecryptingRegistrationService",
+            {"registerTrustee": self._register_trustee}),))
+        self.server.start()
+        log.info("decryption coordinator listening on %d", self.port)
+
+    def _register_trustee(self, request, context):
+        Resp = pb.msg("RegisterDecryptingTrusteeResponse")
+        with self._lock:
+            if self._started:
+                return Resp(error="decryption already started")
+            gid = request.guardian_id
+            for p in self.proxies:
+                if p.id == gid:
+                    return Resp(error=f"duplicate guardian id {gid}")
+            if len(self.proxies) >= self.navailable:
+                return Resp(error="enough guardians already registered")
+            try:
+                pubkey = serialize.import_p(self.group, request.public_key)
+            except ValueError as e:
+                return Resp(error=f"bad public key: {e}")
+            proxy = RemoteDecryptingTrusteeProxy(
+                self.group, gid, int(request.x_coordinate), pubkey,
+                request.remote_url)
+            self.proxies.append(proxy)
+            log.info("registered decrypting trustee %s x=%d url=%s",
+                     gid, request.x_coordinate, request.remote_url)
+            return Resp()
+
+    def ready(self) -> int:
+        with self._lock:
+            return len(self.proxies)
+
+    def wait_for_registrations(self, timeout: float = 300.0,
+                               poll: float = 0.25) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.ready() == self.navailable:
+                return True
+            time.sleep(poll)
+        return False
+
+    def mark_started(self):
+        with self._lock:
+            self._started = True
+
+    def shutdown(self, all_ok: bool):
+        for p in self.proxies:
+            p.finish(all_ok)
+            p.shutdown()
+        self.server.stop(grace=1)
+
+
+# ---------------------------------------------------------------------------
+# trustee side
+# ---------------------------------------------------------------------------
+
+class RemoteDecryptorProxy:
+    """Trustee-side registration client (reference: RemoteDecryptorProxy.java)."""
+
+    def __init__(self, coordinator_url: str):
+        self._channel = rpc_util.make_channel(
+            coordinator_url, rpc_util.MAX_REGISTRATION_MESSAGE)
+        self._stub = rpc_util.Stub(self._channel,
+                                   "DecryptingRegistrationService")
+
+    def register_trustee(self, guardian_id: str, remote_url: str,
+                         x_coordinate: int, public_key: ElementModP):
+        return self._stub.call("registerTrustee",
+                               pb.msg("RegisterDecryptingTrusteeRequest")(
+                                   guardian_id=guardian_id,
+                                   remote_url=remote_url,
+                                   x_coordinate=x_coordinate,
+                                   public_key=serialize.publish_p(public_key)))
+
+    def close(self):
+        self._channel.close()
+
+
+class DecryptingTrusteeServer:
+    """One decryption guardian process: loads its trustee state, registers
+    with its identity (id, url, x, public key), serves batch rpcs."""
+
+    def __init__(self, group: GroupContext, trustee: DecryptingTrustee,
+                 coordinator_url: str, port: int = 0,
+                 host: str = "localhost"):
+        self.group = group
+        self.trustee = trustee
+        self._all_ok: Optional[bool] = None
+        self._done = threading.Event()
+
+        self.server, self.port = rpc_util.make_server(port)
+        self.url = f"{host}:{self.port}"
+        self.server.add_generic_rpc_handlers((rpc_util.generic_service(
+            "DecryptingTrusteeService",
+            {"directDecrypt": self._direct_decrypt,
+             "compensatedDecrypt": self._compensated_decrypt,
+             "finish": self._finish}),))
+        self.server.start()
+
+        reg = RemoteDecryptorProxy(coordinator_url)
+        try:
+            resp = reg.register_trustee(
+                trustee.id, self.url, trustee.x_coordinate,
+                trustee.election_public_key)
+        finally:
+            reg.close()
+        if resp.error:
+            self.server.stop(grace=0)
+            raise RuntimeError(f"registration failed: {resp.error}")
+        log.info("decrypting trustee %s registered url=%s",
+                 trustee.id, self.url)
+
+    # ---- rpc impls (reference: RunRemoteDecryptingTrustee.java:181-257) --
+    def _direct_decrypt(self, request, context):
+        Resp = pb.msg("DirectDecryptionResponse")
+        try:
+            texts = [serialize.import_ciphertext(self.group, t)
+                     for t in request.texts]
+            qbar = serialize.import_q(self.group, request.extended_base_hash)
+        except ValueError as e:
+            return Resp(error=f"malformed request: {e}")
+        res = self.trustee.direct_decrypt(texts, qbar)
+        if isinstance(res, Result):
+            return Resp(error=res.error)
+        return Resp(results=[pb.msg("DirectDecryptionResult")(
+            partial_decryption=serialize.publish_p(d.partial_decryption),
+            proof=serialize.publish_generic_proof(d.proof))
+            for d in res])
+
+    def _compensated_decrypt(self, request, context):
+        Resp = pb.msg("CompensatedDecryptionResponse")
+        try:
+            texts = [serialize.import_ciphertext(self.group, t)
+                     for t in request.texts]
+            qbar = serialize.import_q(self.group, request.extended_base_hash)
+        except ValueError as e:
+            return Resp(error=f"malformed request: {e}")
+        res = self.trustee.compensated_decrypt(
+            request.missing_guardian_id, texts, qbar)
+        if isinstance(res, Result):
+            return Resp(error=res.error)
+        return Resp(results=[pb.msg("CompensatedDecryptionResult")(
+            partial_decryption=serialize.publish_p(c.partial_decryption),
+            proof=serialize.publish_generic_proof(c.proof),
+            recovered_public_key_share=serialize.publish_p(
+                c.recovered_public_key_share))
+            for c in res])
+
+    def _finish(self, request, context):
+        # the reference's trustee exits the whole process here
+        # (RunRemoteDecryptingTrustee.java:274-276); we signal the host
+        # binary instead, which exits after wait_until_finished.
+        self._all_ok = bool(request.all_ok)
+        self._done.set()
+        return pb.msg("BoolResponse")(ok=True)
+
+    def wait_until_finished(self, timeout: Optional[float] = None) -> Optional[bool]:
+        if not self._done.wait(timeout):
+            return None
+        self.server.stop(grace=1)
+        return self._all_ok
+
+    def shutdown(self):
+        self._done.set()
+        self.server.stop(grace=0)

@@ -1,0 +1,397 @@
+"""Remote key ceremony: coordinator + trustee servers and their proxies.
+
+Mirrors the reference's four key-ceremony classes (SURVEY.md §2 rows 1-4):
+
+* ``KeyCeremonyCoordinator`` — registration service + ceremony driver
+  (reference: RunRemoteKeyCeremony.java:86-313): waits for ``n_guardians``
+  registrations, assigns x-coordinates from a counter, dials each trustee
+  back, runs the exchange over proxies, orders remote save, publishes
+  ``ElectionInitialized``.
+* ``RemoteTrusteeProxy`` — coordinator-resident ``KeyCeremonyTrusteeIF``
+  over gRPC (reference: RemoteTrusteeProxy.java:28-256).
+* ``KeyCeremonyTrusteeServer`` — guardian process: serves the trustee rpcs
+  around an in-process ``KeyCeremonyTrustee`` delegate (reference:
+  RunRemoteTrustee.java:33-361).  Guardian secrets never cross the wire
+  except encrypted shares / challenged coordinates.
+* ``RemoteKeyCeremonyProxy`` — trustee-side registration client
+  (reference: RemoteKeyCeremonyProxy.java:16-59).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional, Union
+
+import grpc
+
+from electionguard_tpu.core.group import GroupContext
+from electionguard_tpu.keyceremony.exchange import (KeyCeremonyResults,
+                                                    key_ceremony_exchange)
+from electionguard_tpu.keyceremony.interface import (KeyCeremonyTrusteeIF,
+                                                     KeyShareChallengeResponse,
+                                                     PublicKeys, Result,
+                                                     SecretKeyShare)
+from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+from electionguard_tpu.publish import pb, serialize
+from electionguard_tpu.remote import rpc_util
+
+log = logging.getLogger("egtpu.remote.keyceremony")
+
+
+# ---------------------------------------------------------------------------
+# coordinator side
+# ---------------------------------------------------------------------------
+
+class RemoteTrusteeProxy(KeyCeremonyTrusteeIF):
+    """Coordinator-resident client for one remote trustee."""
+
+    def __init__(self, group: GroupContext, guardian_id: str,
+                 x_coordinate: int, url: str):
+        self.group = group
+        self._id = guardian_id
+        self._x = x_coordinate
+        self.url = url
+        self._channel = rpc_util.make_channel(url)
+        self._stub = rpc_util.Stub(self._channel,
+                                   "RemoteKeyCeremonyTrusteeService")
+
+    @property
+    def id(self) -> str:
+        return self._id
+
+    @property
+    def x_coordinate(self) -> int:
+        return self._x
+
+    def _call(self, method, request):
+        try:
+            return self._stub.call(method, request)
+        except grpc.RpcError as e:
+            return Result.Err(f"rpc {method} to {self._id}: {e.code()}")
+
+    def send_public_keys(self) -> Union[PublicKeys, Result]:
+        resp = self._call("sendPublicKeys", pb.msg("PublicKeySetRequest")())
+        if isinstance(resp, Result):
+            return resp
+        if resp.error:
+            return Result.Err(resp.error)
+        return PublicKeys(
+            resp.guardian_id, int(resp.x_coordinate),
+            tuple(serialize.import_p(self.group, k)
+                  for k in resp.coefficient_commitments),
+            tuple(serialize.import_schnorr(self.group, p)
+                  for p in resp.coefficient_proofs))
+
+    def receive_public_keys(self, keys: PublicKeys) -> Result:
+        m = pb.msg("PublicKeySet")(
+            guardian_id=keys.guardian_id, x_coordinate=keys.x_coordinate,
+            coefficient_commitments=[serialize.publish_p(k)
+                                     for k in keys.coefficient_commitments],
+            coefficient_proofs=[serialize.publish_schnorr(p)
+                                for p in keys.coefficient_proofs])
+        resp = self._call("receivePublicKeys", m)
+        if isinstance(resp, Result):
+            return resp
+        return Result(resp.ok, resp.error)
+
+    def send_secret_key_share(self, other_id: str) -> Union[SecretKeyShare, Result]:
+        resp = self._call("sendSecretKeyShare",
+                          pb.msg("PartialKeyBackupRequest")(
+                              designated_guardian_id=other_id))
+        if isinstance(resp, Result):
+            return resp
+        if resp.error:
+            return Result.Err(resp.error)
+        return SecretKeyShare(
+            resp.generating_guardian_id, resp.designated_guardian_id,
+            int(resp.designated_guardian_x),
+            serialize.import_hashed_ciphertext(self.group,
+                                               resp.encrypted_coordinate))
+
+    def receive_secret_key_share(self, share: SecretKeyShare) -> Result:
+        m = pb.msg("PartialKeyBackup")(
+            generating_guardian_id=share.generating_guardian_id,
+            designated_guardian_id=share.designated_guardian_id,
+            designated_guardian_x=share.designated_guardian_x,
+            encrypted_coordinate=serialize.publish_hashed_ciphertext(
+                share.encrypted_coordinate))
+        resp = self._call("receiveSecretKeyShare", m)
+        if isinstance(resp, Result):
+            return resp
+        return Result(resp.ok, resp.error)
+
+    def challenge_share(self, challenger_id: str) -> Union[KeyShareChallengeResponse, Result]:
+        resp = self._call("challengeShare", pb.msg("PartialKeyChallenge")(
+            challenger_guardian_id=challenger_id))
+        if isinstance(resp, Result):
+            return resp
+        if resp.error:
+            return Result.Err(resp.error)
+        return KeyShareChallengeResponse(
+            resp.generating_guardian_id, resp.designated_guardian_id,
+            serialize.import_q(self.group, resp.coordinate))
+
+    def receive_challenged_share(self, response: KeyShareChallengeResponse) -> Result:
+        m = pb.msg("PartialKeyChallengeResponse")(
+            generating_guardian_id=response.generating_guardian_id,
+            designated_guardian_id=response.designated_guardian_id,
+            coordinate=serialize.publish_q(response.coordinate))
+        resp = self._call("receiveChallengedShare", m)
+        if isinstance(resp, Result):
+            return resp
+        return Result(resp.ok, resp.error)
+
+    def save_state(self, out_dir: str) -> Result:
+        resp = self._call("saveState",
+                          pb.msg("SaveStateRequest")(out_dir=out_dir))
+        if isinstance(resp, Result):
+            return resp
+        return Result(resp.ok, resp.error)
+
+    def finish(self, all_ok: bool) -> Result:
+        resp = self._call("finish", pb.msg("FinishRequest")(all_ok=all_ok))
+        if isinstance(resp, Result):
+            return resp
+        return Result(resp.ok, resp.error)
+
+    def shutdown(self):
+        self._channel.close()
+
+
+class KeyCeremonyCoordinator:
+    """The ceremony server + driver (reference: RunRemoteKeyCeremony.java)."""
+
+    def __init__(self, group: GroupContext, n_guardians: int, quorum: int,
+                 port: int = 17111):
+        self.group = group
+        self.n = n_guardians
+        self.quorum = quorum
+        self.proxies: list[RemoteTrusteeProxy] = []
+        self._lock = threading.Lock()
+        self._next_coordinate = 0
+        self._started_ceremony = False
+        self.server, self.port = rpc_util.make_server(
+            port, rpc_util.MAX_REGISTRATION_MESSAGE)
+        self.server.add_generic_rpc_handlers((rpc_util.generic_service(
+            "RemoteKeyCeremonyService",
+            {"registerTrustee": self._register_trustee}),))
+        self.server.start()
+        log.info("key ceremony coordinator listening on %d", self.port)
+
+    # -- registration rpc (reference: RunRemoteKeyCeremony.java:258-276) --
+    def _register_trustee(self, request, context):
+        Resp = pb.msg("RegisterKeyCeremonyTrusteeResponse")
+        with self._lock:
+            if self._started_ceremony:
+                return Resp(error="ceremony already started")
+            gid = request.guardian_id
+            for p in self.proxies:
+                if p.id == gid:
+                    return Resp(error=f"duplicate guardian id {gid}")
+            if len(self.proxies) >= self.n:
+                return Resp(error="all guardians already registered")
+            self._next_coordinate += 1
+            x = self._next_coordinate
+            proxy = RemoteTrusteeProxy(self.group, gid, x, request.remote_url)
+            self.proxies.append(proxy)
+            log.info("registered trustee %s x=%d url=%s", gid, x,
+                     request.remote_url)
+            return Resp(guardian_id=gid, x_coordinate=x, quorum=self.quorum)
+
+    def ready(self) -> int:
+        with self._lock:
+            return len(self.proxies)
+
+    def wait_for_registrations(self, timeout: float = 300.0,
+                               poll: float = 0.25) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.ready() == self.n:
+                return True
+            time.sleep(poll)
+        return False
+
+    def run_key_ceremony(self, trustee_out_dir: str) -> Union[KeyCeremonyResults, Result]:
+        with self._lock:
+            self._started_ceremony = True
+        results = key_ceremony_exchange(self.proxies, self.group)
+        if isinstance(results, Result):
+            return results
+        for p in self.proxies:
+            res = p.save_state(trustee_out_dir)
+            if not res.ok:
+                return Result.Err(f"saveState({p.id}): {res.error}")
+        return results
+
+    def shutdown(self, all_ok: bool):
+        for p in self.proxies:
+            p.finish(all_ok)
+            p.shutdown()
+        self.server.stop(grace=1)
+
+
+# ---------------------------------------------------------------------------
+# trustee side
+# ---------------------------------------------------------------------------
+
+class RemoteKeyCeremonyProxy:
+    """Trustee-side registration client (reference: RemoteKeyCeremonyProxy.java)."""
+
+    def __init__(self, coordinator_url: str):
+        self._channel = rpc_util.make_channel(
+            coordinator_url, rpc_util.MAX_REGISTRATION_MESSAGE)
+        self._stub = rpc_util.Stub(self._channel, "RemoteKeyCeremonyService")
+
+    def register_trustee(self, guardian_id: str, remote_url: str):
+        return self._stub.call("registerTrustee",
+                               pb.msg("RegisterKeyCeremonyTrusteeRequest")(
+                                   guardian_id=guardian_id,
+                                   remote_url=remote_url))
+
+    def close(self):
+        self._channel.close()
+
+
+class KeyCeremonyTrusteeServer:
+    """One guardian process: registers, then serves the trustee rpcs."""
+
+    def __init__(self, group: GroupContext, guardian_id: str,
+                 coordinator_url: str, out_dir: Optional[str] = None,
+                 port: int = 0, host: str = "localhost"):
+        self.group = group
+        self.guardian_id = guardian_id
+        self.out_dir = out_dir
+        self.trustee: Optional[KeyCeremonyTrustee] = None
+        self._all_ok: Optional[bool] = None
+        self._done = threading.Event()
+
+        self.server, self.port = rpc_util.make_server(port)
+        self.url = f"{host}:{self.port}"
+        self.server.add_generic_rpc_handlers((rpc_util.generic_service(
+            "RemoteKeyCeremonyTrusteeService",
+            {"sendPublicKeys": self._send_public_keys,
+             "receivePublicKeys": self._receive_public_keys,
+             "sendSecretKeyShare": self._send_secret_key_share,
+             "receiveSecretKeyShare": self._receive_secret_key_share,
+             "challengeShare": self._challenge_share,
+             "receiveChallengedShare": self._receive_challenged_share,
+             "saveState": self._save_state,
+             "finish": self._finish}),))
+        self.server.start()
+
+        # register with the coordinator; it assigns our x-coordinate
+        reg = RemoteKeyCeremonyProxy(coordinator_url)
+        try:
+            resp = reg.register_trustee(guardian_id, self.url)
+        finally:
+            reg.close()
+        if resp.error:
+            self.server.stop(grace=0)
+            raise RuntimeError(f"registration failed: {resp.error}")
+        self.x_coordinate = int(resp.x_coordinate)
+        self.quorum = int(resp.quorum)
+        self.trustee = KeyCeremonyTrustee(group, guardian_id,
+                                          self.x_coordinate, self.quorum)
+        log.info("trustee %s registered: x=%d quorum=%d url=%s",
+                 guardian_id, self.x_coordinate, self.quorum, self.url)
+
+    # ---- rpc impls ---------------------------------------------------
+    def _send_public_keys(self, request, context):
+        keys = self.trustee.send_public_keys()
+        if isinstance(keys, Result):
+            return pb.msg("PublicKeySet")(error=keys.error)
+        return pb.msg("PublicKeySet")(
+            guardian_id=keys.guardian_id, x_coordinate=keys.x_coordinate,
+            coefficient_commitments=[serialize.publish_p(k)
+                                     for k in keys.coefficient_commitments],
+            coefficient_proofs=[serialize.publish_schnorr(p)
+                                for p in keys.coefficient_proofs])
+
+    def _receive_public_keys(self, request, context):
+        Resp = pb.msg("BoolResponse")
+        try:
+            keys = PublicKeys(
+                request.guardian_id, int(request.x_coordinate),
+                tuple(serialize.import_p(self.group, k)
+                      for k in request.coefficient_commitments),
+                tuple(serialize.import_schnorr(self.group, p)
+                      for p in request.coefficient_proofs))
+        except ValueError as e:
+            return Resp(ok=False, error=f"malformed keys: {e}")
+        res = self.trustee.receive_public_keys(keys)
+        return Resp(ok=res.ok, error=res.error)
+
+    def _send_secret_key_share(self, request, context):
+        share = self.trustee.send_secret_key_share(
+            request.designated_guardian_id)
+        if isinstance(share, Result):
+            return pb.msg("PartialKeyBackup")(error=share.error)
+        return pb.msg("PartialKeyBackup")(
+            generating_guardian_id=share.generating_guardian_id,
+            designated_guardian_id=share.designated_guardian_id,
+            designated_guardian_x=share.designated_guardian_x,
+            encrypted_coordinate=serialize.publish_hashed_ciphertext(
+                share.encrypted_coordinate))
+
+    def _receive_secret_key_share(self, request, context):
+        Resp = pb.msg("BoolResponse")
+        try:
+            share = SecretKeyShare(
+                request.generating_guardian_id,
+                request.designated_guardian_id,
+                int(request.designated_guardian_x),
+                serialize.import_hashed_ciphertext(
+                    self.group, request.encrypted_coordinate))
+        except ValueError as e:
+            return Resp(ok=False, error=f"malformed share: {e}")
+        res = self.trustee.receive_secret_key_share(share)
+        return Resp(ok=res.ok, error=res.error)
+
+    def _challenge_share(self, request, context):
+        resp = self.trustee.challenge_share(request.challenger_guardian_id)
+        if isinstance(resp, Result):
+            return pb.msg("PartialKeyChallengeResponse")(error=resp.error)
+        return pb.msg("PartialKeyChallengeResponse")(
+            generating_guardian_id=resp.generating_guardian_id,
+            designated_guardian_id=resp.designated_guardian_id,
+            coordinate=serialize.publish_q(resp.coordinate))
+
+    def _receive_challenged_share(self, request, context):
+        Resp = pb.msg("BoolResponse")
+        try:
+            resp = KeyShareChallengeResponse(
+                request.generating_guardian_id,
+                request.designated_guardian_id,
+                serialize.import_q(self.group, request.coordinate))
+        except ValueError as e:
+            return Resp(ok=False, error=f"malformed challenge response: {e}")
+        res = self.trustee.receive_challenged_share(resp)
+        return Resp(ok=res.ok, error=res.error)
+
+    def _save_state(self, request, context):
+        out = request.out_dir or self.out_dir
+        if not out:
+            return pb.msg("BoolResponse")(ok=False,
+                                          error="no output dir configured")
+        res = self.trustee.save_state(out)
+        return pb.msg("BoolResponse")(ok=res.ok, error=res.error)
+
+    def _finish(self, request, context):
+        self._all_ok = bool(request.all_ok)
+        self._done.set()
+        return pb.msg("BoolResponse")(ok=True)
+
+    # ------------------------------------------------------------------
+    def wait_until_finished(self, timeout: Optional[float] = None) -> Optional[bool]:
+        """Block until the coordinator calls finish (reference:
+        blockUntilShutdown, RunRemoteTrustee.java:141-172)."""
+        if not self._done.wait(timeout):
+            return None
+        self.server.stop(grace=1)
+        return self._all_ok
+
+    def shutdown(self):
+        self._done.set()
+        self.server.stop(grace=0)

@@ -1,0 +1,96 @@
+"""gRPC plumbing over runtime protobuf descriptors.
+
+No generated ``*_pb2_grpc.py`` stubs exist (the image has bare protoc only,
+see electionguard_tpu.publish.pb) — services and client stubs are built
+directly from the service descriptors, so the .proto files remain the single
+contract.  Mirrors the reference's transport settings: plaintext channels,
+per-destination channel, 51 MB max message for trustee data planes and 2 KB
+for registration (reference: RemoteTrusteeProxy.java:30,249-252,
+RemoteKeyCeremonyProxy.java:27).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Callable
+
+import grpc
+from google.protobuf import message_factory
+
+from electionguard_tpu.publish import pb
+
+MAX_TRUSTEE_MESSAGE = 51 * 1000 * 1000   # key exchange / batch decrypt plane
+MAX_REGISTRATION_MESSAGE = 2000          # registration plane
+
+
+def _method_classes(method_desc):
+    req = message_factory.GetMessageClass(method_desc.input_type)
+    resp = message_factory.GetMessageClass(method_desc.output_type)
+    return req, resp
+
+
+def generic_service(service_name: str,
+                    impls: dict[str, Callable]) -> grpc.GenericRpcHandler:
+    """Build a generic handler for ``service_name`` from ``{method: fn}``
+    where fn(request_msg, context) -> response_msg."""
+    svc = pb.service_descriptor(service_name)
+    handlers = {}
+    for m in svc.methods:
+        if m.name not in impls:
+            raise ValueError(f"missing impl for {service_name}.{m.name}")
+        req_cls, _ = _method_classes(m)
+        handlers[m.name] = grpc.unary_unary_rpc_method_handler(
+            impls[m.name],
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda msg: msg.SerializeToString())
+    return grpc.method_handlers_generic_handler(svc.full_name, handlers)
+
+
+class Stub:
+    """Client stub for one service over one channel: ``stub.call(name, req)``."""
+
+    def __init__(self, channel: grpc.Channel, service_name: str):
+        svc = pb.service_descriptor(service_name)
+        self._methods = {}
+        for m in svc.methods:
+            req_cls, resp_cls = _method_classes(m)
+            self._methods[m.name] = channel.unary_unary(
+                f"/{svc.full_name}/{m.name}",
+                request_serializer=lambda msg: msg.SerializeToString(),
+                response_deserializer=resp_cls.FromString)
+
+    def call(self, method: str, request, timeout: float = 60.0):
+        return self._methods[method](request, timeout=timeout)
+
+
+def make_channel(url: str, max_message: int = MAX_TRUSTEE_MESSAGE,
+                 keepalive_ms: int = 60_000) -> grpc.Channel:
+    """Plaintext channel with the reference's size/keepalive settings."""
+    return grpc.insecure_channel(url, options=[
+        ("grpc.max_receive_message_length", max_message),
+        ("grpc.max_send_message_length", max_message),
+        ("grpc.keepalive_time_ms", keepalive_ms),
+    ])
+
+
+def make_server(port: int, max_message: int = MAX_TRUSTEE_MESSAGE,
+                max_workers: int = 8) -> tuple[grpc.Server, int]:
+    """Server on ``port`` (0 = pick a free one); returns (server, port)."""
+    from concurrent import futures
+
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[("grpc.max_receive_message_length", max_message),
+                 ("grpc.max_send_message_length", max_message)])
+    bound = server.add_insecure_port(f"[::]:{port}")
+    if bound == 0:
+        raise OSError(f"could not bind port {port}")
+    return server, bound
+
+
+def find_free_port() -> int:
+    """Probe a free TCP port (the reference probes with ServerSocket —
+    RunRemoteTrustee.java:126-136)."""
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]

@@ -108,7 +108,15 @@ class Verifier:
                    "guardian count mismatch")
 
     def _v2_guardian_keys(self, res):
+        quorum = self.init.config.quorum
         for gr in self.init.guardians:
+            if (len(gr.coefficient_commitments) != quorum
+                    or len(gr.coefficient_proofs) != quorum):
+                res.record("V2.guardian_keys", False,
+                           f"{gr.guardian_id} has "
+                           f"{len(gr.coefficient_commitments)} commitments /"
+                           f" {len(gr.coefficient_proofs)} proofs, expected "
+                           f"quorum={quorum} of each")
             for j, (k, pr) in enumerate(zip(gr.coefficient_commitments,
                                             gr.coefficient_proofs)):
                 if pr.public_key != k:
@@ -153,7 +161,57 @@ class Verifier:
         manifest_sels = {(c.object_id, s.object_id)
                          for c in self.init.config.manifest.contests
                          for s in c.selections}
+        manifest_contests = {c.object_id: c
+                             for c in self.init.config.manifest.contests}
         for b in ballots:
+            # structural soundness per ballot: no duplicate contests, and
+            # within each contest the non-placeholder selections must match
+            # the manifest contest's selection set EXACTLY (duplicates or
+            # omissions would add/remove votes while every proof still
+            # verifies), with exactly votes_allowed placeholders.
+            contest_ids = [c.contest_id for c in b.contests]
+            if len(set(contest_ids)) != len(contest_ids):
+                res.record("V4.selection_proofs", False,
+                           f"{b.ballot_id}: duplicate contest ids")
+            try:
+                style_contests = {
+                    c.object_id for c in
+                    self.init.config.manifest.contests_for_style(
+                        b.ballot_style_id)}
+                if set(contest_ids) != style_contests:
+                    res.record("V4.selection_proofs", False,
+                               f"{b.ballot_id}: contests do not match "
+                               f"ballot style {b.ballot_style_id}")
+            except StopIteration:
+                res.record("V4.selection_proofs", False,
+                           f"{b.ballot_id}: unknown ballot style "
+                           f"{b.ballot_style_id}")
+            for c in b.contests:
+                desc = manifest_contests.get(c.contest_id)
+                if desc is None:
+                    res.record("V4.selection_proofs", False,
+                               f"{b.ballot_id}: contest {c.contest_id} not "
+                               f"in manifest")
+                    continue
+                real_ids = [s.selection_id for s in c.selections
+                            if not s.is_placeholder]
+                want_ids = {s.object_id for s in desc.selections}
+                if len(set(real_ids)) != len(real_ids):
+                    res.record("V4.selection_proofs", False,
+                               f"{b.ballot_id}/{c.contest_id}: duplicate "
+                               f"selection ids")
+                if set(real_ids) != want_ids:
+                    res.record("V4.selection_proofs", False,
+                               f"{b.ballot_id}/{c.contest_id}: selections "
+                               f"do not match the manifest exactly")
+                ph_ids = [s.selection_id for s in c.selections
+                          if s.is_placeholder]
+                if (len(ph_ids) != desc.votes_allowed
+                        or len(set(ph_ids)) != len(ph_ids)):
+                    res.record("V4.selection_proofs", False,
+                               f"{b.ballot_id}/{c.contest_id}: expected "
+                               f"{desc.votes_allowed} distinct placeholders,"
+                               f" got {len(ph_ids)}")
             for c in b.contests:
                 for s in c.selections:
                     # the placeholder flag must be consistent with the id:
@@ -352,71 +410,12 @@ class Verifier:
         res.record("V10.lagrange", True)
 
         cast_count = dr.tally_result.encrypted_tally.cast_ballot_count
-
+        labels = {"direct": "V8.direct_proofs", "comp": "V9.compensated",
+                  "lagrange": "V10.lagrange",
+                  "combine": "V11.share_combination"}
+        self._verify_tally_shares(res, dr.decrypted_tally, avail, labels)
         for c in dr.decrypted_tally.contests:
             for s in c.selections:
-                A, B = s.message.pad, s.message.data
-                m_total = g.ONE_MOD_P
-                for share in s.shares:
-                    gr = guardians.get(share.guardian_id)
-                    if gr is None:
-                        res.record("V8.direct_proofs", False,
-                                   f"share from unknown guardian "
-                                   f"{share.guardian_id}")
-                        continue
-                    if share.proof is not None:  # direct share
-                        if not share.proof.is_valid(
-                                g.G_MOD_P, gr.coefficient_commitments[0],
-                                A, share.share, qbar):
-                            res.record("V8.direct_proofs", False,
-                                       f"direct proof {share.guardian_id} on "
-                                       f"{s.selection_id} invalid")
-                    else:  # reconstructed missing share (V9/V10)
-                        if share.recovered_parts is None:
-                            res.record("V9.compensated", False,
-                                       f"missing share {share.guardian_id} "
-                                       f"has no parts")
-                            continue
-                        recon = g.ONE_MOD_P
-                        for t_id, part in share.recovered_parts.items():
-                            t_rec = avail.get(t_id)
-                            if t_rec is None:
-                                res.record("V9.compensated", False,
-                                           f"part from non-participant {t_id}")
-                                continue
-                            expected_recovery = commitment_product(
-                                g, gr.coefficient_commitments,
-                                t_rec.x_coordinate)
-                            if part.recovered_public_key_share != \
-                                    expected_recovery:
-                                res.record("V9.compensated", False,
-                                           f"recovery key {t_id} for "
-                                           f"{share.guardian_id} wrong")
-                            if not part.proof.is_valid(
-                                    g.G_MOD_P,
-                                    part.recovered_public_key_share,
-                                    A, part.partial_decryption, qbar):
-                                res.record("V9.compensated", False,
-                                           f"compensated proof {t_id} for "
-                                           f"{share.guardian_id} invalid")
-                            recon = g.mult_p(recon, g.pow_p(
-                                part.partial_decryption,
-                                avail[t_id].lagrange_coefficient))
-                        if recon != share.share:
-                            res.record("V10.lagrange", False,
-                                       f"reconstruction of "
-                                       f"{share.guardian_id} on "
-                                       f"{s.selection_id} mismatched")
-                    m_total = g.mult_p(m_total, share.share)
-                # V11: B / Π Mᵢ == recorded value == g^t
-                value = g.div_p(B, m_total)
-                if value != s.value:
-                    res.record("V11.share_combination", False,
-                               f"decrypted value mismatch {s.selection_id}")
-                if g.g_pow_p(g.int_to_q(s.tally)) != s.value:
-                    res.record("V11.share_combination", False,
-                               f"g^t != value for {s.selection_id}")
-                # V12: sanity
                 if cast_count and s.tally > cast_count:
                     res.record("V12.tally_decode", False,
                                f"tally {s.tally} exceeds cast ballots")
@@ -425,17 +424,100 @@ class Verifier:
         res.record("V11.share_combination", True)
         res.record("V12.tally_decode", True)
 
+    def _verify_tally_shares(self, res, tally, avail, labels):
+        """Share/proof/combination checks for one decrypted tally — used for
+        the main tally (V8-V11) and each spoiled ballot (V13)."""
+        g = self.group
+        qbar = self.init.extended_base_hash
+        guardians = {gr.guardian_id: gr for gr in self.init.guardians}
+        for c in tally.contests:
+            for s in c.selections:
+                A, B = s.message.pad, s.message.data
+                m_total = g.ONE_MOD_P
+                for share in s.shares:
+                    gr = guardians.get(share.guardian_id)
+                    if gr is None:
+                        res.record(labels["direct"], False,
+                                   f"share from unknown guardian "
+                                   f"{share.guardian_id}")
+                        continue
+                    if share.proof is not None:  # direct share
+                        if not share.proof.is_valid(
+                                g.G_MOD_P, gr.coefficient_commitments[0],
+                                A, share.share, qbar):
+                            res.record(labels["direct"], False,
+                                       f"direct proof {share.guardian_id} on "
+                                       f"{s.selection_id} invalid")
+                    else:  # reconstructed missing share
+                        if share.recovered_parts is None:
+                            res.record(labels["comp"], False,
+                                       f"missing share {share.guardian_id} "
+                                       f"has no parts")
+                            continue
+                        recon = g.ONE_MOD_P
+                        for t_id, part in share.recovered_parts.items():
+                            t_rec = avail.get(t_id)
+                            if t_rec is None:
+                                res.record(labels["comp"], False,
+                                           f"part from non-participant {t_id}")
+                                continue
+                            expected_recovery = commitment_product(
+                                g, gr.coefficient_commitments,
+                                t_rec.x_coordinate)
+                            if part.recovered_public_key_share != \
+                                    expected_recovery:
+                                res.record(labels["comp"], False,
+                                           f"recovery key {t_id} for "
+                                           f"{share.guardian_id} wrong")
+                            if not part.proof.is_valid(
+                                    g.G_MOD_P,
+                                    part.recovered_public_key_share,
+                                    A, part.partial_decryption, qbar):
+                                res.record(labels["comp"], False,
+                                           f"compensated proof {t_id} for "
+                                           f"{share.guardian_id} invalid")
+                            recon = g.mult_p(recon, g.pow_p(
+                                part.partial_decryption,
+                                t_rec.lagrange_coefficient))
+                        if recon != share.share:
+                            res.record(labels["lagrange"], False,
+                                       f"reconstruction of "
+                                       f"{share.guardian_id} on "
+                                       f"{s.selection_id} mismatched")
+                    m_total = g.mult_p(m_total, share.share)
+                # B / Π Mᵢ == recorded value == g^t
+                value = g.div_p(B, m_total)
+                if value != s.value:
+                    res.record(labels["combine"], False,
+                               f"decrypted value mismatch {s.selection_id}")
+                if g.g_pow_p(g.int_to_q(s.tally)) != s.value:
+                    res.record(labels["combine"], False,
+                               f"g^t != value for {s.selection_id}")
+
     # ==================================================================
     def _v13_spoiled(self, res):
-        # spoiled ballots must not contribute to the tally; their published
-        # decryptions (if any) verified with the same share logic
+        """Spoiled ballots: excluded from the tally (V7 handles that) and
+        any published spoiled-ballot decryption must verify with the same
+        share logic as the main tally."""
         spoiled_ids = {b.ballot_id for b in self.record.encrypted_ballots
                        if b.state == BallotState.SPOILED}
+        dr = self.record.decryption_result
+        avail = ({dg.guardian_id: dg for dg in dr.decrypting_guardians}
+                 if dr is not None else {})
+        labels = {k: "V13.spoiled"
+                  for k in ("direct", "comp", "lagrange", "combine")}
         for t in self.record.spoiled_ballot_tallies:
             if t.tally_id not in spoiled_ids:
                 res.record("V13.spoiled", False,
                            f"spoiled tally {t.tally_id} for non-spoiled "
                            f"ballot")
+                continue
+            if dr is None:
+                res.record("V13.spoiled", False,
+                           f"spoiled tally {t.tally_id} without a "
+                           f"decryption result")
+                continue
+            self._verify_tally_shares(res, t, avail, labels)
         res.record("V13.spoiled", True)
 
     def _v14_coherence(self, res):

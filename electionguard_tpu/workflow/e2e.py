@@ -1,0 +1,191 @@
+"""The 5-phase multi-process workflow driver.
+
+Mirror of the reference's ``RunRemoteWorkflowTest``
+(src/test/java/electionguard/workflow/RunRemoteWorkflowTest.java:83-194):
+
+  1. key ceremony   — coordinator + nguardians guardian processes (gRPC)
+  2. encrypt        — RandomBallotProvider fake ballots + batch encryption
+  3. tally          — homomorphic accumulation
+  4. decrypt        — decryptor + navailable trustee processes (gRPC)
+  5. verify         — full record verification (the ground truth)
+
+Every node is a subprocess on localhost with captured output, exactly the
+reference's multi-node-without-a-cluster mechanism; phases communicate only
+through the election-record directory (the checkpoint system).
+
+Run:  python -m electionguard_tpu.workflow.e2e -out /tmp/eg -nballots 20 \
+          -nguardians 3 -quorum 2 -navailable 2 -group tiny
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from electionguard_tpu.ballot.manifest import (BallotStyle, Candidate,
+                                               ContestDescription,
+                                               GeopoliticalUnit, Manifest,
+                                               Party, SelectionDescription)
+from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+from electionguard_tpu.cli.common import setup_logging
+from electionguard_tpu.publish.publisher import Publisher
+from electionguard_tpu.remote.rpc_util import find_free_port
+from electionguard_tpu.workflow.run_command import RunCommand, wait_all
+
+
+def sample_manifest(ncontests: int = 1, nselections: int = 2) -> Manifest:
+    contests = []
+    candidates = []
+    for c in range(ncontests):
+        sels = []
+        for s in range(nselections):
+            cid = f"cand-{c}-{s}"
+            candidates.append(Candidate(cid, f"Candidate {c}/{s}"))
+            sels.append(SelectionDescription(f"contest{c}-sel{s}", s, cid))
+        contests.append(ContestDescription(
+            f"contest-{c}", c, "gp-0", "one_of_m", 1,
+            f"Contest {c}", tuple(sels)))
+    return Manifest(
+        election_scope_id="e2e-election", spec_version="tpu-1.0",
+        start_date="2026-07-01", end_date="2026-07-29",
+        geopolitical_units=(GeopoliticalUnit("gp-0", "District 0"),),
+        parties=(Party("party-0", "The Party"),),
+        candidates=tuple(candidates),
+        contests=tuple(contests),
+        ballot_styles=(BallotStyle("style-0", ("gp-0",)),),
+    )
+
+
+def main(argv=None) -> int:
+    log = setup_logging("RunRemoteWorkflow")
+    ap = argparse.ArgumentParser("RunRemoteWorkflow")
+    ap.add_argument("-out", dest="output", required=True,
+                    help="working dir (record + process logs)")
+    ap.add_argument("-nballots", type=int, default=20)
+    ap.add_argument("-nguardians", type=int, default=3)
+    ap.add_argument("-quorum", type=int, default=2)
+    ap.add_argument("-navailable", type=int, default=2)
+    ap.add_argument("-ncontests", type=int, default=1)
+    ap.add_argument("-nselections", type=int, default=2)
+    ap.add_argument("-group", choices=["production", "tiny"],
+                    default="tiny")
+    ap.add_argument("-keep", action="store_true",
+                    help="keep going past failures and dump all output")
+    args = ap.parse_args(argv)
+
+    out = args.output
+    record_dir = os.path.join(out, "record")
+    ballots_dir = os.path.join(out, "plaintext_ballots")
+    cmd_out = os.path.join(out, "logs")
+    trustee_dir = os.path.join(record_dir, "private", "trustees")
+    os.makedirs(record_dir, exist_ok=True)
+    os.makedirs(ballots_dir, exist_ok=True)
+    group_flags = ["-group", args.group]
+    t_all = time.time()
+    procs: list[RunCommand] = []
+
+    def phase_fail(name, cmds):
+        for c in cmds:
+            c.show()
+        log.error("phase %s FAILED", name)
+        return 1
+
+    # ---- phase 0: write the manifest -------------------------------------
+    manifest = sample_manifest(args.ncontests, args.nselections)
+    input_dir = os.path.join(out, "input")
+    os.makedirs(input_dir, exist_ok=True)
+    with open(os.path.join(input_dir, "manifest.json"), "w") as f:
+        f.write(manifest.to_json())
+
+    # ---- phase 1: key ceremony (multi-process) ---------------------------
+    t0 = time.time()
+    kc_port = find_free_port()
+    coord = RunCommand.python_module(
+        "keyceremony-coordinator",
+        "electionguard_tpu.cli.run_remote_keyceremony",
+        ["-in", input_dir, "-out", record_dir,
+         "-nguardians", str(args.nguardians), "-quorum", str(args.quorum),
+         "-port", str(kc_port), "-trusteeDir", trustee_dir,
+         "-timeout", "90"] + group_flags,
+        cmd_out)
+    procs.append(coord)
+    time.sleep(1.5)  # let the coordinator bind
+    guardians = []
+    for i in range(args.nguardians):
+        guardians.append(RunCommand.python_module(
+            f"guardian-{i}", "electionguard_tpu.cli.run_remote_trustee",
+            ["-name", f"guardian-{i}", "-serverPort", str(kc_port),
+             "-out", trustee_dir] + group_flags,
+            cmd_out))
+    procs.extend(guardians)
+    if not wait_all([coord] + guardians, timeout=180):
+        return phase_fail("key-ceremony", [coord] + guardians)
+    log.info("[1] key ceremony took %.1fs", time.time() - t0)
+
+    # ---- phase 2: fake ballots + batch encryption ------------------------
+    t0 = time.time()
+    pub = Publisher(out)
+    for b in RandomBallotProvider(manifest, args.nballots, seed=11).ballots():
+        pub.write_plaintext_ballot("plaintext_ballots", b)
+    enc = RunCommand.python_module(
+        "batch-encryption", "electionguard_tpu.cli.run_batch_encryption",
+        ["-in", record_dir, "-ballots", ballots_dir, "-out", record_dir,
+         "-fixedNonces"] + group_flags,
+        cmd_out)
+    if not wait_all([enc], timeout=600):
+        return phase_fail("encryption", [enc])
+    dt = time.time() - t0
+    log.info("[2] encrypted %d ballots in %.1fs (%.3fs/ballot)",
+             args.nballots, dt, dt / max(args.nballots, 1))
+
+    # ---- phase 3: accumulate --------------------------------------------
+    t0 = time.time()
+    acc = RunCommand.python_module(
+        "accumulate", "electionguard_tpu.cli.run_accumulate_tally",
+        ["-in", record_dir, "-out", record_dir] + group_flags, cmd_out)
+    if not wait_all([acc], timeout=300):
+        return phase_fail("accumulate", [acc])
+    log.info("[3] tally accumulation took %.1fs", time.time() - t0)
+
+    # ---- phase 4: remote decryption (multi-process) ----------------------
+    t0 = time.time()
+    dec_port = find_free_port()
+    decryptor = RunCommand.python_module(
+        "decryptor", "electionguard_tpu.cli.run_remote_decryptor",
+        ["-in", record_dir, "-out", record_dir,
+         "-navailable", str(args.navailable), "-port", str(dec_port),
+         "-timeout", "90"] + group_flags,
+        cmd_out)
+    time.sleep(1.5)
+    dec_trustees = []
+    trustee_files = sorted(os.listdir(trustee_dir))[:args.navailable]
+    for name in trustee_files:
+        dec_trustees.append(RunCommand.python_module(
+            f"dec-{name}", "electionguard_tpu.cli.run_remote_decrypting_trustee",
+            ["-trusteeFile", os.path.join(trustee_dir, name),
+             "-serverPort", str(dec_port)] + group_flags,
+            cmd_out))
+    if not wait_all([decryptor] + dec_trustees, timeout=300):
+        return phase_fail("decryption", [decryptor] + dec_trustees)
+    log.info("[4] decryption took %.1fs", time.time() - t0)
+
+    # ---- phase 5: verify --------------------------------------------------
+    t0 = time.time()
+    ver = RunCommand.python_module(
+        "verifier", "electionguard_tpu.cli.run_verifier",
+        ["-in", record_dir] + group_flags, cmd_out)
+    code = ver.wait_for(timeout=600)
+    ver.show()
+    if code != 0:
+        return phase_fail("verify", [ver])
+    log.info("[5] verification took %.1fs", time.time() - t0)
+
+    log.info("WORKFLOW PASS: 5 phases, %d ballots, %.1fs total",
+             args.nballots, time.time() - t_all)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,95 @@
+"""Async subprocess runner for multi-process workflow tests.
+
+Mirror of the reference's ``RunCommand`` (src/test/java/electionguard/
+workflow/RunCommand.java:19-117): starts a process detached, captures
+stdout/stderr to ``<output_dir>/<name>.std{out,err}`` files, supports
+wait-with-timeout, kill, and ``show()`` dumping the captured output —
+the reference's multi-node-without-a-cluster mechanism (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Optional
+
+
+class RunCommand:
+    def __init__(self, name: str, args: list[str], output_dir: str,
+                 env: Optional[dict] = None):
+        self.name = name
+        self.args = list(args)
+        os.makedirs(output_dir, exist_ok=True)
+        self.stdout_path = os.path.join(output_dir, f"{name}.stdout")
+        self.stderr_path = os.path.join(output_dir, f"{name}.stderr")
+        self._stdout_f = open(self.stdout_path, "wb")
+        self._stderr_f = open(self.stderr_path, "wb")
+        self.process = subprocess.Popen(
+            self.args, stdout=self._stdout_f, stderr=self._stderr_f,
+            env={**os.environ, **(env or {})})
+
+    @staticmethod
+    def python_module(name: str, module: str, flags: list[str],
+                      output_dir: str, env: Optional[dict] = None
+                      ) -> "RunCommand":
+        """Launch ``python -m module flags...`` (the fatJar equivalent)."""
+        return RunCommand(name, [sys.executable, "-m", module] + flags,
+                          output_dir, env)
+
+    def wait_for(self, timeout: float) -> Optional[int]:
+        """Wait up to timeout seconds; returns exit code or None."""
+        try:
+            return self.process.wait(timeout)
+        except subprocess.TimeoutExpired:
+            return None
+
+    def poll(self) -> Optional[int]:
+        return self.process.poll()
+
+    def kill(self):
+        if self.process.poll() is None:
+            self.process.terminate()
+            try:
+                self.process.wait(5)
+            except subprocess.TimeoutExpired:
+                self.process.kill()
+        self._close()
+
+    def _close(self):
+        for f in (self._stdout_f, self._stderr_f):
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    def show(self, stream=sys.stdout):
+        """Dump captured output (reference: RunCommand.show :84-99)."""
+        self._close()
+        print(f"----- {self.name} " + "-" * 40, file=stream)
+        print(f"  args: {' '.join(self.args)}", file=stream)
+        print(f"  exit: {self.process.poll()}", file=stream)
+        for label, path in (("stdout", self.stdout_path),
+                            ("stderr", self.stderr_path)):
+            with open(path, "rb") as f:
+                data = f.read().decode(errors="replace")
+            if data.strip():
+                print(f"  --- {label} ---", file=stream)
+                for line in data.splitlines():
+                    print(f"  {line}", file=stream)
+
+
+def wait_all(commands: list[RunCommand], timeout: float) -> bool:
+    """Wait for all commands; kill stragglers at the deadline."""
+    deadline = time.monotonic() + timeout
+    ok = True
+    for c in commands:
+        remaining = max(0.1, deadline - time.monotonic())
+        code = c.wait_for(remaining)
+        if code is None:
+            c.kill()
+            ok = False
+        elif code != 0:
+            ok = False
+    return ok

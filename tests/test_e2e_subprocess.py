@@ -1,0 +1,29 @@
+"""Subprocess E2E: the full 5-phase workflow as separate OS processes.
+
+The pytest wrapper around electionguard_tpu.workflow.e2e — the reference's
+RunRemoteWorkflowTest equivalent, with a real pass/fail discipline (the
+reference's own harness had a literal "LOOK how do we know if it worked?"
+comment — SURVEY.md §4; here the verifier exit code is the answer).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_five_phase_workflow(tmp_path):
+    env = {k: v for k, v in os.environ.items()
+           if "AXON" not in k and "PALLAS" not in k
+           and not k.startswith("TPU")}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "electionguard_tpu.workflow.e2e",
+         "-out", str(tmp_path), "-nballots", "8", "-nguardians", "3",
+         "-quorum", "2", "-navailable", "2", "-group", "tiny"],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "WORKFLOW PASS" in proc.stdout + proc.stderr

@@ -1,0 +1,95 @@
+"""Serialization + election-record store roundtrip tests."""
+
+import pytest
+
+from electionguard_tpu.publish import pb, serialize
+from electionguard_tpu.publish.publisher import (Consumer, Publisher,
+                                                 election_record_from_consumer)
+from tests.test_workflow_inprocess import election  # noqa: F401  (fixture)
+
+
+def test_primitive_roundtrips(tgroup):
+    g = tgroup
+    q = g.rand_q()
+    assert serialize.import_q(g, serialize.publish_q(q)) == q
+    p = g.g_pow_p(q)
+    assert serialize.import_p(g, serialize.publish_p(p)) == p
+    # wire widths enforced
+    with pytest.raises(ValueError):
+        serialize.import_p(g, pb.ElementModP(value=b"\x00"))
+    with pytest.raises(ValueError):
+        serialize.import_u256(pb.UInt256(value=b"short"))
+
+
+def test_proof_roundtrips(tgroup):
+    from electionguard_tpu.crypto.elgamal import ElGamalKeypair, elgamal_encrypt
+    from electionguard_tpu.crypto.chaum_pedersen import \
+        make_disjunctive_cp_proof
+    from electionguard_tpu.crypto.schnorr import make_schnorr_proof
+    from electionguard_tpu.crypto.hashed_elgamal import hashed_elgamal_encrypt
+    g = tgroup
+    kp = ElGamalKeypair.generate(g)
+    sp = make_schnorr_proof(g, kp.secret_key, kp.public_key, g.rand_q())
+    sp2 = serialize.import_schnorr(g, serialize.publish_schnorr(sp))
+    assert sp2 == sp and sp2.is_valid()
+    n, ctx = g.rand_q(), g.int_to_q(5)
+    ct = elgamal_encrypt(g, 1, n, kp.public_key)
+    ct2 = serialize.import_ciphertext(g, serialize.publish_ciphertext(ct))
+    assert ct2 == ct
+    dp = make_disjunctive_cp_proof(g, ct, n, kp.public_key, ctx, 1, g.rand_q())
+    dp2 = serialize.import_disjunctive_proof(
+        g, serialize.publish_disjunctive_proof(dp))
+    assert dp2 == dp and dp2.is_valid(ct2, kp.public_key, ctx)
+    h = hashed_elgamal_encrypt(g, b"data bytes", g.rand_q(), kp.public_key)
+    h2 = serialize.import_hashed_ciphertext(
+        g, serialize.publish_hashed_ciphertext(h))
+    assert h2 == h
+
+
+def test_record_roundtrip_through_disk(election, tmp_path):  # noqa: F811
+    g = election["group"]
+    pub = Publisher(str(tmp_path / "record"))
+    pub.write_election_initialized(election["init"])
+    n = pub.write_encrypted_ballots(election["encrypted"])
+    assert n == len(election["encrypted"])
+    pub.write_tally_result(election["tally_result"])
+    pub.write_decryption_result(election["decryption_result"])
+
+    cons = Consumer(str(tmp_path / "record"), g)
+    record = election_record_from_consumer(cons)
+    assert record.election_init == election["init"]
+    assert record.encrypted_ballots == election["encrypted"]
+    assert record.tally_result == election["tally_result"]
+    assert record.decryption_result == election["decryption_result"]
+
+
+def test_roundtripped_record_verifies(election, tmp_path):  # noqa: F811
+    from electionguard_tpu.verify.verifier import Verifier
+    g = election["group"]
+    pub = Publisher(str(tmp_path / "record"))
+    pub.write_election_initialized(election["init"])
+    pub.write_encrypted_ballots(election["encrypted"])
+    pub.write_tally_result(election["tally_result"])
+    pub.write_decryption_result(election["decryption_result"])
+    record = election_record_from_consumer(
+        Consumer(str(tmp_path / "record"), g))
+    res = Verifier(record, g).verify()
+    assert res.ok, res.summary()
+
+
+def test_publisher_fail_fast(tmp_path):
+    d = tmp_path / "out"
+    d.mkdir()
+    (d / "junk").write_text("x")
+    with pytest.raises(FileExistsError):
+        Publisher(str(d), create_new=True)
+    Publisher(str(d), create_new=False)  # append mode fine
+
+
+def test_plaintext_ballot_staging(election, tmp_path):  # noqa: F811
+    pub = Publisher(str(tmp_path / "record"))
+    for b in election["ballots"][:3]:
+        pub.write_plaintext_ballot("plaintext_ballots", b)
+    cons = Consumer(str(tmp_path / "record"), election["group"])
+    back = list(cons.iterate_plaintext_ballots("plaintext_ballots"))
+    assert back == sorted(election["ballots"][:3], key=lambda b: b.ballot_id)

@@ -1,0 +1,136 @@
+"""Remote-plane tests: real gRPC servers/clients on localhost.
+
+Exercises the reference's full network topology (SURVEY.md §3.1/§3.2/§3.5)
+— reverse-connection registration, coordinator-assigned x-coordinates,
+pairwise exchange over proxies, batch decryption rpcs — with every node in
+this process (threads) instead of subprocesses; the subprocess version lives
+in the workflow E2E harness.
+"""
+
+import threading
+
+import pytest
+
+from electionguard_tpu.ballot.tally import (EncryptedTally,
+                                            EncryptedTallyContest,
+                                            EncryptedTallySelection)
+from electionguard_tpu.core.dlog import DLog
+from electionguard_tpu.crypto.elgamal import elgamal_encrypt
+from electionguard_tpu.decrypt.decryption import Decryption
+from electionguard_tpu.decrypt.trustee import read_trustee
+from electionguard_tpu.keyceremony.interface import Result
+from electionguard_tpu.publish.election_record import ElectionConfig
+from electionguard_tpu.remote.decrypting_remote import (
+    DecryptingTrusteeServer, DecryptionCoordinator)
+from electionguard_tpu.remote.keyceremony_remote import (
+    KeyCeremonyCoordinator, KeyCeremonyTrusteeServer)
+from tests.test_keyceremony import tiny_manifest
+
+
+@pytest.fixture()
+def remote_ceremony(tgroup, tmp_path):
+    """3 trustee servers + coordinator over real localhost gRPC."""
+    coord = KeyCeremonyCoordinator(tgroup, 3, 2, port=0)
+    servers = []
+    try:
+        for i in range(3):
+            servers.append(KeyCeremonyTrusteeServer(
+                tgroup, f"guardian-{i}", f"localhost:{coord.port}",
+                out_dir=str(tmp_path)))
+        assert coord.wait_for_registrations(timeout=10)
+        results = coord.run_key_ceremony(str(tmp_path))
+        assert not isinstance(results, Result), results
+        yield dict(coord=coord, servers=servers, results=results,
+                   tmp=tmp_path)
+    finally:
+        coord.shutdown(all_ok=True)
+        for s in servers:
+            s.shutdown()
+
+
+def test_remote_key_ceremony(remote_ceremony, tgroup):
+    results = remote_ceremony["results"]
+    servers = remote_ceremony["servers"]
+    # coordinator assigned sequential x coordinates
+    assert sorted(s.x_coordinate for s in servers) == [1, 2, 3]
+    # joint key matches the product of local trustee keys
+    joint = tgroup.mult_p(*(s.trustee.election_public_key for s in servers))
+    assert results.joint_public_key == joint
+    # every trustee holds verified shares from the other two
+    for s in servers:
+        assert len(s.trustee.received_shares) == 2
+    # trustee files were saved remotely
+    for i in range(3):
+        assert (remote_ceremony["tmp"] / f"trustee-guardian-{i}.json").exists()
+
+
+def test_duplicate_registration_rejected(remote_ceremony, tgroup):
+    coord = remote_ceremony["coord"]
+    with pytest.raises(RuntimeError, match="already"):
+        KeyCeremonyTrusteeServer(tgroup, "guardian-0",
+                                 f"localhost:{coord.port}")
+
+
+def test_remote_decryption_with_missing_guardian(remote_ceremony, tgroup):
+    g = tgroup
+    results = remote_ceremony["results"]
+    tmp = remote_ceremony["tmp"]
+    init = results.make_election_initialized(
+        ElectionConfig(tiny_manifest(), 3, 2))
+
+    # encrypt a small tally under the joint key
+    K = init.joint_public_key
+    votes = [5, 2]
+    cts = []
+    for v in votes:
+        acc = None
+        for j in range(5):
+            ct = elgamal_encrypt(g, 1 if j < v else 0, g.rand_q(), K)
+            acc = ct if acc is None else acc.mult(ct)
+        cts.append(acc)
+    tally = EncryptedTally("t", (EncryptedTallyContest(
+        "contest-0", 0, tuple(
+            EncryptedTallySelection(f"sel-{i}", i, ct)
+            for i, ct in enumerate(cts))),), cast_ballot_count=5)
+
+    # guardian-1 is missing; 0 and 2 serve over gRPC
+    coord = DecryptionCoordinator(g, navailable=2, port=0)
+    servers = []
+    try:
+        for i in (0, 2):
+            trustee = read_trustee(g, str(tmp / f"trustee-guardian-{i}.json"))
+            servers.append(DecryptingTrusteeServer(
+                g, trustee, f"localhost:{coord.port}"))
+        assert coord.wait_for_registrations(timeout=10)
+        coord.mark_started()
+        d = Decryption(g, init, coord.proxies, ["guardian-1"],
+                       DLog(g, max_exponent=10))
+        out = d.decrypt(tally)
+        got = [s.tally for s in out.contests[0].selections]
+        assert got == votes
+        # missing guardian share was reconstructed over the wire
+        for s in out.contests[0].selections:
+            ids = {sh.guardian_id for sh in s.shares}
+            assert "guardian-1" in ids
+    finally:
+        coord.shutdown(all_ok=True)
+        for s in servers:
+            s.shutdown()
+
+
+def test_finish_releases_trustee(tgroup, tmp_path):
+    coord = KeyCeremonyCoordinator(tgroup, 1, 1, port=0)
+    server = KeyCeremonyTrusteeServer(
+        tgroup, "solo", f"localhost:{coord.port}", out_dir=str(tmp_path))
+    assert coord.wait_for_registrations(timeout=10)
+    results = coord.run_key_ceremony(str(tmp_path))
+    assert not isinstance(results, Result)
+
+    waiter = {}
+    th = threading.Thread(
+        target=lambda: waiter.setdefault(
+            "ok", server.wait_until_finished(timeout=15)))
+    th.start()
+    coord.shutdown(all_ok=True)
+    th.join(timeout=20)
+    assert waiter.get("ok") is True

@@ -181,3 +181,69 @@ def test_verifier_catches_placeholder_flip(election):
     # caught by the id/flag consistency check and/or the broken ballot code
     assert (not res.checks["V4.selection_proofs"]
             or not res.checks["V6.ballot_chaining"])
+
+
+def test_verifier_catches_duplicated_selection(election):
+    """A contest carrying the same selection twice (double vote) must fail
+    the exact-match structural check."""
+    import dataclasses
+    record = ElectionRecord(
+        election_init=election["init"],
+        encrypted_ballots=list(election["encrypted"]),
+        tally_result=election["tally_result"],
+        decryption_result=election["decryption_result"])
+    b = record.encrypted_ballots[1]
+    c = b.contests[0]
+    real = next(s for s in c.selections if not s.is_placeholder)
+    doubled = dataclasses.replace(
+        b, contests=(dataclasses.replace(
+            c, selections=c.selections + (real,)),))
+    record.encrypted_ballots[1] = doubled
+    res = Verifier(record, election["group"]).verify()
+    assert not res.ok
+    assert not res.checks["V4.selection_proofs"]
+
+
+def test_encryptor_rejects_duplicate_selection(election):
+    from electionguard_tpu.ballot.plaintext import (PlaintextBallot,
+                                                    PlaintextBallotContest,
+                                                    PlaintextBallotSelection)
+    g = election["group"]
+    enc = BatchEncryptor(election["init"], g)
+    dup = PlaintextBallot("dup", "style-0", (PlaintextBallotContest(
+        "contest-0", (PlaintextBallotSelection("sel-0", 1),
+                      PlaintextBallotSelection("sel-0", 0))),))
+    out, invalid = enc.encrypt_ballots([dup], seed=g.int_to_q(8))
+    assert not out and len(invalid) == 1
+    assert "duplicate selection" in invalid[0][1]
+
+
+def test_spoiled_tally_forgery_detected(election):
+    """A fabricated spoiled-ballot decryption must fail V13."""
+    import dataclasses
+    from electionguard_tpu.ballot.ciphertext import BallotState
+    spoiled = dataclasses.replace(election["encrypted"][0],
+                                  state=BallotState.SPOILED)
+    ballots = [spoiled] + list(election["encrypted"][1:])
+    # forge a tally claiming arbitrary values with garbage shares
+    from electionguard_tpu.ballot.tally import (PlaintextTally,
+                                                PlaintextTallyContest,
+                                                PlaintextTallySelection,
+                                                PartialDecryption)
+    g = election["group"]
+    c0 = spoiled.contests[0]
+    forged = PlaintextTally(spoiled.ballot_id, (PlaintextTallyContest(
+        c0.contest_id, tuple(
+            PlaintextTallySelection(
+                s.selection_id, 1, g.G_MOD_P, s.ciphertext,
+                (PartialDecryption("guardian-0", g.G_MOD_P, None, {}),))
+            for s in c0.selections)),))
+    record = ElectionRecord(
+        election_init=election["init"],
+        encrypted_ballots=ballots,
+        tally_result=election["tally_result"],
+        decryption_result=election["decryption_result"],
+        spoiled_ballot_tallies=[forged])
+    res = Verifier(record, g).verify()
+    assert not res.ok
+    assert not res.checks["V13.spoiled"]
