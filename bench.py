@@ -1,0 +1,83 @@
+"""Driver benchmark: ballots verified+tallied per second per chip.
+
+Measures the BASELINE.md north-star path on the production 4096-bit group:
+batch verification of encrypted ballots (subgroup membership + disjunctive
+Chaum-Pedersen selection proofs + contest limit proofs + code chain +
+homomorphic tally aggregation — Verifier V4-V7) over the device batch plane.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is value / (1M ballots / 60 s / 8 chips) — the driver target
+"verify 1M encrypted ballots in <60 s on a v5e-8" (BASELINE.json); >1.0
+means the target rate is met on this chip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    nballots = int(os.environ.get("BENCH_NBALLOTS", "256"))
+    t_setup = time.time()
+
+    from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+    from electionguard_tpu.core.group import production_group
+    from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+    from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+    from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+    from electionguard_tpu.publish.election_record import (ElectionConfig,
+                                                           ElectionRecord)
+    from electionguard_tpu.tally.accumulate import accumulate_ballots
+    from electionguard_tpu.verify.verifier import Verifier
+    from electionguard_tpu.workflow.e2e import sample_manifest
+
+    import jax
+    n_chips = max(1, len(jax.devices()))
+
+    g = production_group()
+    manifest = sample_manifest(ncontests=1, nselections=2)
+    trustees = [KeyCeremonyTrustee(g, "guardian-0", 1, 1)]
+    init = key_ceremony_exchange(trustees, g).make_election_initialized(
+        ElectionConfig(manifest, 1, 1), {"created_by": "bench"})
+
+    ballots = list(RandomBallotProvider(manifest, nballots, seed=1).ballots())
+    enc = BatchEncryptor(init, g)
+    t0 = time.time()
+    encrypted, invalid = enc.encrypt_ballots(ballots, seed=g.int_to_q(42))
+    t_encrypt = time.time() - t0
+    assert not invalid and len(encrypted) == nballots
+    tally_result = accumulate_ballots(init, encrypted)
+
+    record = ElectionRecord(election_init=init, encrypted_ballots=encrypted,
+                            tally_result=tally_result)
+
+    t_setup = time.time() - t_setup  # election build + encrypt + tally
+
+    # warmup pass compiles every kernel at the measured shapes
+    res = Verifier(record, g).verify()
+    assert res.ok, res.summary()
+    t0 = time.time()
+    res = Verifier(record, g).verify()
+    t_verify = time.time() - t0
+    assert res.ok, res.summary()
+
+    ballots_per_sec_per_chip = nballots / t_verify / n_chips
+    target = 1_000_000 / 60.0 / 8  # 1M ballots / 60 s / v5e-8
+    print(json.dumps({
+        "metric": "ballots_verified_tallied_per_sec_per_chip",
+        "value": round(ballots_per_sec_per_chip, 3),
+        "unit": "ballots/s/chip",
+        "vs_baseline": round(ballots_per_sec_per_chip / target, 5),
+    }))
+    print(f"# nballots={nballots} chips={n_chips} "
+          f"encrypt={t_encrypt:.2f}s ({nballots / t_encrypt:.1f}/s) "
+          f"verify={t_verify:.2f}s setup={t_setup:.1f}s "
+          f"platform={jax.devices()[0].platform}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

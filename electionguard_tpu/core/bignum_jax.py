@@ -288,3 +288,18 @@ def powmod(ctx: MontCtx, base: jax.Array, exp: jax.Array,
            exp_bits: int) -> jax.Array:
     """Canonical-domain batched base^exp mod p."""
     return from_mont(ctx, mont_pow(ctx, to_mont(ctx, base), exp, exp_bits))
+
+
+def mont_prod_tree(ctx: MontCtx, x: jax.Array) -> jax.Array:
+    """Log-depth Montgomery product over axis 0: (M, ..., n) mont-domain
+    values -> (..., n) mont-domain product.  Odd levels pad with mont(1);
+    exact shape program per static M."""
+    m = x.shape[0]
+    while m > 1:
+        if m % 2 == 1:
+            pad = jnp.broadcast_to(ctx.r_mod_p, (1,) + x.shape[1:])
+            x = jnp.concatenate([x, pad], axis=0)
+            m += 1
+        x = montmul(ctx, x[0::2], x[1::2])
+        m //= 2
+    return x[0]

@@ -115,22 +115,10 @@ class JaxGroupOps:
         return bn.powmod(self.ctx, base, exp, self.exp_bits)
 
     def _prod_reduce_impl(self, x: jax.Array) -> jax.Array:
-        """Product over axis 0 of (M, B, n) canonical values -> (B, n).
-
-        Log-depth Montgomery tree: M->M/2->...->1, padding odd levels with
-        mont(1).  Exact shape program per static M.
-        """
+        """Product over axis 0 of (M, B, n) canonical values -> (B, n),
+        via the log-depth Montgomery tree (bignum_jax.mont_prod_tree)."""
         ctx = self.ctx
-        x = bn.to_mont(ctx, x)
-        m = x.shape[0]
-        while m > 1:
-            if m % 2 == 1:
-                pad = jnp.broadcast_to(ctx.r_mod_p, (1,) + x.shape[1:])
-                x = jnp.concatenate([x, pad], axis=0)
-                m += 1
-            x = bn.montmul(ctx, x[0::2], x[1::2])
-            m //= 2
-        return bn.from_mont(ctx, x[0])
+        return bn.from_mont(ctx, bn.mont_prod_tree(ctx, bn.to_mont(ctx, x)))
 
     def _verify_residue_impl(self, x: jax.Array, q_exp: jax.Array) -> jax.Array:
         """Subgroup membership: 0 < x < p and x^q == 1, batched.

@@ -1,0 +1,16 @@
+"""Multi-chip plane: device meshes + shard_map'd batch kernels.
+
+SURVEY.md §5.7/§5.8: the workload is embarrassingly parallel over ballots
+with one log-depth multiplicative reduction, so the mesh story is a ``dp``
+batch axis plus an optional ``wp`` window axis for fixed-base
+exponentiation; cross-chip combines ride ICI via ``lax.all_gather``.
+"""
+
+from electionguard_tpu.parallel.mesh import (DP_AXIS, WP_AXIS, election_mesh,
+                                             single_device_mesh)
+from electionguard_tpu.parallel.sharded import ShardedGroupOps, sharded_ops
+
+__all__ = [
+    "DP_AXIS", "WP_AXIS", "election_mesh", "single_device_mesh",
+    "ShardedGroupOps", "sharded_ops",
+]

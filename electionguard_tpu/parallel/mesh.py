@@ -1,0 +1,57 @@
+"""Device-mesh construction for the multi-chip batch plane.
+
+The reference's only scale-out devices are an 11-thread CPU pool and batched
+rpcs (reference: src/test/java/electionguard/workflow/RunRemoteWorkflowTest.java:140,180
+and SURVEY.md §2.10); it has no collectives.  Our scale axis is the same —
+ballots × contests × selections — mapped onto a JAX ``Mesh``:
+
+* ``dp`` (data parallel): the flattened selection/ballot batch axis.  Every
+  hot op (modexp, residue check, proof-commitment recompute) is elementwise
+  over this axis, so it shards with zero communication.
+* ``wp`` (window parallel): the 8-bit windows of fixed-base (PowRadix)
+  exponentiation.  Each chip holds a slice of the precomputed table, computes
+  the Montgomery product of its windows, and the partial products are
+  combined with a log-depth all-gather product over ICI — the tensor-parallel
+  analogue for exponentiation.
+
+The homomorphic tally product-reduce contracts the ``dp`` axis with the same
+all-gather + local-tree combine (SURVEY.md §5.7: "one log-depth reduction").
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DP_AXIS = "dp"
+WP_AXIS = "wp"
+
+
+def election_mesh(n_devices: Optional[int] = None,
+                  wp: int = 1,
+                  devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a ``(dp, wp)`` mesh over ``n_devices`` (default: all devices).
+
+    ``wp`` devices cooperate on each fixed-base exponentiation window set;
+    the remaining factor shards the batch.  ``wp=1`` is pure data parallel —
+    the right default for this workload (SURVEY.md §5.7).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices > len(devices):
+        raise ValueError(
+            f"asked for {n_devices} devices, have {len(devices)}")
+    if n_devices % wp != 0:
+        raise ValueError(f"wp={wp} must divide n_devices={n_devices}")
+    dev = np.asarray(devices[:n_devices]).reshape(n_devices // wp, wp)
+    return Mesh(dev, (DP_AXIS, WP_AXIS))
+
+
+def single_device_mesh() -> Mesh:
+    """1×1 mesh: lets the sharded code path run unchanged on one chip."""
+    return election_mesh(1, 1)

@@ -1,0 +1,229 @@
+"""Sharded batch kernels: ``shard_map`` versions of the group hot ops.
+
+This is the second communication plane SURVEY.md §5.8 calls for — XLA
+collectives over ICI inside the coordinator's pod — layered on the same limb
+kernels as the single-chip path (electionguard_tpu.core.bignum_jax).  The
+gRPC plane (electionguard_tpu.remote) stays the trust boundary; nothing here
+ever touches guardian secrets, only ciphertexts, shares, and proofs
+(reference boundary: src/main/proto/decrypting_trustee_rpc.proto:15-45).
+
+Sharding layout
+---------------
+* batch ops (``powmod``, ``mulmod``, ``fixed_pow``, ``is_valid_residue``):
+  batch axis sharded over ``dp`` — elementwise, zero communication.
+* ``fixed_pow`` additionally splits the PowRadix windows over ``wp``: each
+  device multiplies together the table rows for its window slice, then the
+  per-device partials are combined with an all-gather + log-tree Montgomery
+  product (`lax.all_gather` over ``wp`` rides ICI).
+* ``prod_reduce`` (homomorphic tally): the ballot axis is sharded over
+  ``dp``; each device reduces its shard with a local log-depth Montgomery
+  tree, then combines partials across ``dp`` the same way.  This is the
+  multiplicative analogue of ``psum`` (SURVEY.md §5.7).
+
+All entry points pad the batch to a multiple of the mesh and slice the
+padding back off, so callers never see the mesh shape.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map as _shard_map
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+# check_vma=False: kernel bodies create fresh zero-carries inside lax.scan
+# (bignum_jax.montmul), which the varying-manual-axes checker would reject
+# even though every output is honestly dp-varying.
+shard_map = functools.partial(_shard_map, check_vma=False)
+
+from electionguard_tpu.core import bignum_jax as bn
+from electionguard_tpu.parallel.mesh import DP_AXIS, WP_AXIS
+
+
+def _pad_rows(x: np.ndarray | jax.Array, mult: int, fill_row) -> jax.Array:
+    """Pad axis 0 of ``x`` up to a multiple of ``mult`` with ``fill_row``."""
+    b = x.shape[0]
+    rem = (-b) % mult
+    if rem == 0:
+        return jnp.asarray(x)
+    pad = jnp.broadcast_to(jnp.asarray(fill_row), (rem,) + x.shape[1:])
+    return jnp.concatenate([jnp.asarray(x), pad], axis=0)
+
+
+class ShardedGroupOps:
+    """Mesh-parallel twin of ``JaxGroupOps`` — same public array API, so the
+    verifier/tally/encrypt paths swap it in without code changes.
+
+    Wraps a ``JaxGroupOps`` (whose Montgomery context and PowRadix tables it
+    reuses) plus a ``Mesh`` from ``electionguard_tpu.parallel.mesh``.
+    """
+
+    def __init__(self, ops, mesh: Mesh):
+        self.ops = ops
+        self.group = ops.group
+        self.mesh = mesh
+        self.ndp = mesh.shape[DP_AXIS]
+        self.nwp = mesh.shape[WP_AXIS]
+        if ops.nwin8 % self.nwp != 0:
+            raise ValueError(
+                f"wp={self.nwp} must divide nwin8={ops.nwin8}")
+        self.ctx = ops.ctx
+        self._one_p = np.zeros(ops.n, np.uint32)
+        self._one_p[0] = 1
+        self._zero_q = np.zeros(ops.ne, np.uint32)
+        self._powmod_j = self._build_elementwise(
+            functools.partial(bn.powmod, ops.ctx, exp_bits=ops.exp_bits))
+        self._mulmod_j = self._build_elementwise(
+            functools.partial(bn.mulmod, ops.ctx))
+        self._residue_j = self._build_elementwise(ops._verify_residue_impl)
+        self._fixed_pow_j = self._build_fixed_pow()
+        self._prod_reduce_j = self._build_prod_reduce()
+
+    # -- codecs delegate to the single-chip plane ----------------------
+    def to_limbs_p(self, xs):
+        return self.ops.to_limbs_p(xs)
+
+    def to_limbs_q(self, xs):
+        return self.ops.to_limbs_q(xs)
+
+    def from_limbs(self, arr):
+        return self.ops.from_limbs(arr)
+
+    def fixed_table(self, base: int):
+        return self.ops.fixed_table(base)
+
+    @property
+    def g_table(self):
+        return self.ops.g_table
+
+    # ------------------------------------------------------------------
+    def _build_elementwise(self, fn):
+        """shard_map an elementwise batch kernel over dp (wp replicated)."""
+        mapped = shard_map(
+            fn, mesh=self.mesh,
+            in_specs=(P(DP_AXIS), P(DP_AXIS)),
+            out_specs=P(DP_AXIS))
+        return jax.jit(mapped)
+
+    def _build_fixed_pow(self):
+        ops = self.ops
+        ctx = ops.ctx
+        local_wins = ops.nwin8 // self.nwp
+
+        def local_partial(table, digits):
+            # table: (local_wins, 256, n); digits: (b_loc, local_wins)
+            acc = None
+            for i in range(local_wins):
+                sel = table[i][digits[:, i]]            # (b_loc, n)
+                acc = sel if acc is None else bn.montmul(ctx, acc, sel)
+            return acc
+
+        def kernel(table, digits):
+            partial = local_partial(table, digits)      # mont domain
+            # combine window partials across wp: all-gather + local tree
+            parts = lax.all_gather(partial, WP_AXIS)    # (nwp, b_loc, n)
+            return bn.from_mont(ctx, bn.mont_prod_tree(ctx, parts))
+
+        mapped = shard_map(
+            kernel, mesh=self.mesh,
+            in_specs=(P(WP_AXIS), P(DP_AXIS, WP_AXIS)),
+            out_specs=P(DP_AXIS))
+        return jax.jit(mapped)
+
+    def _build_prod_reduce(self):
+        ctx = self.ops.ctx
+
+        def kernel(x):                                  # (m_loc, B, n)
+            partial = bn.mont_prod_tree(ctx, bn.to_mont(ctx, x))
+            parts = lax.all_gather(partial, DP_AXIS)    # (ndp, B, n)
+            return bn.from_mont(ctx, bn.mont_prod_tree(ctx, parts))
+
+        mapped = shard_map(
+            kernel, mesh=self.mesh,
+            in_specs=(P(DP_AXIS),),
+            out_specs=P())
+        return jax.jit(mapped)
+
+    # ------------------------------------------------------------------
+    # public array API (mirrors JaxGroupOps)
+    # ------------------------------------------------------------------
+    def powmod(self, base, exp):
+        b = base.shape[0]
+        base_p = _pad_rows(base, self.ndp, self._one_p)
+        exp_p = _pad_rows(exp, self.ndp, self._zero_q)
+        return self._powmod_j(base_p, exp_p)[:b]
+
+    def mulmod(self, a, b_arr):
+        b = a.shape[0]
+        a_p = _pad_rows(a, self.ndp, self._one_p)
+        b_p = _pad_rows(b_arr, self.ndp, self._one_p)
+        return self._mulmod_j(a_p, b_p)[:b]
+
+    def is_valid_residue(self, x):
+        x = jnp.asarray(x)
+        b = x.shape[0]
+        x_p = _pad_rows(x, self.ndp, self._one_p)
+        q_p = jnp.broadcast_to(
+            jnp.asarray(bn.int_to_limbs(self.group.q, self.ops.ne)),
+            (x_p.shape[0], self.ops.ne))
+        return self._residue_j(x_p, q_p)[:b]
+
+    def _digits8(self, exp: jax.Array) -> jax.Array:
+        """(B, ne) 16-bit limbs -> (B, nwin8) 8-bit window digit indices."""
+        lo = (exp & jnp.uint32(0xFF)).astype(jnp.int32)
+        hi = (exp >> 8).astype(jnp.int32)
+        digits = jnp.stack([lo, hi], axis=-1).reshape(exp.shape[0], -1)
+        return digits[:, :self.ops.nwin8]  # 2*ne may exceed nwin8
+
+    def _fixed_pow(self, table, exp):
+        b = exp.shape[0]
+        digits = self._digits8(jnp.asarray(exp))
+        digits = _pad_rows(digits, self.ndp,
+                           np.zeros(self.ops.nwin8, np.int32))
+        return self._fixed_pow_j(table, digits)[:b]
+
+    def g_pow(self, exp):
+        return self._fixed_pow(self.ops.g_table, exp)
+
+    def base_pow(self, base: int, exp):
+        return self._fixed_pow(self.ops.fixed_table(base), exp)
+
+    def prod_reduce(self, x):
+        """Product over axis 0: (M, B, n) -> (B, n), dp-sharded over M."""
+        x = jnp.asarray(x)
+        x_p = _pad_rows(x, self.ndp,
+                        jnp.broadcast_to(jnp.asarray(self._one_p),
+                                         x.shape[1:]))
+        return self._prod_reduce_j(x_p)
+
+    # -- int-facing convenience (parity with JaxGroupOps) --------------
+    def powmod_ints(self, bases, exps):
+        return self.from_limbs(
+            self.powmod(self.to_limbs_p(bases), self.to_limbs_q(exps)))
+
+    def mulmod_ints(self, a, b):
+        return self.from_limbs(
+            self.mulmod(self.to_limbs_p(a), self.to_limbs_p(b)))
+
+    def g_pow_ints(self, exps):
+        return self.from_limbs(self.g_pow(self.to_limbs_q(exps)))
+
+    def prod_ints(self, xs):
+        arr = np.stack([self.to_limbs_p(row) for row in xs])
+        return self.from_limbs(self.prod_reduce(arr))
+
+
+def sharded_ops(group, mesh: Optional[Mesh] = None) -> ShardedGroupOps:
+    """Sharded batch plane for ``group`` over ``mesh`` (default: all
+    devices, pure data parallel)."""
+    from electionguard_tpu.core.group_jax import jax_ops
+    from electionguard_tpu.parallel.mesh import election_mesh
+    if mesh is None:
+        mesh = election_mesh()
+    return ShardedGroupOps(jax_ops(group), mesh)

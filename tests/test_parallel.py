@@ -1,0 +1,103 @@
+"""Sharded plane vs single-chip plane: identical results on an 8-device
+virtual CPU mesh (conftest forces xla_force_host_platform_device_count=8)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from electionguard_tpu.core.group_jax import jax_ops
+from electionguard_tpu.parallel import (ShardedGroupOps, election_mesh,
+                                        single_device_mesh)
+
+
+@pytest.fixture(scope="module")
+def meshes():
+    n = len(jax.devices())
+    assert n == 8, f"conftest should provide 8 virtual devices, got {n}"
+    return {
+        "dp8": election_mesh(8, wp=1),
+        "dp4wp2": election_mesh(8, wp=2),
+        "single": single_device_mesh(),
+    }
+
+
+@pytest.fixture(scope="module")
+def tops(tgroup):
+    return jax_ops(tgroup)
+
+
+def _rand_elems(group, rng, k):
+    # random subgroup members g^e (valid residues)
+    return [pow(group.g, int(e), group.p)
+            for e in rng.integers(1, group.q, size=k)]
+
+
+@pytest.mark.parametrize("mesh_name", ["dp8", "dp4wp2", "single"])
+@pytest.mark.parametrize("batch", [8, 16, 5])  # 5 exercises padding
+def test_sharded_powmod_matches(tgroup, tops, meshes, mesh_name, batch):
+    rng = np.random.default_rng(42)
+    sops = ShardedGroupOps(tops, meshes[mesh_name])
+    bases = _rand_elems(tgroup, rng, batch)
+    exps = [int(e) for e in rng.integers(0, tgroup.q, size=batch)]
+    want = [pow(b, e, tgroup.p) for b, e in zip(bases, exps)]
+    got = sops.powmod_ints(bases, exps)
+    assert got == want
+
+
+@pytest.mark.parametrize("mesh_name", ["dp8", "dp4wp2"])
+def test_sharded_g_pow_matches(tgroup, tops, meshes, mesh_name):
+    rng = np.random.default_rng(7)
+    sops = ShardedGroupOps(tops, meshes[mesh_name])
+    exps = [int(e) for e in rng.integers(0, tgroup.q, size=11)]
+    want = [pow(tgroup.g, e, tgroup.p) for e in exps]
+    assert sops.g_pow_ints(exps) == want
+
+
+@pytest.mark.parametrize("mesh_name", ["dp8", "dp4wp2"])
+def test_sharded_base_pow_matches(tgroup, tops, meshes, mesh_name):
+    rng = np.random.default_rng(3)
+    sops = ShardedGroupOps(tops, meshes[mesh_name])
+    K = pow(tgroup.g, 12345 % tgroup.q, tgroup.p)
+    exps = [int(e) for e in rng.integers(0, tgroup.q, size=9)]
+    want = [pow(K, e, tgroup.p) for e in exps]
+    got = sops.from_limbs(sops.base_pow(K, sops.to_limbs_q(exps)))
+    assert got == want
+
+
+@pytest.mark.parametrize("mesh_name", ["dp8", "dp4wp2", "single"])
+@pytest.mark.parametrize("m", [8, 16, 13])  # 13 exercises dp padding
+def test_sharded_prod_reduce_matches(tgroup, tops, meshes, mesh_name, m):
+    rng = np.random.default_rng(5)
+    sops = ShardedGroupOps(tops, meshes[mesh_name])
+    cols = 3
+    rows = [_rand_elems(tgroup, rng, cols) for _ in range(m)]
+    want = [1] * cols
+    for row in rows:
+        want = [w * x % tgroup.p for w, x in zip(want, row)]
+    assert sops.prod_ints(rows) == want
+
+
+def test_sharded_mulmod_and_residue(tgroup, tops, meshes):
+    rng = np.random.default_rng(11)
+    sops = ShardedGroupOps(tops, meshes["dp8"])
+    a = _rand_elems(tgroup, rng, 10)
+    b = _rand_elems(tgroup, rng, 10)
+    want = [x * y % tgroup.p for x, y in zip(a, b)]
+    assert sops.mulmod_ints(a, b) == want
+    # residues: subgroup members and 1 valid; p-1 (order 2) and 0 invalid
+    xs = a + [tgroup.p - 1, 1, 0]
+    ok = np.asarray(sops.is_valid_residue(sops.to_limbs_p(xs)))
+    assert ok[:10].all() and ok[11]
+    assert not ok[10] and not ok[12]
+
+
+def test_output_sharding_is_distributed(tgroup, tops, meshes):
+    """The dp-sharded powmod output must actually live sharded on the mesh
+    (not gathered to one device) so downstream stages stay distributed."""
+    rng = np.random.default_rng(13)
+    sops = ShardedGroupOps(tops, meshes["dp8"])
+    bases = _rand_elems(tgroup, rng, 16)
+    exps = [int(e) for e in rng.integers(0, tgroup.q, size=16)]
+    out = sops.powmod(sops.to_limbs_p(bases), sops.to_limbs_q(exps))
+    assert len(out.sharding.device_set) == 8
