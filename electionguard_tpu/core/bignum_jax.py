@@ -107,21 +107,36 @@ def make_mont_ctx(p: int, n: int | None = None) -> MontCtx:
 # carry handling
 # ---------------------------------------------------------------------------
 
+def _shift_up(hi: jax.Array) -> jax.Array:
+    """Move per-limb carries one limb towards the MSB (drop the top one —
+    it must be zero by construction; moduli leave headroom)."""
+    return jnp.pad(hi[..., :-1], [(0, 0)] * (hi.ndim - 1) + [(1, 0)])
+
+
 def normalize(t: jax.Array) -> jax.Array:
     """Carry-propagate a redundant limb vector (..., m) to canonical 16-bit
-    limbs.  Values < 2**32 in; each pass moves carries one limb up; loops
-    until no limb exceeds 16 bits (2-3 passes in practice)."""
+    limbs.  Values < 2**32 in.  Exact and data-independent: two ripple
+    passes bound every limb by 2**16, then a log-depth carry-lookahead
+    (Kogge-Stone over the limb axis) resolves arbitrarily long 0xFFFF
+    ripple chains — no ``while_loop``, no cross-batch predicate reduction,
+    safe for adversarial inputs."""
+    # pass 1: limbs < 2**32 -> <= 2**17 - 2
+    t = (t & MASK16) + _shift_up(t >> 16)
+    # pass 2: limbs <= 2**17 - 2 -> <= 2**16
+    t = (t & MASK16) + _shift_up(t >> 16)
+    # carry-lookahead: generate g_i = (limb == 2**16), propagate
+    # p_i = (limb == 0xFFFF); carry into i+1 = g_i | (p_i & c_i).
+    g = (t >> 16).astype(jnp.uint32)          # 0/1
+    p = (t == MASK16)
 
-    def has_carry(t):
-        return jnp.any(t > MASK16)
+    def combine(left, right):
+        gl, pl = left
+        gr, pr = right
+        return gr | (pr.astype(jnp.uint32) & gl), pl & pr
 
-    def one_pass(t):
-        lo = t & MASK16
-        hi = t >> 16
-        return lo.at[..., 1:].add(hi[..., :-1])
-        # top-limb carry must be zero by construction (moduli leave headroom)
-
-    return lax.while_loop(has_carry, one_pass, t)
+    G, _ = lax.associative_scan(combine, (g, p), axis=-1)
+    c = _shift_up(G)                          # exclusive prefix: carry into i
+    return (t + c) & MASK16
 
 
 def _sub_p(t: jax.Array, p_limbs: jax.Array):

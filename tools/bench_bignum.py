@@ -1,0 +1,80 @@
+"""Microbenchmark for the bignum data plane: montmul / powmod / fixed_pow.
+
+Times the primitive batch kernels at production shapes so kernel work can be
+iterated on without a full bench.py run.  Usage:
+
+    python tools/bench_bignum.py [--batch 512] [--ops powmod,fixed,mulmod]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _timeit(fn, *args, reps=3):
+    out = fn(*args)
+    jax.block_until_ready(out)          # warmup/compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--ops", default="mulmod,powmod,fixed,residue")
+    args = ap.parse_args()
+    B = args.batch
+    which = set(args.ops.split(","))
+
+    from electionguard_tpu.core import bignum_jax as bn
+    from electionguard_tpu.core.group import production_group
+    from electionguard_tpu.core.group_jax import jax_ops
+
+    g = production_group()
+    ops = jax_ops(g)
+    rng = np.random.default_rng(0)
+
+    exps = [int.from_bytes(rng.bytes(32), "big") % g.q for _ in range(B)]
+    bases = [pow(g.g, e | 1, g.p) for e in exps[: min(B, 64)]]
+    bases = (bases * (B // len(bases) + 1))[:B]
+    A = jnp.asarray(ops.to_limbs_p(bases))
+    E = jnp.asarray(ops.to_limbs_q(exps))
+
+    print(f"platform={jax.devices()[0].platform} batch={B} "
+          f"n={ops.n} limbs x 16b")
+
+    if "mulmod" in which:
+        dt = _timeit(ops._mulmod_j, A, A)
+        print(f"mulmod : {dt*1e3:8.2f} ms  "
+              f"{B/dt:12.0f} el/s  {dt/B*1e9:8.0f} ns/el")
+    if "powmod" in which:
+        dt = _timeit(ops._powmod_j, A, E)
+        print(f"powmod : {dt*1e3:8.2f} ms  "
+              f"{B/dt:12.0f} el/s  {dt/B*1e6:8.1f} us/el")
+    if "fixed" in which:
+        dt = _timeit(ops._fixed_pow_j, ops.g_table, E)
+        print(f"g_pow  : {dt*1e3:8.2f} ms  "
+              f"{B/dt:12.0f} el/s  {dt/B*1e6:8.1f} us/el")
+    if "residue" in which:
+        q_exp = jnp.broadcast_to(
+            jnp.asarray(bn.int_to_limbs(g.q, ops.ne)), (B, ops.ne))
+        dt = _timeit(ops._verify_residue_j, A, q_exp)
+        print(f"residue: {dt*1e3:8.2f} ms  "
+              f"{B/dt:12.0f} el/s  {dt/B*1e6:8.1f} us/el")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
