@@ -27,6 +27,7 @@ closed over per-group constants by ``JaxGroupOps``
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -238,9 +239,15 @@ def to_mont(ctx: MontCtx, a: jax.Array) -> jax.Array:
     return montmul(ctx, a, jnp.broadcast_to(ctx.r2_mod_p, a.shape))
 
 
-def from_mont(ctx: MontCtx, a: jax.Array) -> jax.Array:
+def from_mont_via(mul, a: jax.Array) -> jax.Array:
+    """Montgomery-domain exit a·R^{-1} mod p through any backend's
+    Montgomery multiplier ``mul``."""
     one = jnp.zeros_like(a).at[..., 0].set(U32(1))
-    return montmul(ctx, a, one)
+    return mul(a, one)
+
+
+def from_mont(ctx: MontCtx, a: jax.Array) -> jax.Array:
+    return from_mont_via(functools.partial(montmul, ctx), a)
 
 
 def mulmod(ctx: MontCtx, a: jax.Array, b: jax.Array) -> jax.Array:
@@ -254,7 +261,7 @@ def mulmod(ctx: MontCtx, a: jax.Array, b: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 def mont_pow(ctx: MontCtx, base_mont: jax.Array, exp: jax.Array,
-             exp_bits: int) -> jax.Array:
+             exp_bits: int, montmul_fn=None, montsqr_fn=None) -> jax.Array:
     """Batched modexp in the Montgomery domain.
 
     base_mont: (..., n) Montgomery-domain bases.
@@ -263,16 +270,20 @@ def mont_pow(ctx: MontCtx, base_mont: jax.Array, exp: jax.Array,
     Returns Montgomery-domain base^exp.
 
     Fixed 4-bit windows, MSB-first scan: acc = acc^16 · table[window].
+    ``montmul_fn`` / ``montsqr_fn`` plug in an alternative Montgomery
+    multiplier over the same limb format (the MXU NTT engine of
+    electionguard_tpu.core.ntt_mxu); default is the VPU CIOS kernel.
     """
-    n = ctx.n
-    batch_shape = base_mont.shape[:-1]
+    mul = montmul_fn if montmul_fn is not None else \
+        functools.partial(montmul, ctx)
+    sqr = montsqr_fn if montsqr_fn is not None else (lambda a: mul(a, a))
     nwin = (exp_bits + 3) // 4
 
     # table[d] = base^d in Montgomery domain, d = 0..15: (16, ..., n)
     one_mont = jnp.broadcast_to(ctx.r_mod_p, base_mont.shape)
 
     def build_row(carry, _):
-        nxt = montmul(ctx, carry, base_mont)
+        nxt = mul(carry, base_mont)
         return nxt, carry
 
     _, table = lax.scan(build_row, one_mont, None, length=16)
@@ -284,14 +295,14 @@ def mont_pow(ctx: MontCtx, base_mont: jax.Array, exp: jax.Array,
     def step(acc, w):
         # acc^16
         for _ in range(4):
-            acc = montmul(ctx, acc, acc)
+            acc = sqr(acc)
         limb = exp[..., w // 4]            # (...,) uint32 16-bit limb
         digit = (limb >> ((w % 4) * 4)) & U32(0xF)
         # gather table[digit] per batch element
         sel = jnp.take_along_axis(
             table, digit[None, ..., None].astype(jnp.int32),
             axis=0)[0]                     # (..., n)
-        acc = montmul(ctx, acc, sel)
+        acc = mul(acc, sel)
         return acc, None
 
     acc0 = jnp.broadcast_to(ctx.r_mod_p, base_mont.shape)  # mont(1)
@@ -300,21 +311,28 @@ def mont_pow(ctx: MontCtx, base_mont: jax.Array, exp: jax.Array,
 
 
 def powmod(ctx: MontCtx, base: jax.Array, exp: jax.Array,
-           exp_bits: int) -> jax.Array:
+           exp_bits: int, montmul_fn=None, montsqr_fn=None) -> jax.Array:
     """Canonical-domain batched base^exp mod p."""
-    return from_mont(ctx, mont_pow(ctx, to_mont(ctx, base), exp, exp_bits))
+    mul = montmul_fn if montmul_fn is not None else \
+        functools.partial(montmul, ctx)
+    r2 = jnp.broadcast_to(ctx.r2_mod_p, base.shape)
+    acc = mont_pow(ctx, mul(base, r2), exp, exp_bits,
+                   montmul_fn=montmul_fn, montsqr_fn=montsqr_fn)
+    return from_mont_via(mul, acc)
 
 
-def mont_prod_tree(ctx: MontCtx, x: jax.Array) -> jax.Array:
+def mont_prod_tree(ctx: MontCtx, x: jax.Array, montmul_fn=None) -> jax.Array:
     """Log-depth Montgomery product over axis 0: (M, ..., n) mont-domain
     values -> (..., n) mont-domain product.  Odd levels pad with mont(1);
     exact shape program per static M."""
+    mul = montmul_fn if montmul_fn is not None else \
+        functools.partial(montmul, ctx)
     m = x.shape[0]
     while m > 1:
         if m % 2 == 1:
             pad = jnp.broadcast_to(ctx.r_mod_p, (1,) + x.shape[1:])
             x = jnp.concatenate([x, pad], axis=0)
             m += 1
-        x = montmul(ctx, x[0::2], x[1::2])
+        x = mul(x[0::2], x[1::2])
         m //= 2
     return x[0]

@@ -22,6 +22,8 @@ sharding axis for multi-chip meshes (see electionguard_tpu.parallel).
 from __future__ import annotations
 
 import functools
+import os
+import warnings
 from typing import Iterable, Sequence
 
 import jax
@@ -29,20 +31,50 @@ import jax.numpy as jnp
 import numpy as np
 
 from electionguard_tpu.core import bignum_jax as bn
+from electionguard_tpu.core import ntt_mxu
 from electionguard_tpu.core.group import GroupContext
+
+
+def _default_backend() -> str:
+    """MXU NTT engine on TPU, VPU CIOS elsewhere; override with
+    EGTPU_BIGNUM=ntt|cios."""
+    env = os.environ.get("EGTPU_BIGNUM", "auto").lower()
+    if env in ("ntt", "cios"):
+        return env
+    return "ntt" if jax.default_backend() == "tpu" else "cios"
 
 
 class JaxGroupOps:
     """Batch plane for one ``GroupContext``.  Thread-compatible, stateless
-    after construction (all tables are device constants)."""
+    after construction (all tables are device constants).
 
-    def __init__(self, group: GroupContext):
+    ``backend`` selects the Montgomery multiplier: "cios" (VPU lax.scan
+    kernel, bignum_jax) or "ntt" (MXU int8-matmul engine, ntt_mxu); both
+    share the R = 2^4096 Montgomery domain and limb format."""
+
+    def __init__(self, group: GroupContext, backend: str | None = None):
         self.group = group
         p = group.p
         self.n = (p.bit_length() + 15) // 16          # p limbs (256 prod)
         self.ne = (group.q.bit_length() + 15) // 16   # exponent limbs (16)
         self.exp_bits = group.q.bit_length()
         self.ctx = bn.make_mont_ctx(p, self.n)
+        self.backend = backend or _default_backend()
+        if self.backend not in ("ntt", "cios"):
+            raise ValueError(f"unknown bignum backend {self.backend!r}; "
+                             "expected 'ntt' or 'cios'")
+        if self.backend == "ntt" and self.n != ntt_mxu.NL:
+            # the MXU engine is built for the 4096-bit production group
+            warnings.warn(f"ntt backend requires {ntt_mxu.NL}-limb groups; "
+                          f"falling back to cios for {self.n}-limb group")
+            self.backend = "cios"
+        if self.backend == "ntt":
+            nctx = ntt_mxu.make_ntt_ctx(p)
+            self._mm = functools.partial(ntt_mxu.montmul, nctx)
+            self._ms = functools.partial(ntt_mxu.montsqr, nctx)
+        else:
+            self._mm = functools.partial(bn.montmul, self.ctx)
+            self._ms = None
         R = 1 << (16 * self.n)
         self._R = R
 
@@ -53,7 +85,7 @@ class JaxGroupOps:
 
         # jitted entry points
         self._powmod_j = jax.jit(self._powmod_impl)
-        self._mulmod_j = jax.jit(functools.partial(bn.mulmod, self.ctx))
+        self._mulmod_j = jax.jit(self._mulmod_impl)
         self._fixed_pow_j = jax.jit(self._fixed_pow_impl)
         self._prod_reduce_j = jax.jit(self._prod_reduce_impl)
         self._verify_residue_j = jax.jit(self._verify_residue_impl)
@@ -99,26 +131,32 @@ class JaxGroupOps:
 
     def _fixed_pow_impl(self, table: jax.Array, exp: jax.Array) -> jax.Array:
         """Canonical base^exp for a fixed-base table; exp (B, ne) limbs."""
-        ctx = self.ctx
         acc = None
         for w in range(self.nwin8):
             limb = exp[..., w // 2]
             digit = ((limb >> ((w % 2) * 8)) & jnp.uint32(0xFF)).astype(jnp.int32)
             sel = table[w][digit]          # (B, n) gather over 256 rows
-            acc = sel if acc is None else bn.montmul(ctx, acc, sel)
-        return bn.from_mont(ctx, acc)
+            acc = sel if acc is None else self._mm(acc, sel)
+        return bn.from_mont_via(self._mm, acc)
 
     # ------------------------------------------------------------------
     # op implementations
     # ------------------------------------------------------------------
+    def _mulmod_impl(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        return self._mm(self._mm(a, b),
+                        jnp.broadcast_to(self.ctx.r2_mod_p, a.shape))
+
     def _powmod_impl(self, base: jax.Array, exp: jax.Array) -> jax.Array:
-        return bn.powmod(self.ctx, base, exp, self.exp_bits)
+        return bn.powmod(self.ctx, base, exp, self.exp_bits,
+                         montmul_fn=self._mm, montsqr_fn=self._ms)
 
     def _prod_reduce_impl(self, x: jax.Array) -> jax.Array:
         """Product over axis 0 of (M, B, n) canonical values -> (B, n),
         via the log-depth Montgomery tree (bignum_jax.mont_prod_tree)."""
-        ctx = self.ctx
-        return bn.from_mont(ctx, bn.mont_prod_tree(ctx, bn.to_mont(ctx, x)))
+        r2 = jnp.broadcast_to(self.ctx.r2_mod_p, x.shape)
+        acc = bn.mont_prod_tree(self.ctx, self._mm(x, r2),
+                                montmul_fn=self._mm)
+        return bn.from_mont_via(self._mm, acc)
 
     def _verify_residue_impl(self, x: jax.Array, q_exp: jax.Array) -> jax.Array:
         """Subgroup membership: 0 < x < p and x^q == 1, batched.
@@ -127,7 +165,8 @@ class JaxGroupOps:
         ``ElementModP.is_valid_residue`` so non-canonical limb encodings
         (e.g. x = p + 1) are rejected, not silently reduced."""
         in_range = bn.is_lt(x, self.ctx.p_limbs) & jnp.any(x != 0, axis=-1)
-        y = bn.powmod(self.ctx, x, q_exp, self.group.q.bit_length())
+        y = bn.powmod(self.ctx, x, q_exp, self.group.q.bit_length(),
+                      montmul_fn=self._mm, montsqr_fn=self._ms)
         one = jnp.zeros_like(y).at[..., 0].set(jnp.uint32(1))
         return in_range & jnp.all(y == one, axis=-1)
 

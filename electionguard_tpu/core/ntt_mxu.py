@@ -1,0 +1,384 @@
+"""MXU Montgomery engine: 4096-bit modular multiplication as int8 matmuls.
+
+This is the TPU-native answer to the reference's hot layer (JVM BigInteger
+under ``ProductionElementModP`` — reference call sites:
+src/main/java/electionguard/util/ConvertCommonProto.java:46,55 [ext]) for
+TPU generations where the MXU dwarfs the VPU.  The VPU CIOS kernel
+(electionguard_tpu.core.bignum_jax) remains the portable/differential twin;
+both share the same (B, 256)-uint32 16-bit-limb interface and the same
+Montgomery domain R = 2^4096, so PowRadix tables and all callers are
+backend-agnostic.
+
+Math design (all steps exact, no floating point)
+------------------------------------------------
+* Numbers are polynomials in base 256: 512 8-bit digits.  A 4096x4096-bit
+  product is a length-1023 convolution whose coefficients are bounded by
+  512*255^2 < 2^25 — they accumulate EXACTLY in int32.
+* Convolution of two *varying* operands is bilinear, so it cannot be one
+  matmul; we evaluate both operands with a number-theoretic transform
+  (length-1024 NTT = dense matmul with a shared Vandermonde-of-roots
+  matrix), multiply pointwise, and interpolate back.  Two NTT primes
+  m1 = 12289, m2 = 13313 (both ≡ 1 mod 1024, product > 2^27 > max
+  coefficient) give the true coefficients by CRT.
+* Matmuls run on the MXU in int8: matrix entries are centered residues
+  split into two balanced digit planes (lo ∈ [-128,127], hi = the
+  carry plane, |hi| ≤ 26), inputs are digits-minus-128 ("e-form", one
+  int8 plane) with the +128 offset folded into precomputed column-sum
+  vectors.  Every partial matmul is ≤ 1024*128*128 = 2^24 — exact in
+  int32 accumulation.
+* Montgomery reduction needs T_low·p' mod R and m1·p — both have one
+  FIXED operand (p' = -p^{-1} mod R, p), so they are plain Toeplitz
+  matmuls, no NTT.  The unsigned-offset cross terms reduce to cumulative
+  sums (VPU) and host-precomputed vectors.
+* Mod-m reductions on the VPU use Barrett with constants exhaustively
+  validated over the full input domain (see tests/test_ntt_mxu.py):
+  (a=13,b=13) has max deficit 2 for x < 2^26; (a=14,b=13) max deficit 3
+  for x < 2^28.  Pointwise products use 16-bit Montgomery reduction with
+  the 2^-16 factor folded into the inverse-NTT matrix.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from electionguard_tpu.core import bignum_jax as bn
+
+NL = 256          # 16-bit limbs per 4096-bit element
+ND = 512          # 8-bit digits
+NC = 1024         # convolution / NTT length
+PRIMES = (12289, 13313)          # ≡ 1 (mod 1024); product 1.636e8 > 2^27
+OMEGA = {12289: 10302, 13313: 10076}   # primitive 1024th roots of unity
+
+U32 = jnp.uint32
+I32 = jnp.int32
+
+
+class NttCtx(NamedTuple):
+    """Device constants for one modulus p (plus the shared CIOS context)."""
+
+    mctx: bn.MontCtx
+    V0: jax.Array        # (2, NC, NC) int8 forward-NTT lo digit plane
+    V1: jax.Array        # (2, NC, NC) int8 forward-NTT hi digit plane
+    iV0: jax.Array       # (2, NC, NC) int8 inverse-NTT lo plane (scaled)
+    iV1: jax.Array       # (2, NC, NC) int8 inverse-NTT hi plane
+    evoff0: jax.Array    # (2, 1, NC) int32  128·colsum(V0) + bias (mult of m)
+    evoff1: jax.Array    # (2, 1, NC) int32  128·colsum(V1) + bias
+    ivoff0: jax.Array    # (2, 1, NC) int32  128·colsum(iV0) + bias
+    ivoff1: jax.Array    # (2, 1, NC) int32  128·colsum(iV1) + bias
+    toep_m: jax.Array    # (ND, ND) int8   Toeplitz of p'e, low half
+    f_m: jax.Array       # (ND,) int32     fixed offset terms for m1
+    toep_p: jax.Array    # (ND, NC) int8   Toeplitz of pe, full product
+    f_p: jax.Array       # (NC,) int32     fixed offset terms for m1·p
+    p_pad: jax.Array     # (NL + 2,) uint32  p in 16-bit limbs, padded
+    # static python ints (hashable; ctx is closed over, not traced)
+    m: tuple             # (m1, m2)
+    mprime: tuple        # -m^{-1} mod 2^16 per prime
+    mu26: tuple          # floor(2^26/m) per prime   (barrett a=13,b=13)
+    mu27: tuple          # floor(2^27/m) per prime   (barrett a=14,b=13)
+    bias1: tuple         # eval stage-1 bias (multiple of m)
+    bias0: tuple         # eval stage-0 bias
+    biasc: tuple         # interp C-stage bias
+    biasb: tuple         # interp B-stage bias
+    biasa: tuple         # interp A-stage bias
+    inv12s: int          # m1^{-1}·2^16 mod m2 (for CRT via mredc16)
+
+
+# ---------------------------------------------------------------------------
+# host-side construction
+# ---------------------------------------------------------------------------
+
+def _digit_planes(mat: np.ndarray, m: int):
+    """Centered residues mod m -> two balanced int8 planes (lo, hi) with
+    lo ∈ [-128,127], hi = (v+128)//256, v = lo + 256·hi."""
+    v = mat % m
+    v = np.where(v > m // 2, v - m, v).astype(np.int64)
+    hi = (v + 128) >> 8
+    lo = v - (hi << 8)
+    assert lo.min() >= -128 and lo.max() <= 127
+    assert abs(hi).max() <= 26, hi.max()
+    return lo.astype(np.int8), hi.astype(np.int8)
+
+
+def _int_to_digits(x: int, nd: int) -> np.ndarray:
+    return np.frombuffer(x.to_bytes(nd, "little"), dtype=np.uint8).copy()
+
+
+@functools.lru_cache(maxsize=None)
+def make_ntt_ctx(p: int) -> NttCtx:
+    mctx = bn.make_mont_ctx(p, NL)
+    R = 1 << (16 * NL)
+
+    V0s, V1s, iV0s, iV1s = [], [], [], []
+    ev0, ev1, iv0, iv1 = [], [], [], []
+    mprime, mu26, mu27 = [], [], []
+    b1, b0, bc, bb, ba = [], [], [], [], []
+    for m in PRIMES:
+        w = OMEGA[m]
+        # powers of omega: o[k] = w^k mod m, k in [0, NC)
+        o = np.ones(NC, dtype=np.int64)
+        for k in range(1, NC):
+            o[k] = o[k - 1] * w % m
+        idx = np.outer(np.arange(NC), np.arange(NC)) % NC
+        V = o[idx]                                   # V[i,j] = w^(ij)
+        winv = pow(w, -1, m)
+        oi = np.ones(NC, dtype=np.int64)
+        for k in range(1, NC):
+            oi[k] = oi[k - 1] * winv % m
+        scale = pow(NC, -1, m) * (1 << 16) % m       # fold n^-1 and 2^16
+        iV = oi[idx] * scale % m
+        v0, v1 = _digit_planes(V, m)
+        i0, i1 = _digit_planes(iV, m)
+        V0s.append(v0); V1s.append(v1); iV0s.append(i0); iV1s.append(i1)
+
+        def colsum_off(plane, extra_neg, bias_pow):
+            off = 128 * plane.astype(np.int64).sum(axis=0)
+            neg = -min(0, int(off.min())) + extra_neg
+            bias = m * ((neg + m - 1) // m)
+            assert bias + extra_neg < (1 << bias_pow)
+            return off, bias
+
+        # eval stage 1: X1 = e@V1 + off1 + bias1, |e@V1| <= NC*128*26 < 2^22
+        off1, bias1 = colsum_off(v1, NC * 128 * 26, 24)
+        # eval stage 0: X0 = e@V0 + off0 + (r1<<8) + bias0
+        off0, bias0 = colsum_off(v0, NC * 128 * 128, 26)
+        # interp C: t1@iV1, |.| <= NC*52*26 < 2^21
+        biasC = m * ((NC * 52 * 26 + m - 1) // m)
+        # interp B: t0e@iV1 + ivoff1 + t1@iV0 + (Cm<<8) + biasb
+        ioff1, biasB = colsum_off(i1, NC * 128 * 26 + NC * 52 * 128
+                                  + (m << 8), 25)
+        # interp A: t0e@iV0 + ivoff0 + (Bm<<8) + biasa
+        ioff0, biasA = colsum_off(i0, NC * 128 * 128 + (m << 8), 26)
+
+        ev0.append(off0 + bias0); ev1.append(off1 + bias1)
+        iv0.append(ioff0 + biasA); iv1.append(ioff1 + biasB)
+        b1.append(bias1); b0.append(bias0)
+        bc.append(biasC); bb.append(biasB); ba.append(biasA)
+        mprime.append((-pow(m, -1, 1 << 16)) % (1 << 16))
+        mu26.append((1 << 26) // m)
+        mu27.append((1 << 27) // m)
+
+    # Toeplitz constants for the Montgomery reduction (fixed operands)
+    pprime = (-pow(p, -1, R)) % R
+    pd = _int_to_digits(pprime, ND).astype(np.int64)
+    pe = pd - 128
+    # toep_m[i, k] = p'e[k-i] for 0 <= k-i < ND (low-half product)
+    i_idx = np.arange(ND)[:, None]
+    k_idx = np.arange(ND)[None, :]
+    d = k_idx - i_idx
+    toep_m = np.where((d >= 0), pe[np.clip(d, 0, ND - 1)], 0).astype(np.int8)
+    # f_m[k] = 128·prefixsum(p'e)[k] + 128^2·(k+1)
+    f_m = 128 * np.cumsum(pe) + 16384 * (np.arange(ND) + 1)
+
+    pdg = _int_to_digits(p, ND).astype(np.int64)
+    ppe = pdg - 128
+    k_idx = np.arange(NC)[None, :]
+    d = k_idx - i_idx                                 # (ND, NC)
+    toep_p = np.where((d >= 0) & (d < ND),
+                      ppe[np.clip(d, 0, ND - 1)], 0).astype(np.int8)
+    # f_p[k] = 128·(windowed prefix of pe) + 128^2·overlap(k)
+    cs = np.concatenate([[0], np.cumsum(ppe)])        # cs[j] = sum pe[:j]
+    k = np.arange(NC)
+    lo_i = np.maximum(0, k - (ND - 1))
+    hi_i = np.minimum(ND - 1, k)
+    win = cs[np.clip(k - lo_i + 1, 0, ND)] - cs[np.clip(k - hi_i, 0, ND)]
+    overlap = np.maximum(0, hi_i - lo_i + 1)
+    f_p = 128 * win + 16384 * overlap
+
+    p_pad = np.zeros(NL + 2, dtype=np.uint32)
+    p_pad[:NL] = np.asarray(bn.int_to_limbs(p, NL))
+
+    m1, m2 = PRIMES
+    return NttCtx(
+        mctx=mctx,
+        V0=jnp.asarray(np.stack(V0s)), V1=jnp.asarray(np.stack(V1s)),
+        iV0=jnp.asarray(np.stack(iV0s)), iV1=jnp.asarray(np.stack(iV1s)),
+        evoff0=jnp.asarray(np.stack(ev0))[:, None, :].astype(jnp.int32),
+        evoff1=jnp.asarray(np.stack(ev1))[:, None, :].astype(jnp.int32),
+        ivoff0=jnp.asarray(np.stack(iv0))[:, None, :].astype(jnp.int32),
+        ivoff1=jnp.asarray(np.stack(iv1))[:, None, :].astype(jnp.int32),
+        toep_m=jnp.asarray(toep_m), f_m=jnp.asarray(f_m, dtype=jnp.int32),
+        toep_p=jnp.asarray(toep_p), f_p=jnp.asarray(f_p, dtype=jnp.int32),
+        p_pad=jnp.asarray(p_pad),
+        m=tuple(PRIMES), mprime=tuple(mprime),
+        mu26=tuple(mu26), mu27=tuple(mu27),
+        bias1=tuple(b1), bias0=tuple(b0),
+        biasc=tuple(bc), biasb=tuple(bb), biasa=tuple(ba),
+        inv12s=pow(m1, -1, m2) * (1 << 16) % m2,
+    )
+
+
+# ---------------------------------------------------------------------------
+# device-side primitives
+# ---------------------------------------------------------------------------
+
+def _i8dot(a: jax.Array, w: jax.Array) -> jax.Array:
+    """(B, K) int8 @ (K, N) int8 -> (B, N) int32, exact (MXU int8 path)."""
+    return lax.dot_general(a, w, (((1,), (0,)), ((), ())),
+                           preferred_element_type=jnp.int32)
+
+
+def _barrett(x: jax.Array, m: int, mu: int, a: int, nsub: int) -> jax.Array:
+    """x mod m for uint32 x; constants validated exhaustively (see module
+    docstring).  q = ((x>>a)·mu)>>13, then nsub conditional subtracts."""
+    q = ((x >> a) * U32(mu)) >> 13
+    r = x - q * U32(m)
+    for _ in range(nsub):
+        r = jnp.where(r >= m, r - U32(m), r)
+    return r
+
+
+def _mredc16(x: jax.Array, m: int, mprime: int) -> jax.Array:
+    """(x · 2^-16) mod m for uint32 x < 2^16·m: exact, in [0, m)."""
+    u = (x * U32(mprime)) & U32(0xFFFF)
+    t = (x + u * U32(m)) >> 16
+    return jnp.where(t >= m, t - U32(m), t)
+
+
+def _digits_to_limbs(d: jax.Array) -> jax.Array:
+    """Nonneg redundant base-256 coeffs (..., L) u32 (< 2^25) -> canonical
+    16-bit limbs (..., L/2).  Carries beyond limb L/2 are dropped (callers
+    either prove them zero or want mod 2^(8L))."""
+    # four ripple passes: < 2^25 -> <= 255+2^17 -> <= 768 -> <= 258 -> <= 256
+    for _ in range(4):
+        d = (d & U32(0xFF)) + bn._shift_up(d >> 8)
+    z = d[..., 0::2] + (d[..., 1::2] << 8)       # redundant base 2^16
+    return bn.normalize(z)
+
+
+def _limbs_to_e(x: jax.Array, pad_to: int | None = None) -> jax.Array:
+    """(..., L) uint32 16-bit limbs -> (..., 2L [padded]) int8 e-form
+    (digit - 128; zero digits pad as -128)."""
+    d0 = (x & U32(0xFF)).astype(jnp.int32)
+    d1 = ((x >> 8) & U32(0xFF)).astype(jnp.int32)
+    e = jnp.stack([d0, d1], axis=-1).reshape(*x.shape[:-1], 2 * x.shape[-1])
+    e = e - 128
+    if pad_to is not None and pad_to > e.shape[-1]:
+        pad = [(0, 0)] * (e.ndim - 1) + [(0, pad_to - e.shape[-1])]
+        e = jnp.pad(e, pad, constant_values=-128)
+    return e.astype(jnp.int8)
+
+
+def _eval(ctx: NttCtx, e: jax.Array) -> list[jax.Array]:
+    """Forward NTT of e-form digits (B, NC) -> per-prime (B, NC) uint32
+    in [0, m)."""
+    out = []
+    for t in range(2):
+        m = ctx.m[t]
+        A1 = _i8dot(e, ctx.V1[t]) + ctx.evoff1[t]          # >= 0, < 2^24
+        r1 = _barrett(A1.astype(U32), m, ctx.mu26[t], 13, 2)
+        A0 = (_i8dot(e, ctx.V0[t]) + ctx.evoff0[t]).astype(U32) + (r1 << 8)
+        out.append(_barrett(A0, m, ctx.mu27[t], 14, 3))    # < 2^27 domain
+    return out
+
+
+def _interp_crt(ctx: NttCtx, that: list[jax.Array]) -> jax.Array:
+    """Pointwise-product values (per prime, [0,m)) -> exact convolution
+    coefficients (B, NC) uint32 (< 2^25) via inverse NTT + CRT."""
+    cs = []
+    for t in range(2):
+        m = ctx.m[t]
+        th = that[t]
+        t0e = ((th & U32(0xFF)).astype(jnp.int32) - 128).astype(jnp.int8)
+        t1 = (th >> 8).astype(jnp.int8)                    # <= 51
+        C = _i8dot(t1, ctx.iV1[t]) + ctx.biasc[t]
+        Cm = _barrett(C.astype(U32), m, ctx.mu26[t], 13, 2)
+        B_ = (_i8dot(t0e, ctx.iV1[t]) + _i8dot(t1, ctx.iV0[t])
+              + ctx.ivoff1[t]).astype(U32) + (Cm << 8)
+        Bm = _barrett(B_, m, ctx.mu26[t], 13, 2)
+        A_ = (_i8dot(t0e, ctx.iV0[t]) + ctx.ivoff0[t]).astype(U32) + (Bm << 8)
+        cs.append(_barrett(A_, m, ctx.mu27[t], 14, 3))
+    c1, c2 = cs
+    m1, m2 = ctx.m
+    # CRT: y = c1 + m1·((c2 - c1)·m1^{-1} mod m2), via mredc16 with the
+    # 2^16 factor folded into inv12s; d ≡ c2 - c1 (mod m2), nonneg.
+    d = c2 + U32(2 * m2) - c1
+    u = _mredc16(d * U32(ctx.inv12s), m2, ctx.mprime[1])
+    return c1 + U32(m1) * u                                # exact, < 2^25
+
+
+def _mont_reduce(ctx: NttCtx, y: jax.Array) -> jax.Array:
+    """Exact conv coefficients of T = a·b (B, NC) -> (T·R^{-1} mod p) as
+    canonical (B, NL) limbs.  R = 2^4096."""
+    batch = y.shape[:-1]
+    # normalize T to digits; T < p^2 so needs <= 1024 digits, keep 4 spare
+    yp = jnp.pad(y, [(0, 0)] * (y.ndim - 1) + [(0, 4)])
+    Tl = _digits_to_limbs(yp)                              # (B, 514) limbs
+    eT = _limbs_to_e(Tl[..., :NL])                         # (B, ND) low half
+    # m1 = T_low · p' mod 2^4096  (Toeplitz + offset terms, exact int32)
+    csT = jnp.cumsum(eT.astype(jnp.int32), axis=-1)
+    m1c = _i8dot(eT, ctx.toep_m) + ctx.f_m + (csT << 7)    # >= 0, < 2^25
+    m1l = _digits_to_limbs(m1c.astype(U32))                # (B, NL) mod R
+    em1 = _limbs_to_e(m1l)                                 # (B, ND)
+    # m1 · p (full product): Toeplitz (ND, NC) + windowed-cumsum offsets
+    cs1 = jnp.cumsum(em1.astype(jnp.int32), axis=-1)       # (B, ND)
+    last = jnp.broadcast_to(cs1[..., -1:], batch + (ND,))
+    wsum = (jnp.concatenate([cs1, last], axis=-1)
+            - jnp.pad(cs1, [(0, 0)] * (cs1.ndim - 1) + [(ND, 0)])[..., :NC])
+    m1pc = _i8dot(em1, ctx.toep_p) + ctx.f_p + (wsum << 7)  # >= 0, < 2^25
+    # S = T + m1·p; low 512 digits vanish; U = S / 2^4096 < 2p
+    # re-expand T limbs to digit stream cheaply: interleave 8-bit halves
+    Td = jnp.stack([Tl & U32(0xFF), Tl >> 8], axis=-1)
+    Td = Td.reshape(*batch, Tl.shape[-1] * 2)              # (B, 1028) digits
+    S = Td.astype(jnp.int32).at[..., :NC].add(m1pc)
+    Sl = _digits_to_limbs(S.astype(U32))                   # (B, 514)
+    U = Sl[..., NL:NL + NL + 2]                            # (B, 258) = S/R
+    U = bn._sub_if_ge(U, ctx.p_pad)
+    return U[..., :NL]
+
+
+# ---------------------------------------------------------------------------
+# public ops (drop-in for bignum_jax.montmul / mont_pow / powmod)
+# ---------------------------------------------------------------------------
+
+def montmul(ctx: NttCtx, a: jax.Array, b: jax.Array) -> jax.Array:
+    """Batched Montgomery product a·b·R^{-1} mod p on the MXU.
+    a, b: (..., NL) canonical 16-bit limbs < p."""
+    shape = a.shape
+    a2 = a.reshape(-1, NL)
+    b2 = jnp.broadcast_to(b, shape).reshape(-1, NL)
+    ah = _eval(ctx, _limbs_to_e(a2, NC))
+    bh = _eval(ctx, _limbs_to_e(b2, NC))
+    that = [_mredc16(ah[t] * bh[t], ctx.m[t], ctx.mprime[t])
+            for t in range(2)]
+    return _mont_reduce(ctx, _interp_crt(ctx, that)).reshape(shape)
+
+
+def montsqr(ctx: NttCtx, a: jax.Array) -> jax.Array:
+    """Batched Montgomery square (one forward NTT instead of two)."""
+    shape = a.shape
+    a2 = a.reshape(-1, NL)
+    ah = _eval(ctx, _limbs_to_e(a2, NC))
+    that = [_mredc16(ah[t] * ah[t], ctx.m[t], ctx.mprime[t])
+            for t in range(2)]
+    return _mont_reduce(ctx, _interp_crt(ctx, that)).reshape(shape)
+
+
+def mont_pow(ctx: NttCtx, base_mont: jax.Array, exp: jax.Array,
+             exp_bits: int) -> jax.Array:
+    return bn.mont_pow(ctx.mctx, base_mont, exp, exp_bits,
+                       montmul_fn=functools.partial(montmul, ctx),
+                       montsqr_fn=functools.partial(montsqr, ctx))
+
+
+def powmod(ctx: NttCtx, base: jax.Array, exp: jax.Array,
+           exp_bits: int) -> jax.Array:
+    return bn.powmod(ctx.mctx, base, exp, exp_bits,
+                     montmul_fn=functools.partial(montmul, ctx),
+                     montsqr_fn=functools.partial(montsqr, ctx))
+
+
+def mulmod(ctx: NttCtx, a: jax.Array, b: jax.Array) -> jax.Array:
+    """Plain modular product a·b mod p."""
+    return montmul(ctx, montmul(ctx, a, b),
+                   jnp.broadcast_to(ctx.mctx.r2_mod_p, a.shape))
+
+
+def mont_prod_tree(ctx: NttCtx, x: jax.Array) -> jax.Array:
+    return bn.mont_prod_tree(ctx.mctx, x,
+                             montmul_fn=functools.partial(montmul, ctx))

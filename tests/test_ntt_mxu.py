@@ -1,0 +1,132 @@
+"""Differential tests for the MXU NTT Montgomery engine (core/ntt_mxu.py)
+against the VPU CIOS kernel (core/bignum_jax.py) and Python ints.
+
+Runs on the CPU backend (int8 dot_general is exact there too); batches are
+kept tiny because CPU matmul throughput is the bottleneck, and full-width
+exponent ladders use reduced exp_bits.  The Barrett constants are
+re-validated exhaustively over their full input domains.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from electionguard_tpu.core import bignum_jax as bn
+from electionguard_tpu.core import ntt_mxu as nt
+from electionguard_tpu.core.group import production_group
+from electionguard_tpu.core.group_jax import JaxGroupOps
+
+
+@pytest.fixture(scope="module")
+def nctx(pgroup):
+    return nt.make_ntt_ctx(pgroup.p)
+
+
+def _rand_elems(g, k, seed=0):
+    rng = np.random.default_rng(seed)
+    out = [pow(g.g, int.from_bytes(rng.bytes(32), "big") % g.q, g.p)
+           for _ in range(k - 4)]
+    R = 1 << 4096
+    return out + [0, 1, g.p - 1, (R - 1) % g.p]
+
+
+def test_barrett_constants_exhaustive():
+    """Re-derive the hardcoded Barrett deficits over the full domains."""
+    for m in nt.PRIMES:
+        for (a, xbits, nsub) in [(13, 26, 2), (14, 28, 3)]:
+            mu = (1 << (a + 13)) // m
+            worst = 0
+            for lo in range(0, 1 << xbits, 1 << 24):
+                x = np.arange(lo, min(lo + (1 << 24), 1 << xbits),
+                              dtype=np.uint64)
+                q = ((x >> a) * mu) >> 13
+                r = x - q * m
+                worst = max(worst, int(r.max() // m))
+            assert worst <= nsub, (m, a, worst)
+
+
+def test_ntt_roots():
+    for m in nt.PRIMES:
+        w = nt.OMEGA[m]
+        assert pow(w, 1024, m) == 1 and pow(w, 512, m) != 1
+        assert (m - 1) % 1024 == 0
+    m1, m2 = nt.PRIMES
+    assert m1 * m2 > 512 * 255 * 255  # CRT range covers conv coefficients
+
+
+def test_montmul_matches_cios_and_ints(pgroup, nctx):
+    g = pgroup
+    xs = _rand_elems(g, 8, seed=1)
+    ys = _rand_elems(g, 8, seed=2)
+    A = jnp.asarray(bn.ints_to_limbs(xs, nt.NL))
+    B = jnp.asarray(bn.ints_to_limbs(ys, nt.NL))
+    got = np.asarray(nt.montmul(nctx, A, B))
+    ref = np.asarray(bn.montmul(nctx.mctx, A, B))
+    np.testing.assert_array_equal(got, ref)
+    Rinv = pow(1 << 4096, -1, g.p)
+    want = [x * y * Rinv % g.p for x, y in zip(xs, ys)]
+    assert bn.limbs_to_ints(got) == want
+
+
+def test_montsqr_matches(pgroup, nctx):
+    g = pgroup
+    xs = _rand_elems(g, 8, seed=3)
+    A = jnp.asarray(bn.ints_to_limbs(xs, nt.NL))
+    got = bn.limbs_to_ints(np.asarray(nt.montsqr(nctx, A)))
+    Rinv = pow(1 << 4096, -1, g.p)
+    assert got == [x * x * Rinv % g.p for x in xs]
+
+
+def test_montmul_broadcast_constant(pgroup, nctx):
+    g = pgroup
+    xs = _rand_elems(g, 6, seed=4)
+    A = jnp.asarray(bn.ints_to_limbs(xs, nt.NL))
+    got = bn.limbs_to_ints(np.asarray(nt.montmul(nctx, A, nctx.mctx.r2_mod_p)))
+    R = 1 << 4096
+    assert got == [x * R % g.p for x in xs]  # to_mont
+
+
+def test_mont_pow_small_exponents(pgroup, nctx):
+    """Full ladder logic with reduced exp_bits (CPU-affordable)."""
+    g = pgroup
+    rng = np.random.default_rng(5)
+    xs = _rand_elems(g, 6, seed=6)
+    es = [int(rng.integers(0, 1 << 32)) for _ in range(6)]
+    A = jnp.asarray(bn.ints_to_limbs(xs, nt.NL))
+    E = jnp.asarray(bn.ints_to_limbs(es, 2))
+    got = bn.limbs_to_ints(np.asarray(nt.powmod(nctx, A, E, 32)))
+    assert got == [pow(x, e, g.p) for x, e in zip(xs, es)]
+
+
+def test_group_ops_ntt_backend_mulmod_prod(pgroup):
+    ops = JaxGroupOps(pgroup, backend="ntt")
+    assert ops.backend == "ntt"
+    g = pgroup
+    xs = _rand_elems(g, 6, seed=7)
+    ys = _rand_elems(g, 6, seed=8)
+    got = ops.mulmod_ints(xs, ys)
+    assert got == [x * y % g.p for x, y in zip(xs, ys)]
+    rows = [xs, ys]
+    got = ops.prod_ints(rows)
+    assert got == [x * y % g.p for x, y in zip(xs, ys)]
+
+
+def test_group_ops_ntt_fixed_pow(pgroup):
+    ops = JaxGroupOps(pgroup, backend="ntt")
+    rng = np.random.default_rng(9)
+    es = [int.from_bytes(rng.bytes(32), "big") % pgroup.q for _ in range(3)]
+    got = ops.g_pow_ints(es)
+    assert got == [pow(pgroup.g, e, pgroup.p) for e in es]
+
+
+def test_noncanonical_input_canonicalized(pgroup, nctx):
+    """Operands >= p (any 4096-bit pattern) are safe: the first montmul in
+    a chain reduces them mod p (matches the CIOS kernel's behavior)."""
+    g = pgroup
+    R = 1 << 4096
+    xs = [g.p, g.p + 12345, R - 1]
+    A = jnp.asarray(bn.ints_to_limbs(xs, nt.NL))
+    got = bn.limbs_to_ints(
+        np.asarray(nt.montmul(nctx, A, nctx.mctx.r2_mod_p)))
+    assert got == [x * R % g.p for x in xs]
