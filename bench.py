@@ -23,6 +23,9 @@ def main() -> int:
     nballots = int(os.environ.get("BENCH_NBALLOTS", "256"))
     t_setup = time.time()
 
+    from electionguard_tpu.utils import enable_compile_cache
+    enable_compile_cache()
+
     from electionguard_tpu.ballot.plaintext import RandomBallotProvider
     from electionguard_tpu.core.group import production_group
     from electionguard_tpu.encrypt.encryptor import BatchEncryptor

@@ -14,6 +14,9 @@ import time
 
 from electionguard_tpu.ballot.manifest import Manifest, validate_manifest
 from electionguard_tpu.core.group import GroupContext, production_group, tiny_group
+from electionguard_tpu.utils import enable_compile_cache
+
+enable_compile_cache()
 
 
 def setup_logging(name: str) -> logging.Logger:

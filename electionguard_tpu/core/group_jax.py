@@ -38,9 +38,12 @@ from electionguard_tpu.core.group import GroupContext
 def _default_backend() -> str:
     """MXU NTT engine on TPU, VPU CIOS elsewhere; override with
     EGTPU_BIGNUM=ntt|cios."""
-    env = os.environ.get("EGTPU_BIGNUM", "auto").lower()
+    env = os.environ.get("EGTPU_BIGNUM", "auto").strip().lower()
     if env in ("ntt", "cios"):
         return env
+    if env not in ("", "auto"):
+        raise ValueError(f"EGTPU_BIGNUM={env!r} not recognized; "
+                         "expected 'ntt', 'cios', or 'auto'")
     return "ntt" if jax.default_backend() == "tpu" else "cios"
 
 
@@ -172,33 +175,78 @@ class JaxGroupOps:
 
     # ------------------------------------------------------------------
     # public array API (jnp/np arrays of limbs in and out)
+    #
+    # Batch axes are padded up to power-of-two buckets (with neutral
+    # elements) before dispatch so the whole workflow compiles a handful
+    # of shapes instead of one per distinct batch size — compile time is
+    # the practical cost of the big NTT programs.
     # ------------------------------------------------------------------
+    @staticmethod
+    def _bucket(b: int) -> int:
+        if b <= 16:
+            return 16
+        return 1 << (b - 1).bit_length()
+
+    def _pad(self, arr, fill_one: bool):
+        """Pad (B, n) to the bucketed batch; fill rows with 1 or 0."""
+        arr = jnp.asarray(arr)
+        b = arr.shape[0]
+        nb = self._bucket(b)
+        if nb == b:
+            return arr, b
+        pad = jnp.zeros((nb - b, arr.shape[1]), dtype=arr.dtype)
+        if fill_one:
+            pad = pad.at[:, 0].set(jnp.asarray(1, dtype=arr.dtype))
+        return jnp.concatenate([arr, pad], axis=0), b
+
     def powmod(self, base, exp):
         """Elementwise batch base^exp mod p; base (B,n), exp (B,ne)."""
-        return self._powmod_j(jnp.asarray(base), jnp.asarray(exp))
+        base, b = self._pad(base, fill_one=True)   # 1^0 = 1 padding
+        exp, _ = self._pad(exp, fill_one=False)
+        return self._powmod_j(base, exp)[:b]
 
-    def mulmod(self, a, b):
-        return self._mulmod_j(jnp.asarray(a), jnp.asarray(b))
+    def mulmod(self, a, b_arr):
+        a, b = self._pad(a, fill_one=True)
+        b_arr, _ = self._pad(b_arr, fill_one=True)
+        return self._mulmod_j(a, b_arr)[:b]
 
     def g_pow(self, exp):
         """g^exp via the PowRadix table; exp (B, ne)."""
-        return self._fixed_pow_j(self.g_table, jnp.asarray(exp))
+        exp, b = self._pad(exp, fill_one=False)    # g^0 = 1 padding
+        return self._fixed_pow_j(self.g_table, exp)[:b]
 
     def base_pow(self, base: int, exp):
         """base^exp for a host-known base (K, g^{-1}, ...) via cached table."""
-        return self._fixed_pow_j(self.fixed_table(base), jnp.asarray(exp))
+        exp, b = self._pad(exp, fill_one=False)
+        return self._fixed_pow_j(self.fixed_table(base), exp)[:b]
 
     def prod_reduce(self, x):
-        """Product over axis 0: (M, B, n) -> (B, n)."""
-        return self._prod_reduce_j(jnp.asarray(x))
+        """Product over axis 0: (M, B, n) -> (B, n).  Both the reduced M
+        axis (which varies with ballot count) and the B axis are bucketed
+        with neutral 1-rows."""
+        x = jnp.asarray(x)
+        m, b = x.shape[0], x.shape[1]
+        nm, nb = self._bucket(m), self._bucket(b)
+        if nm != m or nb != b:
+            one = jnp.zeros((1, 1, x.shape[2]), dtype=x.dtype)
+            one = one.at[..., 0].set(jnp.asarray(1, dtype=x.dtype))
+            if nb != b:
+                x = jnp.concatenate(
+                    [x, jnp.broadcast_to(one, (m, nb - b, x.shape[2]))],
+                    axis=1)
+            if nm != m:
+                x = jnp.concatenate(
+                    [x, jnp.broadcast_to(one, (nm - m, nb, x.shape[2]))],
+                    axis=0)
+        return self._prod_reduce_j(x)[:b]
 
     def is_valid_residue(self, x):
         """Batched subgroup membership x^q == 1 (and 0 < x < p)."""
-        x = jnp.asarray(x)
+        x, b = self._pad(x, fill_one=True)         # 1 is a valid residue
         q_exp = jnp.broadcast_to(
             jnp.asarray(bn.int_to_limbs(self.group.q, self.ne)),
             x.shape[:-1] + (self.ne,))
-        return self._verify_residue_j(x, q_exp)
+        return self._verify_residue_j(x, q_exp)[:b]
 
     # ------------------------------------------------------------------
     # int-facing convenience (tests, small control-plane batches)

@@ -77,10 +77,10 @@ class ShardedGroupOps:
         self._one_p = np.zeros(ops.n, np.uint32)
         self._one_p[0] = 1
         self._zero_q = np.zeros(ops.ne, np.uint32)
-        self._powmod_j = self._build_elementwise(
-            functools.partial(bn.powmod, ops.ctx, exp_bits=ops.exp_bits))
-        self._mulmod_j = self._build_elementwise(
-            functools.partial(bn.mulmod, ops.ctx))
+        # every kernel routes Montgomery products through ops._mm/_ms so
+        # the sharded plane follows the same backend (cios/ntt) as ops
+        self._powmod_j = self._build_elementwise(ops._powmod_impl)
+        self._mulmod_j = self._build_elementwise(ops._mulmod_impl)
         self._residue_j = self._build_elementwise(ops._verify_residue_impl)
         self._fixed_pow_j = self._build_fixed_pow()
         self._prod_reduce_j = self._build_prod_reduce()
@@ -121,14 +121,15 @@ class ShardedGroupOps:
             acc = None
             for i in range(local_wins):
                 sel = table[i][digits[:, i]]            # (b_loc, n)
-                acc = sel if acc is None else bn.montmul(ctx, acc, sel)
+                acc = sel if acc is None else ops._mm(acc, sel)
             return acc
 
         def kernel(table, digits):
             partial = local_partial(table, digits)      # mont domain
             # combine window partials across wp: all-gather + local tree
             parts = lax.all_gather(partial, WP_AXIS)    # (nwp, b_loc, n)
-            return bn.from_mont(ctx, bn.mont_prod_tree(ctx, parts))
+            return bn.from_mont_via(
+                ops._mm, bn.mont_prod_tree(ctx, parts, montmul_fn=ops._mm))
 
         mapped = shard_map(
             kernel, mesh=self.mesh,
@@ -137,12 +138,16 @@ class ShardedGroupOps:
         return jax.jit(mapped)
 
     def _build_prod_reduce(self):
-        ctx = self.ops.ctx
+        ops = self.ops
+        ctx = ops.ctx
 
         def kernel(x):                                  # (m_loc, B, n)
-            partial = bn.mont_prod_tree(ctx, bn.to_mont(ctx, x))
+            r2 = jnp.broadcast_to(ctx.r2_mod_p, x.shape)
+            partial = bn.mont_prod_tree(ctx, ops._mm(x, r2),
+                                        montmul_fn=ops._mm)
             parts = lax.all_gather(partial, DP_AXIS)    # (ndp, B, n)
-            return bn.from_mont(ctx, bn.mont_prod_tree(ctx, parts))
+            return bn.from_mont_via(
+                ops._mm, bn.mont_prod_tree(ctx, parts, montmul_fn=ops._mm))
 
         mapped = shard_map(
             kernel, mesh=self.mesh,

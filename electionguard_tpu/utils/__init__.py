@@ -1,0 +1,23 @@
+"""Shared runtime utilities."""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache(path: str | None = None) -> None:
+    """Turn on JAX's persistent compilation cache (best-effort).
+
+    The MXU NTT programs are expensive to compile (~minutes for the full
+    modexp ladder); caching makes every process after the first warm.
+    Call before the first jit dispatch.
+    """
+    cache = (path or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+             or os.path.expanduser("~/.cache/egtpu_jax"))
+    try:
+        os.makedirs(cache, exist_ok=True)
+        import jax
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # cache is an optimization; never fail the workload for it
