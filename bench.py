@@ -23,7 +23,7 @@ def main() -> int:
     nballots = int(os.environ.get("BENCH_NBALLOTS", "256"))
     t_setup = time.time()
 
-    from electionguard_tpu.utils import enable_compile_cache
+    from electionguard_tpu.utils import enable_compile_cache, maybe_profile
     enable_compile_cache()
 
     from electionguard_tpu.ballot.plaintext import RandomBallotProvider
@@ -63,7 +63,8 @@ def main() -> int:
     res = Verifier(record, g).verify()
     assert res.ok, res.summary()
     t0 = time.time()
-    res = Verifier(record, g).verify()
+    with maybe_profile("bench-verify"):
+        res = Verifier(record, g).verify()
     t_verify = time.time() - t0
     assert res.ok, res.summary()
 

@@ -13,6 +13,7 @@ from electionguard_tpu.cli.common import (Stopwatch, add_group_flag,
                                           resolve_group, setup_logging)
 from electionguard_tpu.publish.publisher import Consumer, Publisher
 from electionguard_tpu.tally.accumulate import accumulate_ballots
+from electionguard_tpu.utils import maybe_profile
 
 
 def main(argv=None) -> int:
@@ -32,8 +33,9 @@ def main(argv=None) -> int:
     publisher = Publisher(args.output)
 
     sw = Stopwatch()
-    result = accumulate_ballots(init, ballots, args.name,
-                                {"created_by": "RunAccumulateTally"})
+    with maybe_profile("accumulate"):
+        result = accumulate_ballots(init, ballots, args.name,
+                                    {"created_by": "RunAccumulateTally"})
     publisher.write_tally_result(result)
     log.info("%s; %d cast ballots accumulated",
              sw.took("accumulation", max(len(ballots), 1)),

@@ -15,6 +15,7 @@ from electionguard_tpu.cli.common import (Stopwatch, add_group_flag,
                                           resolve_group, setup_logging)
 from electionguard_tpu.encrypt.encryptor import BatchEncryptor
 from electionguard_tpu.publish.publisher import Consumer, Publisher
+from electionguard_tpu.utils import maybe_profile
 
 
 def main(argv=None) -> int:
@@ -56,14 +57,15 @@ def main(argv=None) -> int:
     # confirmation-code chain continues across chunks via code_seed
     encrypted, invalid = [], []
     code_seed = None
-    for lo in range(0, len(ballots), args.batch_size):
-        chunk = ballots[lo:lo + args.batch_size]
-        enc_chunk, inv_chunk = enc.encrypt_ballots(
-            chunk, seed=seed, code_seed=code_seed)
-        encrypted.extend(enc_chunk)
-        invalid.extend(inv_chunk)
-        if enc_chunk:
-            code_seed = enc_chunk[-1].code
+    with maybe_profile("encrypt"):
+        for lo in range(0, len(ballots), args.batch_size):
+            chunk = ballots[lo:lo + args.batch_size]
+            enc_chunk, inv_chunk = enc.encrypt_ballots(
+                chunk, seed=seed, code_seed=code_seed)
+            encrypted.extend(enc_chunk)
+            invalid.extend(inv_chunk)
+            if enc_chunk:
+                code_seed = enc_chunk[-1].code
     n = publisher.write_encrypted_ballots(encrypted)
     if invalid:
         inv_pub = Publisher(args.invalid_dir) if args.invalid_dir else publisher

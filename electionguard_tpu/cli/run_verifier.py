@@ -15,6 +15,7 @@ from electionguard_tpu.cli.common import (Stopwatch, add_group_flag,
 from electionguard_tpu.publish.publisher import (Consumer,
                                                  election_record_from_consumer)
 from electionguard_tpu.verify.verifier import Verifier
+from electionguard_tpu.utils import maybe_profile
 
 
 def main(argv=None) -> int:
@@ -33,7 +34,8 @@ def main(argv=None) -> int:
         return 1
 
     sw = Stopwatch()
-    res = Verifier(record, group).verify()
+    with maybe_profile("verify"):
+        res = Verifier(record, group).verify()
     print(res.summary())
     log.info("%s; ok=%s",
              sw.took("verification", max(len(record.encrypted_ballots), 1)),

@@ -2,7 +2,22 @@
 
 from __future__ import annotations
 
+import contextlib
 import os
+
+
+@contextlib.contextmanager
+def maybe_profile(tag: str):
+    """JAX profiler trace for one workflow phase when EGTPU_PROFILE=<dir>
+    is set (the TPU equivalent of the reference's Guava Stopwatch prints —
+    reference: RunRemoteWorkflowTest.java:125,145,153,174; SURVEY.md §5.1)."""
+    out = os.environ.get("EGTPU_PROFILE")
+    if not out:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(os.path.join(out, tag)):
+        yield
 
 
 def enable_compile_cache(path: str | None = None) -> None:
