@@ -1,0 +1,182 @@
+"""Batched SHA-256 on the device: Fiat–Shamir challenges without leaving TPU.
+
+The reference computes every proof challenge on the JVM one element at a
+time [ext]; our batch planes produce the commitment byte images ON DEVICE,
+so hashing them host-side would round-trip megabytes per batch and burn
+~0.4 ms of Python per selection (the measured host ceiling, ~1.7k
+ballots/s).  SHA-256 is pure uint32 arithmetic — exact on TPU — so the
+challenge computation runs as one jitted program over the whole batch:
+message assembly, 64-round compression via ``lax.scan``, and the final
+reduction into Z_q.
+
+Exactly reproduces ``electionguard_tpu.core.hash.hash_elems`` for the
+fixed-layout call sites (tag || len || payload concatenation); differential
+tests pin byte-for-byte equality against hashlib.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from electionguard_tpu.core import bignum_jax as bn
+
+U32 = jnp.uint32
+
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5,
+    0x3956c25b, 0x59f111f1, 0x923f82a4, 0xab1c5ed5,
+    0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174,
+    0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7,
+    0xc6e00bf3, 0xd5a79147, 0x06ca6351, 0x14292967,
+    0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3,
+    0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5,
+    0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f, 0x682e6ff3,
+    0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2], dtype=np.uint32)
+
+_H0 = np.array([0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19],
+               dtype=np.uint32)
+
+
+def _rotr(x, n):
+    return (x >> n) | (x << (32 - n))
+
+
+def _compress_block(state, wk):
+    """One SHA-256 block: state (B, 8) u32, wk (B, 16) u32 message words."""
+    w16 = [wk[:, t] for t in range(16)]
+
+    def extend(carry, _):
+        # carry: tuple of last 16 w values, rotating window
+        w = list(carry)
+        s0 = _rotr(w[1], 7) ^ _rotr(w[1], 18) ^ (w[1] >> 3)
+        s1 = _rotr(w[14], 17) ^ _rotr(w[14], 19) ^ (w[14] >> 10)
+        nxt = w[0] + s0 + w[9] + s1
+        return tuple(w[1:] + [nxt]), nxt
+
+    _, w_ext = lax.scan(extend, tuple(w16), None, length=48)
+    # full schedule (64, B)
+    w_all = jnp.concatenate([jnp.stack(w16), w_ext], axis=0)
+    k_all = jnp.asarray(_K)
+
+    def round_fn(carry, wt_kt):
+        a, b, c, d, e, f, g, h = carry
+        wt, kt = wt_kt
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + kt + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        return (t1 + t2, a, b, c, d + t1, e, f, g), None
+
+    init = tuple(state[:, i] for i in range(8))
+    out, _ = lax.scan(round_fn, init, (w_all, k_all))
+    return state + jnp.stack(out, axis=1)
+
+
+def sha256_rows(msgs: jax.Array) -> jax.Array:
+    """SHA-256 of each row: (B, L) uint8 -> (B, 32) uint8.  L is static."""
+    B, L = msgs.shape
+    total = ((L + 9 + 63) // 64) * 64
+    padding = np.zeros(total - L, dtype=np.uint8)
+    padding[0] = 0x80
+    padding[-8:] = np.frombuffer((8 * L).to_bytes(8, "big"), np.uint8)
+    m = jnp.concatenate(
+        [msgs, jnp.broadcast_to(jnp.asarray(padding), (B, total - L))],
+        axis=1)
+    w = ((m[:, 0::4].astype(U32) << 24) | (m[:, 1::4].astype(U32) << 16)
+         | (m[:, 2::4].astype(U32) << 8) | m[:, 3::4].astype(U32))
+    blocks = w.reshape(B, total // 64, 16).swapaxes(0, 1)  # (nb, B, 16)
+
+    def per_block(state, wk):
+        return _compress_block(state, wk), None
+
+    state0 = jnp.broadcast_to(jnp.asarray(_H0), (B, 8))
+    state, _ = lax.scan(per_block, state0, blocks)
+    out = jnp.stack([(state >> 24) & 0xFF, (state >> 16) & 0xFF,
+                     (state >> 8) & 0xFF, state & 0xFF],
+                    axis=2).astype(jnp.uint8)        # (B, 8, 4) BE bytes
+    return out.reshape(B, 32)
+
+
+def _digest_mod_q(digest: jax.Array, q_limbs: jax.Array) -> jax.Array:
+    """(B, 32) uint8 big-endian digests -> (B, 16) limbs of digest mod q
+    (single conditional subtract; valid because 2^256 < 2q)."""
+    b = digest.astype(U32)
+    limbs_be = (b[:, 0::2] << 8) | b[:, 1::2]        # (B, 16) BE 16-bit
+    limbs = limbs_be[:, ::-1]                        # little-endian order
+    return bn._sub_if_ge(limbs, q_limbs)
+
+
+def digest_to_q_limbs(group, digest: jax.Array) -> jax.Array:
+    """(B, 32) uint8 big-endian digests -> (B, 16) uint32 16-bit limbs of
+    (digest mod q); production group only (see ``supports``)."""
+    if not supports(group):
+        raise ValueError("digest_to_q_limbs requires the production group")
+    return _digest_mod_q(digest, jnp.asarray(bn.int_to_limbs(group.q, 16)))
+
+
+_TAG_P_HDR = b"\x01" + (512).to_bytes(4, "big")
+
+
+@jax.jit
+def _hash_rows_mod_q(msgs: jax.Array, q_limbs: jax.Array) -> jax.Array:
+    """(B, L) uint8 messages + (16,) q limbs -> (B, 16) challenge limbs."""
+    return _digest_mod_q(sha256_rows(msgs), q_limbs)
+
+
+def _bucket(b: int) -> int:
+    return 16 if b <= 16 else 1 << (b - 1).bit_length()
+
+
+def supports(group) -> bool:
+    """Whether the device challenge path applies: the production group's
+    256-bit q (single-subtract mod-q reduction) AND 4096-bit p (the fixed
+    512-byte element frame in ``_TAG_P_HDR``)."""
+    return (group.q.bit_length() == 256 and (1 << 256) < 2 * group.q
+            and group.p.bit_length() == 4096)
+
+
+def batch_challenge_p(group, prefix: bytes, elem_bytes: list) -> np.ndarray:
+    """Fiat–Shamir challenge over fixed-layout messages, batched on device.
+
+    ``prefix``: host bytes — the encoded leading items (e.g. enc(Q̄)), same
+    for every row.  ``elem_bytes``: list of (B, 512) uint8 arrays, each the
+    big-endian byte image of a batch of ElementModP; every element is
+    framed exactly as ``hash._encode`` frames an ElementModP.  Returns
+    (B, 16) uint32 limbs of the challenge mod q — byte-identical to
+    ``hash_elems(group, *items)``.
+
+    Requires the production group's 256-bit q (2^256 < 2q); callers fall
+    back to host hashing for other groups.
+    """
+    if not supports(group):
+        raise ValueError("batch_challenge_p requires the production group "
+                         "(256-bit q, 4096-bit p)")
+    arrs = [jnp.asarray(e, dtype=jnp.uint8) for e in elem_bytes]
+    b = arrs[0].shape[0]
+    nb = _bucket(b)
+    if nb != b:
+        arrs = [jnp.concatenate(
+            [a, jnp.zeros((nb - b, a.shape[1]), jnp.uint8)]) for a in arrs]
+    hdr = jnp.broadcast_to(
+        jnp.asarray(np.frombuffer(_TAG_P_HDR, np.uint8)), (nb, 5))
+    parts = [jnp.broadcast_to(
+        jnp.asarray(np.frombuffer(prefix, np.uint8)), (nb, len(prefix)))]
+    for a in arrs:
+        parts.append(hdr)
+        parts.append(a)
+    msgs = jnp.concatenate(parts, axis=1)
+    q_limbs = jnp.asarray(bn.int_to_limbs(group.q, 16))
+    return _hash_rows_mod_q(msgs, q_limbs)[:b]

@@ -37,7 +37,8 @@ from electionguard_tpu.core.group import ElementModP, ElementModQ
 from electionguard_tpu.core.group_jax import (JaxExponentOps, JaxGroupOps,
                                               jax_exp_ops, jax_ops,
                                               limbs_to_bytes_be)
-from electionguard_tpu.core.hash import hash_digest, hash_elems
+from electionguard_tpu.core import sha256_jax
+from electionguard_tpu.core.hash import _encode, hash_digest, hash_elems
 from electionguard_tpu.crypto.chaum_pedersen import (
     ConstantChaumPedersenProof, DisjunctiveChaumPedersenProof)
 from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
@@ -211,19 +212,31 @@ class BatchEncryptor:
         a_fake_b = limbs_to_bytes_be(a_fake)
         b_fake_b = limbs_to_bytes_be(b_fake)
 
-        C_chal = np.empty(S, dtype=object)
-        for i in range(S):
-            if votes[i] == 0:
-                a0, b0, a1, b1 = (a_real_b[i], b_real_b[i],
-                                  a_fake_b[i], b_fake_b[i])
-            else:
-                a0, b0, a1, b1 = (a_fake_b[i], b_fake_b[i],
-                                  a_real_b[i], b_real_b[i])
-            C_chal[i] = _hash_disjunctive(
-                g, self.qbar, alpha_b[i], beta_b[i], a0, b0, a1, b1)
+        if sha256_jax.supports(g):
+            # device Fiat–Shamir over the whole batch; the (real, fake)
+            # branch order depends on the vote, selected with np.where
+            v1 = (votes == 1)[:, None]
+            a0b = np.where(v1, a_fake_b, a_real_b)
+            b0b = np.where(v1, b_fake_b, b_real_b)
+            a1b = np.where(v1, a_real_b, a_fake_b)
+            b1b = np.where(v1, b_real_b, b_fake_b)
+            C_l = np.asarray(sha256_jax.batch_challenge_p(
+                g, _encode(self.qbar),
+                [alpha_b, beta_b, a0b, b0b, a1b, b1b]))
+        else:
+            C_chal = np.empty(S, dtype=object)
+            for i in range(S):
+                if votes[i] == 0:
+                    a0, b0, a1, b1 = (a_real_b[i], b_real_b[i],
+                                      a_fake_b[i], b_fake_b[i])
+                else:
+                    a0, b0, a1, b1 = (a_fake_b[i], b_fake_b[i],
+                                      a_real_b[i], b_real_b[i])
+                C_chal[i] = _hash_disjunctive(
+                    g, self.qbar, alpha_b[i], beta_b[i], a0, b0, a1, b1)
+            C_l = ee.to_limbs(C_chal)
 
         # c_real = c - c_f ; v_real = u - c_real * R  (device, mod q)
-        C_l = ee.to_limbs(C_chal)
         CR_l = np.asarray(ee.sub(C_l, CF_l))
         VR_l = np.asarray(ee.a_minus_bc(U_l, CR_l, R_l))
 
@@ -254,11 +267,23 @@ class BatchEncryptor:
         B_b = limbs_to_bytes_be(B_c)
         a_cb = limbs_to_bytes_be(a_c)
         b_cb = limbs_to_bytes_be(b_c)
-        C2 = np.empty(C, dtype=object)
-        for ci, row in enumerate(contest_rows):
-            C2[ci] = _hash_constant(g, self.qbar, row[4], A_b[ci], B_b[ci],
-                                    a_cb[ci], b_cb[ci])
-        C2_l = ee.to_limbs(C2)
+        if sha256_jax.supports(g):
+            C2_l = np.empty((C, ee.ne), dtype=np.uint32)
+            by_limit: dict[int, list[int]] = {}
+            for ci, row in enumerate(contest_rows):
+                by_limit.setdefault(row[4], []).append(ci)
+            for limit, idxs in by_limit.items():
+                ix = np.asarray(idxs)
+                prefix = _encode(self.qbar) + _encode(limit)
+                C2_l[ix] = np.asarray(sha256_jax.batch_challenge_p(
+                    g, prefix, [A_b[ix], B_b[ix], a_cb[ix], b_cb[ix]]))
+            C2 = np.array(ee.from_limbs(C2_l), dtype=object)
+        else:
+            C2 = np.empty(C, dtype=object)
+            for ci, row in enumerate(contest_rows):
+                C2[ci] = _hash_constant(g, self.qbar, row[4], A_b[ci],
+                                        B_b[ci], a_cb[ci], b_cb[ci])
+            C2_l = ee.to_limbs(C2)
         V2_l = np.asarray(ee.a_minus_bc(U2_l, C2_l, RS_l))
 
         # ---- materialize ballots ---------------------------------------

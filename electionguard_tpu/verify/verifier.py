@@ -37,7 +37,8 @@ from electionguard_tpu.ballot.manifest import validate_manifest
 from electionguard_tpu.core.group import ElementModP, GroupContext
 from electionguard_tpu.core.group_jax import (jax_exp_ops, jax_ops,
                                               limbs_to_bytes_be)
-from electionguard_tpu.core.hash import hash_elems
+from electionguard_tpu.core import sha256_jax
+from electionguard_tpu.core.hash import _encode, hash_elems
 from electionguard_tpu.decrypt.decryption import lagrange_coefficient
 from electionguard_tpu.keyceremony.trustee import commitment_product
 from electionguard_tpu.publish.election_record import ElectionRecord
@@ -281,15 +282,26 @@ class Verifier:
         a0b, b0b = limbs_to_bytes_be(a0), limbs_to_bytes_be(b0)
         a1b, b1b = limbs_to_bytes_be(a1), limbs_to_bytes_be(b1)
         q = g.q
-        for i in range(S):
-            c = hash_elems(
-                g, qbar,
-                g.bytes_to_p(bytes(alpha_b[i])), g.bytes_to_p(bytes(beta_b[i])),
-                g.bytes_to_p(bytes(a0b[i])), g.bytes_to_p(bytes(b0b[i])),
-                g.bytes_to_p(bytes(a1b[i])), g.bytes_to_p(bytes(b1b[i])))
-            if (c0s[i] + c1s[i]) % q != c.value:
+        if sha256_jax.supports(g):
+            # device Fiat–Shamir: challenge c = H(Q̄, α, β, a0, b0, a1, b1)
+            # hashed + reduced mod q on-device, compared limb-wise to c0+c1
+            c_limbs = np.asarray(sha256_jax.batch_challenge_p(
+                g, _encode(qbar), [alpha_b, beta_b, a0b, b0b, a1b, b1b]))
+            sum_c = np.asarray(ee.add(c0_l, c1_l))
+            for i in np.nonzero(~(sum_c == c_limbs).all(axis=1))[0]:
                 res.record("V4.selection_proofs", False,
-                           f"disjunctive proof fails for {sel_refs[i]}")
+                           f"disjunctive proof fails for {sel_refs[int(i)]}")
+        else:
+            for i in range(S):
+                c = hash_elems(
+                    g, qbar,
+                    g.bytes_to_p(bytes(alpha_b[i])),
+                    g.bytes_to_p(bytes(beta_b[i])),
+                    g.bytes_to_p(bytes(a0b[i])), g.bytes_to_p(bytes(b0b[i])),
+                    g.bytes_to_p(bytes(a1b[i])), g.bytes_to_p(bytes(b1b[i])))
+                if (c0s[i] + c1s[i]) % q != c.value:
+                    res.record("V4.selection_proofs", False,
+                               f"disjunctive proof fails for {sel_refs[i]}")
         res.record("V4.selection_proofs", True)
 
         # ---- V5: contest limits ------------------------------------------
@@ -335,14 +347,31 @@ class Verifier:
         CBb = limbs_to_bytes_be(CB_l)
         acb = limbs_to_bytes_be(a_c)
         bcb = limbs_to_bytes_be(b_c)
-        for i in range(C):
-            c = hash_elems(
-                g, qbar, contest_consts[i],
-                g.bytes_to_p(bytes(CAb[i])), g.bytes_to_p(bytes(CBb[i])),
-                g.bytes_to_p(bytes(acb[i])), g.bytes_to_p(bytes(bcb[i])))
-            if contest_cs[i] != c.value:
-                res.record("V5.contest_limits", False,
-                           f"constant proof fails for {contest_refs[i]}")
+        if sha256_jax.supports(g):
+            # rows share a message layout only within one constant value;
+            # group by constant (in practice one group per election)
+            by_const: dict[int, list[int]] = {}
+            for i, const in enumerate(contest_consts):
+                by_const.setdefault(const, []).append(i)
+            for const, idxs in by_const.items():
+                ix = np.asarray(idxs)
+                prefix = _encode(qbar) + _encode(const)
+                c_limbs = np.asarray(sha256_jax.batch_challenge_p(
+                    g, prefix, [CAb[ix], CBb[ix], acb[ix], bcb[ix]]))
+                want = np.asarray(cc_l)[ix]
+                for j in np.nonzero(~(want == c_limbs).all(axis=1))[0]:
+                    res.record(
+                        "V5.contest_limits", False,
+                        f"constant proof fails for {contest_refs[idxs[int(j)]]}")
+        else:
+            for i in range(C):
+                c = hash_elems(
+                    g, qbar, contest_consts[i],
+                    g.bytes_to_p(bytes(CAb[i])), g.bytes_to_p(bytes(CBb[i])),
+                    g.bytes_to_p(bytes(acb[i])), g.bytes_to_p(bytes(bcb[i])))
+                if contest_cs[i] != c.value:
+                    res.record("V5.contest_limits", False,
+                               f"constant proof fails for {contest_refs[i]}")
         res.record("V5.contest_limits", True)
 
         # ---- V6: chaining ------------------------------------------------

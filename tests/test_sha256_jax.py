@@ -1,0 +1,68 @@
+"""Differential tests: device-batched SHA-256 / Fiat–Shamir vs hashlib."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from electionguard_tpu.core import bignum_jax as bn
+from electionguard_tpu.core import sha256_jax as sj
+from electionguard_tpu.core.hash import _encode, hash_elems
+from electionguard_tpu.core.group_jax import limbs_to_bytes_be
+
+
+@pytest.mark.parametrize("L", [0, 1, 55, 56, 63, 64, 65, 127, 512, 3139])
+def test_sha256_rows_matches_hashlib(L):
+    rng = np.random.default_rng(L)
+    B = 5
+    msgs = rng.integers(0, 256, (B, L), dtype=np.uint8)
+    got = np.asarray(sj.sha256_rows(jnp.asarray(msgs)))
+    for i in range(B):
+        want = hashlib.sha256(msgs[i].tobytes()).digest()
+        assert bytes(got[i]) == want, f"row {i} len {L}"
+
+
+def test_digest_to_q_limbs(pgroup):
+    rng = np.random.default_rng(3)
+    digests = rng.integers(0, 256, (32, 32), dtype=np.uint8)
+    # include a digest >= q (q = 2^256 - 189: bytes all 0xFF)
+    digests[0] = 0xFF
+    got = sj.digest_to_q_limbs(pgroup, jnp.asarray(digests))
+    for i in range(digests.shape[0]):
+        want = int.from_bytes(bytes(digests[i]), "big") % pgroup.q
+        assert bn.limbs_to_int(np.asarray(got[i])) == want
+
+
+def test_batch_challenge_matches_hash_elems(pgroup):
+    g = pgroup
+    rng = np.random.default_rng(11)
+    B = 7
+    qbar = g.int_to_q(int.from_bytes(rng.bytes(32), "big"))
+    elems = [[g.int_to_p(pow(g.g, int(rng.integers(1, 1 << 60)), g.p))
+              for _ in range(B)] for _ in range(6)]
+    elem_bytes = [
+        np.stack([np.frombuffer(e.to_bytes(), np.uint8) for e in col])
+        for col in elems]
+    prefix = _encode(qbar)
+    got = np.asarray(sj.batch_challenge_p(g, prefix, elem_bytes))
+    for i in range(B):
+        want = hash_elems(g, qbar, *[col[i] for col in elems]).value
+        assert bn.limbs_to_int(got[i]) == want
+
+
+def test_batch_challenge_roundtrip_from_limbs(pgroup):
+    """The path the verifier uses: device limb arrays -> byte images ->
+    batch challenge, vs scalar hash_elems over bytes_to_p elements."""
+    g = pgroup
+    rng = np.random.default_rng(13)
+    B = 4
+    vals = [pow(g.g, int(rng.integers(1, 1 << 50)), g.p) for _ in range(B)]
+    limbs = bn.ints_to_limbs(vals, 256)
+    byte_img = limbs_to_bytes_be(limbs)
+    qbar = g.int_to_q(12345)
+    got = np.asarray(sj.batch_challenge_p(g, _encode(qbar), [byte_img]))
+    for i in range(B):
+        want = hash_elems(g, qbar, g.int_to_p(vals[i])).value
+        assert bn.limbs_to_int(got[i]) == want
