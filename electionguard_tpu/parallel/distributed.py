@@ -1,0 +1,100 @@
+"""Multi-host distributed plane: XLA collectives over ICI + DCN.
+
+The reference scales across trust domains with gRPC only (SURVEY.md §5.8 —
+no NCCL/MPI, no collectives); its math plane is one JVM.  Our math plane
+must span hosts the way the reference's gRPC plane spans guardians: this
+module initializes JAX's distributed runtime (one process per host, GCE-or-
+coordinator discovery exactly like jax on TPU pods) and lays the election
+mesh out so that
+
+* ``wp`` (PowRadix window parallelism, all-gather heavy) stays inside one
+  host's ICI domain, and
+* ``dp`` (the ballot/selection batch axis, zero-communication elementwise
+  work + one log-depth tally product) spans hosts over DCN,
+
+which keeps every latency-sensitive collective on ICI and sends only the
+embarrassingly-parallel axis across the data-center network.
+
+Hosts feed their full host-local batch through ``global_batch`` /
+``local_result``; array construction uses ``make_array_from_callback`` so
+each process materializes only its addressable shards.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from electionguard_tpu.parallel.mesh import DP_AXIS, WP_AXIS
+
+
+def distributed_init(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Initialize the JAX distributed runtime (idempotent).
+
+    Arguments default to the EGTPU_COORDINATOR / EGTPU_NUM_PROCESSES /
+    EGTPU_PROCESS_ID environment variables; on TPU pods all three may be
+    None and jax discovers the topology itself.
+    """
+    if jax._src.distributed.global_state.client is not None:  # already up
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "EGTPU_COORDINATOR")
+    if num_processes is None and "EGTPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["EGTPU_NUM_PROCESSES"])
+    if process_id is None and "EGTPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["EGTPU_PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+
+
+def multihost_election_mesh(wp: int = 1,
+                            devices: Optional[Sequence[jax.Device]] = None
+                            ) -> Mesh:
+    """(dp, wp) mesh over ALL processes' devices, ordered so each wp group
+    is process-local (wp collectives ride ICI; dp spans DCN)."""
+    if devices is None:
+        devices = jax.devices()
+    by_proc: dict[int, list[jax.Device]] = {}
+    for d in devices:
+        by_proc.setdefault(d.process_index, []).append(d)
+    ordered: list[jax.Device] = []
+    for pid in sorted(by_proc):
+        local = by_proc[pid]
+        if len(local) % wp != 0:
+            raise ValueError(
+                f"wp={wp} must divide each host's device count "
+                f"({len(local)} on process {pid})")
+        ordered.extend(local)
+    n = len(ordered)
+    dev = np.asarray(ordered).reshape(n // wp, wp)
+    return Mesh(dev, (DP_AXIS, WP_AXIS))
+
+
+def global_batch(mesh: Mesh, arr: np.ndarray,
+                 spec: Optional[P] = None) -> jax.Array:
+    """Host-local full array -> global dp-sharded device array.
+
+    Every process passes the SAME full batch (the coordinator broadcasts
+    work host-side, mirroring the reference's batched rpcs); each process
+    materializes only its addressable shards.
+    """
+    spec = spec if spec is not None else P(DP_AXIS)
+    sharding = NamedSharding(mesh, spec)
+    arr = np.asarray(arr)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
+def local_result(x: jax.Array) -> np.ndarray:
+    """Replicated-output device array -> host numpy (first local replica)."""
+    shards = x.addressable_shards
+    return np.asarray(shards[0].data)

@@ -1,0 +1,99 @@
+"""Multi-host integration test: two OS processes, each with 4 virtual CPU
+devices, form one 8-device JAX distributed runtime and run the sharded
+group ops across the process (DCN) boundary — the CPU stand-in for a
+multi-host TPU pod (SURVEY.md §5.8's second communication plane)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+for k in list(os.environ):
+    if "AXON" in k or "PALLAS" in k or k.startswith("TPU"):
+        os.environ.pop(k)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from electionguard_tpu.parallel.distributed import (
+    distributed_init, global_batch, local_result, multihost_election_mesh)
+
+# must run before anything creates device constants (bignum_jax does at
+# import time), which would initialise the XLA backend prematurely
+distributed_init()
+
+from electionguard_tpu.parallel.mesh import DP_AXIS
+from electionguard_tpu.core.group import tiny_group
+from electionguard_tpu.core.group_jax import JaxGroupOps
+from electionguard_tpu.core import bignum_jax as bn
+import jax.numpy as jnp
+from jax import shard_map as _sm
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+mesh = multihost_election_mesh(wp=1)
+g = tiny_group()
+ops = JaxGroupOps(g, backend="cios")
+
+B = 16
+rng = np.random.default_rng(0)
+bases = [pow(g.g, int(e), g.p) for e in rng.integers(1, 1 << 30, B)]
+exps = [int(e) for e in rng.integers(1, 1 << 30, B)]
+A = ops.to_limbs_p(bases)
+E = ops.to_limbs_q(exps)
+
+mapped = _sm(
+    ops._powmod_impl, mesh=mesh,
+    in_specs=(P(DP_AXIS), P(DP_AXIS)), out_specs=P(DP_AXIS),
+    check_vma=False)
+
+
+@jax.jit
+def step(a, e):
+    out = mapped(a, e)
+    # bring the dp-sharded result back replicated so every host can read it
+    return jax.lax.with_sharding_constraint(
+        out, NamedSharding(mesh, P()))
+
+
+out = step(global_batch(mesh, A), global_batch(mesh, E))
+got = local_result(out)
+want = [pow(b, e, g.p) for b, e in zip(bases, exps)]
+assert bn.limbs_to_ints(got) == want, "cross-host powmod mismatch"
+print(f"proc {jax.process_index()} OK", flush=True)
+"""
+
+
+@pytest.mark.timeout(300)
+def test_two_process_sharded_powmod(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env_base = {k: v for k, v in os.environ.items()
+                if "AXON" not in k and "PALLAS" not in k
+                and not k.startswith("TPU")}
+    procs = []
+    for pid in range(2):
+        env = dict(env_base,
+                   EGTPU_COORDINATOR=f"127.0.0.1:{port}",
+                   EGTPU_NUM_PROCESSES="2",
+                   EGTPU_PROCESS_ID=str(pid),
+                   PYTHONPATH=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
+        assert "OK" in out
