@@ -29,17 +29,19 @@ def main(argv=None) -> int:
     group = resolve_group(args)
     consumer = Consumer(args.input, group)
     init = consumer.read_election_initialized()
-    ballots = list(consumer.iterate_encrypted_ballots())
     publisher = Publisher(args.output)
 
     sw = Stopwatch()
     with maybe_profile("accumulate"):
-        result = accumulate_ballots(init, ballots, args.name,
+        # lazy iterator: million-ballot records stream with O(chunk) memory
+        result = accumulate_ballots(init,
+                                    consumer.iterate_encrypted_ballots(),
+                                    args.name,
                                     {"created_by": "RunAccumulateTally"})
     publisher.write_tally_result(result)
+    n_cast = result.encrypted_tally.cast_ballot_count
     log.info("%s; %d cast ballots accumulated",
-             sw.took("accumulation", max(len(ballots), 1)),
-             result.encrypted_tally.cast_ballot_count)
+             sw.took("accumulation", max(n_cast, 1)), n_cast)
     return 0
 
 

@@ -30,6 +30,11 @@ def main(argv=None) -> int:
     ap.add_argument("-fixedNonces", dest="fixed_nonces", action="store_true",
                     help="derive nonces deterministically from a fixed seed")
     ap.add_argument("-batchSize", dest="batch_size", type=int, default=8192)
+    ap.add_argument("-spoilEvery", dest="spoil_every", type=int, default=0,
+                    help="mark every Nth ballot SPOILED instead of CAST "
+                         "(0 = none); spoiled ballots are excluded from the "
+                         "tally and decrypted individually when the "
+                         "decryptor runs with -decryptSpoiled")
     add_group_flag(ap)
     args = ap.parse_args(argv)
 
@@ -42,38 +47,48 @@ def main(argv=None) -> int:
     import os
 
     from electionguard_tpu.ballot.plaintext import PlaintextBallot
-    ballots = []
-    for path in sorted(glob.glob(os.path.join(args.ballots, "*.json"))):
-        with open(path) as f:
-            ballots.append(PlaintextBallot.from_json(f.read()))
-    if not ballots:
+    paths = sorted(glob.glob(os.path.join(args.ballots, "*.json")))
+    if not paths:
         log.error("no plaintext ballots found under %s", args.ballots)
         return 2
 
     sw = Stopwatch()
     enc = BatchEncryptor(init, group)
     seed = group.int_to_q(42) if args.fixed_nonces else group.rand_q()
-    # chunk the ballot stream so device/host memory stays bounded; the
-    # confirmation-code chain continues across chunks via code_seed
-    encrypted, invalid = [], []
+    # fully streaming: plaintext ballots are loaded, encrypted, written,
+    # and dropped one chunk at a time — host memory stays O(batchSize).
+    # The confirmation-code chain continues across chunks via code_seed;
+    # ballot_index_base keeps device-derived nonces unique across chunks.
+    n_invalid = n_spoiled = 0
     code_seed = None
-    with maybe_profile("encrypt"):
-        for lo in range(0, len(ballots), args.batch_size):
-            chunk = ballots[lo:lo + args.batch_size]
+    inv_pub = Publisher(args.invalid_dir) if args.invalid_dir else publisher
+    with maybe_profile("encrypt"), \
+            publisher.open_encrypted_ballots() as stream:
+        for lo in range(0, len(paths), args.batch_size):
+            chunk = []
+            for path in paths[lo:lo + args.batch_size]:
+                with open(path) as f:
+                    chunk.append(PlaintextBallot.from_json(f.read()))
+            spoiled_ids = ({b.ballot_id for i, b in enumerate(chunk)
+                            if (lo + i + 1) % args.spoil_every == 0}
+                           if args.spoil_every > 0 else set())
             enc_chunk, inv_chunk = enc.encrypt_ballots(
-                chunk, seed=seed, code_seed=code_seed)
-            encrypted.extend(enc_chunk)
-            invalid.extend(inv_chunk)
+                chunk, seed=seed, code_seed=code_seed,
+                ballot_index_base=lo, spoiled_ids=spoiled_ids)
+            for b in enc_chunk:
+                stream.write(b)
+                n_spoiled += b.ballot_id in spoiled_ids
+            for b, reason in inv_chunk:
+                log.warning("invalid ballot %s: %s", b.ballot_id, reason)
+                inv_pub.write_plaintext_ballot("invalid_ballots", b)
+                n_invalid += 1
             if enc_chunk:
                 code_seed = enc_chunk[-1].code
-    n = publisher.write_encrypted_ballots(encrypted)
-    if invalid:
-        inv_pub = Publisher(args.invalid_dir) if args.invalid_dir else publisher
-        for b, reason in invalid:
-            log.warning("invalid ballot %s: %s", b.ballot_id, reason)
-            inv_pub.write_plaintext_ballot("invalid_ballots", b)
+        n = stream.n
+    if args.spoil_every:
+        log.info("spoiled %d of %d ballots", n_spoiled, n)
     log.info("%s; %d encrypted, %d invalid",
-             sw.took("encryption", max(n, 1)), n, len(invalid))
+             sw.took("encryption", max(n, 1)), n, n_invalid)
     return 0
 
 

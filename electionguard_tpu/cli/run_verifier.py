@@ -12,8 +12,8 @@ import sys
 
 from electionguard_tpu.cli.common import (Stopwatch, add_group_flag,
                                           resolve_group, setup_logging)
-from electionguard_tpu.publish.publisher import (Consumer,
-                                                 election_record_from_consumer)
+from electionguard_tpu.publish.election_record import ElectionRecord
+from electionguard_tpu.publish.publisher import Consumer
 from electionguard_tpu.verify.verifier import Verifier
 from electionguard_tpu.utils import maybe_profile
 
@@ -23,23 +23,46 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser("RunVerifier")
     ap.add_argument("-in", dest="input", required=True,
                     help="election record dir")
+    ap.add_argument("-chunkSize", dest="chunk_size", type=int, default=4096,
+                    help="ballots resident/dispatched at once (streaming)")
     add_group_flag(ap)
     args = ap.parse_args(argv)
 
     group = resolve_group(args)
+    n_seen = 0
     try:
-        record = election_record_from_consumer(Consumer(args.input, group))
+        consumer = Consumer(args.input, group)
+        record = ElectionRecord(consumer.read_election_initialized())
+        if consumer.has_tally_result():
+            record.tally_result = consumer.read_tally_result()
+        if consumer.has_decryption_result():
+            record.decryption_result = consumer.read_decryption_result()
+        record.spoiled_ballot_tallies = list(
+            consumer.iterate_spoiled_ballot_tallies())
+
+        def counting_ballots():
+            nonlocal n_seen
+            for b in consumer.iterate_encrypted_ballots():
+                n_seen += 1
+                yield b
+
+        # lazy ballot stream: O(chunk) host residency at any record size
+        record.encrypted_ballots = counting_ballots()
     except Exception as e:  # corrupt/truncated record is a verification FAIL
         log.error("record unreadable (corrupt or truncated): %s", e)
         return 1
 
     sw = Stopwatch()
-    with maybe_profile("verify"):
-        res = Verifier(record, group).verify()
+    try:
+        with maybe_profile("verify"):
+            res = Verifier(record, group,
+                           chunk_size=args.chunk_size).verify()
+    except Exception as e:  # truncated ballot stream surfaces mid-iteration
+        log.error("record unreadable (corrupt or truncated): %s", e)
+        return 1
     print(res.summary())
     log.info("%s; ok=%s",
-             sw.took("verification", max(len(record.encrypted_ballots), 1)),
-             res.ok)
+             sw.took("verification", max(n_seen, 1)), res.ok)
     return 0 if res.ok else 1
 
 

@@ -35,7 +35,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-MASK16 = jnp.uint32(0xFFFF)
+# numpy scalar, NOT jnp: creating a device array at import time would
+# initialise the XLA backend before jax.distributed.initialize can run
+# (multi-host workers import this module before calling distributed_init).
+MASK16 = np.uint32(0xFFFF)
 U32 = jnp.uint32
 
 

@@ -149,6 +149,17 @@ class GroupContext:
     def bytes_to_p(self, b: bytes) -> ElementModP:
         return ElementModP(int.from_bytes(b, "big"), self)
 
+    def fingerprint(self) -> bytes:
+        """32-byte SHA-256 of the (p, q, g) wire-width byte images — the
+        registration-time group-constants check (reference defined but
+        never populated the analogous field: decrypting_rpc.proto:20)."""
+        import hashlib
+        h = hashlib.sha256()
+        h.update(self.p.to_bytes(self.spec.p_bytes, "big"))
+        h.update(self.q.to_bytes(self.spec.q_bytes, "big"))
+        h.update(self.g.to_bytes(self.spec.p_bytes, "big"))
+        return h.digest()
+
     def rand_q(self, minimum: int = 2) -> ElementModQ:
         """Uniform random element of [minimum, q) via rejection sampling.
 

@@ -183,9 +183,8 @@ class JaxGroupOps:
     # ------------------------------------------------------------------
     @staticmethod
     def _bucket(b: int) -> int:
-        if b <= 16:
-            return 16
-        return 1 << (b - 1).bit_length()
+        from electionguard_tpu.utils import batch_bucket
+        return batch_bucket(b)
 
     def _pad(self, arr, fill_one: bool):
         """Pad (B, n) to the bucketed batch; fill rows with 1 or 0."""

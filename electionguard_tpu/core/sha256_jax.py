@@ -137,7 +137,8 @@ def _hash_rows_mod_q(msgs: jax.Array, q_limbs: jax.Array) -> jax.Array:
 
 
 def _bucket(b: int) -> int:
-    return 16 if b <= 16 else 1 << (b - 1).bit_length()
+    from electionguard_tpu.utils import batch_bucket
+    return batch_bucket(b)
 
 
 def supports(group) -> bool:

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 from typing import Optional, Sequence, Union
 
+import numpy as np
+
 from electionguard_tpu.ballot.ciphertext import EncryptedBallot
 from electionguard_tpu.ballot.tally import (EncryptedTally, PartialDecryption,
                                             PlaintextTally,
@@ -32,6 +34,8 @@ from electionguard_tpu.ballot.tally import (EncryptedTally, PartialDecryption,
 from electionguard_tpu.core.dlog import DLog
 from electionguard_tpu.core.group import (ElementModP, ElementModQ,
                                           GroupContext)
+from electionguard_tpu.core.group_jax import jax_ops
+from electionguard_tpu.crypto.cp_batch import batch_cp_verify
 from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
 from electionguard_tpu.decrypt.interface import DecryptingTrusteeIF
 from electionguard_tpu.keyceremony.interface import Result
@@ -102,9 +106,24 @@ class Decryption:
     def _decrypt_batch(
             self, texts: list[ElGamalCiphertext]
     ) -> list[tuple[int, ElementModP, tuple[PartialDecryption, ...]]]:
-        """Decrypt a batch of ciphertexts; returns (t, g^t, shares) each."""
+        """Decrypt a batch of ciphertexts; returns (t, g^t, shares) each.
+
+        Every modexp runs on the device plane in a handful of dispatches:
+        all on-arrival CP proof checks through ``batch_cp_verify``, the
+        Lagrange recombination powers through one ``powmod`` dispatch, and
+        the share-product inverses through one ``powmod`` with exponent
+        q-1 (valid because every Mᵢ that survived its proof check lies in
+        the q-order subgroup; a host-side ``inv·M == 1`` guard catches any
+        violation).  No per-selection host ``pow``.
+        """
         g = self.group
         qbar = self.init.extended_base_hash
+        ops = jax_ops(g)
+        n = len(texts)
+        pads = [ct.pad.value for ct in texts]
+
+        cp_x, cp_g2, cp_y, cp_c, cp_v = [], [], [], [], []
+        cp_err: list[str] = []
 
         # direct shares: one batched call per available trustee
         direct: dict[str, list] = {}
@@ -112,15 +131,16 @@ class Decryption:
             res = t.direct_decrypt(texts, qbar)
             if isinstance(res, Result):
                 raise DecryptionError(f"{t.id} directDecrypt: {res.error}")
-            if len(res) != len(texts):
+            if len(res) != n:
                 raise DecryptionError(f"{t.id} returned wrong batch size")
-            rec = self.init.guardian(t.id)
-            for ct, d in zip(texts, res):
-                if not d.proof.is_valid(g.G_MOD_P,
-                                        rec.coefficient_commitments[0],
-                                        ct.pad, d.partial_decryption, qbar):
-                    raise DecryptionError(
-                        f"direct decryption proof of {t.id} invalid")
+            k0 = self.init.guardian(t.id).coefficient_commitments[0].value
+            for pad, d in zip(pads, res):
+                cp_x.append(k0)
+                cp_g2.append(pad)
+                cp_y.append(d.partial_decryption.value)
+                cp_c.append(d.proof.challenge.value)
+                cp_v.append(d.proof.response.value)
+                cp_err.append(f"direct decryption proof of {t.id} invalid")
             direct[t.id] = res
 
         # compensated shares: per missing guardian, per available trustee
@@ -133,47 +153,79 @@ class Decryption:
                 if isinstance(res, Result):
                     raise DecryptionError(
                         f"{t.id} compensatedDecrypt({m}): {res.error}")
-                if len(res) != len(texts):
+                if len(res) != n:
                     raise DecryptionError(
                         f"{t.id} returned wrong batch size for {m}")
                 expected_recovery = commitment_product(
                     g, m_rec.coefficient_commitments, t.x_coordinate)
-                for ct, c in zip(texts, res):
+                for pad, c in zip(pads, res):
                     if c.recovered_public_key_share != expected_recovery:
                         raise DecryptionError(
                             f"recovery key of {t.id} for {m} mismatches "
                             f"public commitments")
-                    if not c.proof.is_valid(
-                            g.G_MOD_P, c.recovered_public_key_share,
-                            ct.pad, c.partial_decryption, qbar):
-                        raise DecryptionError(
-                            f"compensated proof of {t.id} for {m} invalid")
+                    cp_x.append(c.recovered_public_key_share.value)
+                    cp_g2.append(pad)
+                    cp_y.append(c.partial_decryption.value)
+                    cp_c.append(c.proof.challenge.value)
+                    cp_v.append(c.proof.response.value)
+                    cp_err.append(
+                        f"compensated proof of {t.id} for {m} invalid")
                 per_trustee[t.id] = res
             compensated[m] = per_trustee
 
-        # combine per ciphertext
+        ok = batch_cp_verify(g, cp_x, cp_g2, cp_y, cp_c, cp_v, qbar)
+        bad = np.nonzero(~ok)[0]
+        if bad.size:
+            raise DecryptionError(cp_err[int(bad[0])])
+
+        # Lagrange recombination M_m = Π_ℓ parts^{w_ℓ}: ONE powmod dispatch
+        # over every (missing × trustee × text) row, then host products
+        recovered: dict[str, list[int]] = {}
+        if self.missing:
+            rows, exps = [], []
+            for m in self.missing:
+                for t in self.trustees:
+                    w = self.lagrange[t.id].value
+                    for c in compensated[m][t.id]:
+                        rows.append(c.partial_decryption.value)
+                        exps.append(w)
+            pows = ops.powmod_ints(rows, exps)
+            i = 0
+            for m in self.missing:
+                acc = [1] * n
+                for t in self.trustees:
+                    for k in range(n):
+                        acc[k] = acc[k] * pows[i] % g.p
+                        i += 1
+                recovered[m] = acc
+
+        m_totals = []
+        for idx in range(n):
+            mt = 1
+            for t in self.trustees:
+                mt = mt * direct[t.id][idx].partial_decryption.value % g.p
+            for m in self.missing:
+                mt = mt * recovered[m][idx] % g.p
+            m_totals.append(mt)
+
+        # value = B · (Π Mᵢ)^{-1}; subgroup inverse = ^(q-1), one dispatch
+        inv = ops.powmod_ints(m_totals, [g.q - 1] * n)
         out = []
         for idx, ct in enumerate(texts):
+            if inv[idx] * m_totals[idx] % g.p != 1:
+                raise DecryptionError(
+                    "share product is not in the q-order subgroup")
             shares: list[PartialDecryption] = []
-            m_total = g.ONE_MOD_P
             for t in self.trustees:
                 d = direct[t.id][idx]
-                m_total = g.mult_p(m_total, d.partial_decryption)
                 shares.append(PartialDecryption(
                     t.id, d.partial_decryption, d.proof))
             for m in self.missing:
-                recovered = g.ONE_MOD_P
-                parts = {}
-                for t in self.trustees:
-                    c = compensated[m][t.id][idx]
-                    recovered = g.mult_p(
-                        recovered,
-                        g.pow_p(c.partial_decryption, self.lagrange[t.id]))
-                    parts[t.id] = c
-                m_total = g.mult_p(m_total, recovered)
+                parts = {t.id: compensated[m][t.id][idx]
+                         for t in self.trustees}
                 shares.append(PartialDecryption(
-                    m, recovered, None, parts))
-            value = g.div_p(ct.data, m_total)  # g^t
+                    m, g.int_to_p(recovered[m][idx]), None, parts))
+            value = g.int_to_p(ct.data.value * inv[idx] % g.p)  # g^t
             t_val = self.dlog.dlog(value)
             if t_val is None:
                 raise DecryptionError("tally exceeds dlog table")

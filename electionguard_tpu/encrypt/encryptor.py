@@ -76,11 +76,23 @@ class BatchEncryptor:
             self, ballots: Sequence[PlaintextBallot],
             seed: Optional[ElementModQ] = None,
             code_seed: Optional[bytes] = None,
+            ballot_index_base: int = 0,
+            spoiled_ids: Optional[set] = None,
     ) -> tuple[list[EncryptedBallot], list[tuple[PlaintextBallot, str]]]:
         """Encrypt a batch.  Returns (encrypted, invalid) where invalid is
-        [(ballot, reason)] — mirroring batchEncryption's invalidDir."""
+        [(ballot, reason)] — mirroring batchEncryption's invalidDir.
+
+        ``ballot_index_base``: position of ``ballots[0]`` in the overall
+        stream — callers encrypting chunk-by-chunk under one seed MUST pass
+        it so device-derived nonces stay unique across chunks.
+        ``spoiled_ids``: ballot ids to mark SPOILED instead of CAST — they
+        stay in the code chain but are excluded from the tally and become
+        eligible for spoiled-ballot decryption (reference:
+        RunRemoteDecryptor.java:264-269).
+        """
         g = self.group
         seed = seed if seed is not None else g.rand_q()
+        spoiled_ids = spoiled_ids or set()
         code_seed = code_seed if code_seed is not None else \
             hash_digest("code-chain-start", self.init.manifest_hash)
 
@@ -88,11 +100,12 @@ class BatchEncryptor:
         valid: list[PlaintextBallot] = []
         invalid: list[tuple[PlaintextBallot, str]] = []
         flat = _FlatSelections([], [], [], [], [], [])
-        contest_rows: list[tuple[int, int, str, int, int]] = []
-        # (ballot_idx, contest_idx, contest_id, seq, limit)
+        nonce_idx: list[int] = []     # (global ballot pos << 24) | ordinal
+        contest_rows: list[tuple[int, int, str, int, int, int]] = []
+        # (ballot_idx, contest_idx, contest_id, seq, limit, nonce_idx)
         contests_by_id = {c.object_id: c for c in self.manifest.contests}
 
-        for b in ballots:
+        for pos, b in enumerate(ballots):
             reason = None
             cids = [c.contest_id for c in b.contests]
             if len(set(cids)) != len(cids):
@@ -125,6 +138,8 @@ class BatchEncryptor:
                 continue
             bi = len(valid)
             valid.append(b)
+            ballot_pos = ballot_index_base + pos
+            sel_ordinal = 0
             for ci, c in enumerate(b.contests):
                 desc = contests_by_id[c.contest_id]
                 limit = desc.votes_allowed
@@ -134,7 +149,8 @@ class BatchEncryptor:
                 for j in range(limit - sum(votes)):
                     pad_votes[j] = 1  # placeholders top the sum up to limit
                 contest_rows.append((bi, ci, c.contest_id,
-                                     desc.sequence_order, limit))
+                                     desc.sequence_order, limit,
+                                     (ballot_pos << 24) | ci))
                 for si, s in enumerate(c.selections):
                     flat.ballot_idx.append(bi)
                     flat.contest_idx.append(len(contest_rows) - 1)
@@ -142,6 +158,8 @@ class BatchEncryptor:
                     flat.sequence_orders.append(si)
                     flat.votes.append(s.vote)
                     flat.is_placeholder.append(False)
+                    nonce_idx.append((ballot_pos << 24) | sel_ordinal)
+                    sel_ordinal += 1
                 for j, pv in enumerate(pad_votes):
                     flat.ballot_idx.append(bi)
                     flat.contest_idx.append(len(contest_rows) - 1)
@@ -150,25 +168,37 @@ class BatchEncryptor:
                     flat.sequence_orders.append(n_real + j)
                     flat.votes.append(pv)
                     flat.is_placeholder.append(True)
+                    nonce_idx.append((ballot_pos << 24) | sel_ordinal)
+                    sel_ordinal += 1
 
         S = len(flat.votes)
         C = len(contest_rows)
         if S == 0:
             return [], invalid
 
-        # ---- host: nonce + fake-branch scalar streams -------------------
+        # ---- nonce + fake-branch scalar streams -------------------------
+        # The four per-selection scalars (R, U, CF, VF) are internal
+        # secrets: they must be deterministic in the seed, unique per
+        # position, and uniform mod q — nothing external ever re-derives
+        # them.  On the production group they come from ONE device SHA-256
+        # dispatch over fixed-width rows binding (seed, stream tag, flat
+        # index); other groups fall back to host hashing.
         q = g.q
-        R = np.empty(S, dtype=object)
-        U = np.empty(S, dtype=object)
-        CF = np.empty(S, dtype=object)
-        VF = np.empty(S, dtype=object)
-        for i in range(S):
-            h = hash_elems(g, seed, valid[flat.ballot_idx[i]].ballot_id,
-                           flat.contest_idx[i], flat.selection_ids[i])
-            R[i] = h.value
-            U[i] = hash_elems(g, h, "u").value
-            CF[i] = hash_elems(g, h, "cf").value
-            VF[i] = hash_elems(g, h, "vf").value
+        if sha256_jax.supports(g):
+            R, U, CF, VF = _derive_selection_nonces(
+                g, self.eops, seed, np.asarray(nonce_idx, dtype=np.uint64))
+        else:
+            R = np.empty(S, dtype=object)
+            U = np.empty(S, dtype=object)
+            CF = np.empty(S, dtype=object)
+            VF = np.empty(S, dtype=object)
+            for i in range(S):
+                h = hash_elems(g, seed, valid[flat.ballot_idx[i]].ballot_id,
+                               flat.contest_idx[i], flat.selection_ids[i])
+                R[i] = h.value
+                U[i] = hash_elems(g, h, "u").value
+                CF[i] = hash_elems(g, h, "cf").value
+                VF[i] = hash_elems(g, h, "vf").value
 
         votes = np.array(flat.votes, dtype=np.int64)
 
@@ -246,9 +276,14 @@ class BatchEncryptor:
         for i in range(S):
             R_sum[flat.contest_idx[i]] = (R_sum[flat.contest_idx[i]] + R[i]) % q
             V_sum[flat.contest_idx[i]] += flat.votes[i]
-        U2 = [hash_elems(g, seed, "contest-u", ci,
-                         valid[row[0]].ballot_id).value
-              for ci, row in enumerate(contest_rows)]
+        if sha256_jax.supports(g):
+            U2 = _derive_contest_nonces(
+                g, self.eops, seed,
+                np.asarray([row[5] for row in contest_rows], dtype=np.uint64))
+        else:
+            U2 = [hash_elems(g, seed, "contest-u", ci,
+                             valid[row[0]].ballot_id).value
+                  for ci, row in enumerate(contest_rows)]
         RS_l = ee.to_limbs(R_sum)
         U2_l = ee.to_limbs(U2)
         VS_l = ee.to_limbs(V_sum)
@@ -317,7 +352,7 @@ class BatchEncryptor:
 
         contests_by_ballot: dict[int, list[EncryptedContest]] = {}
         for ci, row in enumerate(contest_rows):
-            bi, _, contest_id, seq, limit = row
+            bi, _, contest_id, seq, limit = row[:5]
             proof = ConstantChaumPedersenProof(
                 g.int_to_q(C2_i[ci]), g.int_to_q(V2[ci]), limit)
             contests_by_ballot.setdefault(bi, []).append(
@@ -329,16 +364,66 @@ class BatchEncryptor:
         timestamp = int(time.time())
         for bi, b in enumerate(valid):
             contests = tuple(contests_by_ballot.get(bi, []))
+            state = (BallotState.SPOILED if b.ballot_id in spoiled_ids
+                     else BallotState.CAST)
             partial = EncryptedBallot(
                 b.ballot_id, b.ballot_style_id, self.init.manifest_hash,
-                prev_code, b"", timestamp, contests, BallotState.CAST)
+                prev_code, b"", timestamp, contests, state)
             code = EncryptedBallot.make_code(prev_code, timestamp,
                                              partial.crypto_hash())
             out.append(EncryptedBallot(
                 b.ballot_id, b.ballot_style_id, self.init.manifest_hash,
-                prev_code, code, timestamp, contests, BallotState.CAST))
+                prev_code, code, timestamp, contests, state))
             prev_code = code
         return out, invalid
+
+
+def _nonce_rows(seed: ElementModQ, tags: np.ndarray,
+                idx: np.ndarray) -> np.ndarray:
+    """Fixed-width SHA-256 input rows: seed(32) || tag(1) || index(8 BE)."""
+    n = tags.shape[0]
+    msgs = np.zeros((n, 41), np.uint8)
+    msgs[:, :32] = np.frombuffer(seed.to_bytes(), np.uint8)
+    msgs[:, 32] = tags
+    msgs[:, 33:] = idx.astype(">u8").view(np.uint8).reshape(n, 8)
+    return msgs
+
+
+def _derive_nonce_ints(g, ee, msgs: np.ndarray) -> list[int]:
+    """Hash rows on-device, reduce mod q, return host ints.  Rows are
+    padded to the shared batch bucket so the whole workflow compiles a
+    handful of SHA shapes."""
+    import jax.numpy as jnp
+
+    from electionguard_tpu.utils import batch_bucket
+    n = msgs.shape[0]
+    nb = batch_bucket(n)
+    if nb != n:
+        msgs = np.concatenate(
+            [msgs, np.zeros((nb - n, msgs.shape[1]), np.uint8)])
+    limbs = np.asarray(sha256_jax.digest_to_q_limbs(
+        g, sha256_jax.sha256_rows(jnp.asarray(msgs))))[:n]
+    return ee.from_limbs(limbs)
+
+
+def _derive_selection_nonces(g, ee, seed: ElementModQ, idx: np.ndarray):
+    """(R, U, CF, VF) for all S selections in one device dispatch; ``idx``
+    is the per-selection global nonce index (unique across chunks)."""
+    S = idx.shape[0]
+    msgs = _nonce_rows(seed, np.repeat(np.arange(4, dtype=np.uint8), S),
+                       np.tile(idx, 4))
+    ints = _derive_nonce_ints(g, ee, msgs)
+    return (np.array(ints[:S], dtype=object),
+            np.array(ints[S:2 * S], dtype=object),
+            np.array(ints[2 * S:3 * S], dtype=object),
+            np.array(ints[3 * S:], dtype=object))
+
+
+def _derive_contest_nonces(g, ee, seed: ElementModQ,
+                           idx: np.ndarray) -> list[int]:
+    """Contest limit-proof nonces (stream tag 4), one device dispatch."""
+    msgs = _nonce_rows(seed, np.full(idx.shape[0], 4, np.uint8), idx)
+    return _derive_nonce_ints(g, ee, msgs)
 
 
 def _hash_disjunctive(g, qbar, alpha_b, beta_b, a0, b0, a1, b1) -> int:

@@ -42,7 +42,7 @@ def distributed_init(coordinator_address: Optional[str] = None,
     EGTPU_PROCESS_ID environment variables; on TPU pods all three may be
     None and jax discovers the topology itself.
     """
-    if jax._src.distributed.global_state.client is not None:  # already up
+    if jax.distributed.is_initialized():  # idempotent
         return
     coordinator_address = coordinator_address or os.environ.get(
         "EGTPU_COORDINATOR")
@@ -95,6 +95,15 @@ def global_batch(mesh: Mesh, arr: np.ndarray,
 
 
 def local_result(x: jax.Array) -> np.ndarray:
-    """Replicated-output device array -> host numpy (first local replica)."""
+    """Replicated-output device array -> host numpy (first local replica).
+
+    The input must be fully replicated (e.g. via a ``P()`` sharding
+    constraint); a dp-sharded array would silently yield one shard.
+    """
+    if not x.sharding.is_fully_replicated:
+        raise ValueError(
+            "local_result requires a fully replicated array; got sharding "
+            f"{x.sharding}. Add a with_sharding_constraint(..., P()) or "
+            "all-gather before reading the result host-side.")
     shards = x.addressable_shards
     return np.asarray(shards[0].data)

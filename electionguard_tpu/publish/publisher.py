@@ -91,14 +91,15 @@ class Publisher:
                 init).SerializeToString())
 
     def write_encrypted_ballots(self, ballots) -> int:
-        n = 0
-        with open(self._path(_BALLOTS), "wb") as f:
+        with self.open_encrypted_ballots() as stream:
             for b in ballots:
-                _write_frame(
-                    f, serialize.publish_encrypted_ballot(
-                        b).SerializeToString())
-                n += 1
-        return n
+                stream.write(b)
+            return stream.n
+
+    def open_encrypted_ballots(self) -> "EncryptedBallotStream":
+        """Incremental framed writer: callers encrypting chunk-by-chunk
+        write each chunk and drop it, keeping host memory O(chunk)."""
+        return EncryptedBallotStream(self._path(_BALLOTS))
 
     def write_tally_result(self, tally: TallyResult):
         with open(self._path(_TALLY), "wb") as f:
@@ -123,6 +124,29 @@ class Publisher:
         os.makedirs(d, exist_ok=True)
         with open(os.path.join(d, f"{ballot.ballot_id}.json"), "w") as f:
             f.write(ballot.to_json())
+
+
+class EncryptedBallotStream:
+    """Appending framed EncryptedBallot writer (see Publisher.open_encrypted_ballots)."""
+
+    def __init__(self, path: str):
+        self._f = open(path, "wb")
+        self.n = 0
+
+    def write(self, ballot: EncryptedBallot):
+        _write_frame(self._f, serialize.publish_encrypted_ballot(
+            ballot).SerializeToString())
+        self.n += 1
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
 
 class Consumer:

@@ -142,6 +142,12 @@ class DecryptionCoordinator:
         with self._lock:
             if self._started:
                 return Resp(error="decryption already started")
+            err = rpc_util.check_group_fingerprint(
+                self.group, request.group_fingerprint)
+            if err:
+                return Resp(
+                    error=err,
+                    constants=rpc_util.group_constants_msg(self.group))
             gid = request.guardian_id
             for p in self.proxies:
                 if p.id == gid:
@@ -158,7 +164,7 @@ class DecryptionCoordinator:
             self.proxies.append(proxy)
             log.info("registered decrypting trustee %s x=%d url=%s",
                      gid, request.x_coordinate, request.remote_url)
-            return Resp()
+            return Resp(constants=rpc_util.group_constants_msg(self.group))
 
     def ready(self) -> int:
         with self._lock:
@@ -198,13 +204,16 @@ class RemoteDecryptorProxy:
                                    "DecryptingRegistrationService")
 
     def register_trustee(self, guardian_id: str, remote_url: str,
-                         x_coordinate: int, public_key: ElementModP):
+                         x_coordinate: int, public_key: ElementModP,
+                         group: Optional[GroupContext] = None):
         return self._stub.call("registerTrustee",
                                pb.msg("RegisterDecryptingTrusteeRequest")(
                                    guardian_id=guardian_id,
                                    remote_url=remote_url,
                                    x_coordinate=x_coordinate,
-                                   public_key=serialize.publish_p(public_key)))
+                                   public_key=serialize.publish_p(public_key),
+                                   group_fingerprint=(group.fingerprint()
+                                                      if group else b"")))
 
     def close(self):
         self._channel.close()
@@ -235,12 +244,14 @@ class DecryptingTrusteeServer:
         try:
             resp = reg.register_trustee(
                 trustee.id, self.url, trustee.x_coordinate,
-                trustee.election_public_key)
+                trustee.election_public_key, group)
         finally:
             reg.close()
-        if resp.error:
+        err = resp.error or rpc_util.check_group_constants(
+            group, resp.constants)
+        if err:
             self.server.stop(grace=0)
-            raise RuntimeError(f"registration failed: {resp.error}")
+            raise RuntimeError(f"registration failed: {err}")
         log.info("decrypting trustee %s registered url=%s",
                  trustee.id, self.url)
 

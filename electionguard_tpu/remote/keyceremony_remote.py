@@ -186,6 +186,12 @@ class KeyCeremonyCoordinator:
         with self._lock:
             if self._started_ceremony:
                 return Resp(error="ceremony already started")
+            err = rpc_util.check_group_fingerprint(
+                self.group, request.group_fingerprint)
+            if err:
+                return Resp(
+                    error=err,
+                    constants=rpc_util.group_constants_msg(self.group))
             gid = request.guardian_id
             for p in self.proxies:
                 if p.id == gid:
@@ -198,7 +204,8 @@ class KeyCeremonyCoordinator:
             self.proxies.append(proxy)
             log.info("registered trustee %s x=%d url=%s", gid, x,
                      request.remote_url)
-            return Resp(guardian_id=gid, x_coordinate=x, quorum=self.quorum)
+            return Resp(guardian_id=gid, x_coordinate=x, quorum=self.quorum,
+                        constants=rpc_util.group_constants_msg(self.group))
 
     def ready(self) -> int:
         with self._lock:
@@ -244,11 +251,14 @@ class RemoteKeyCeremonyProxy:
             coordinator_url, rpc_util.MAX_REGISTRATION_MESSAGE)
         self._stub = rpc_util.Stub(self._channel, "RemoteKeyCeremonyService")
 
-    def register_trustee(self, guardian_id: str, remote_url: str):
+    def register_trustee(self, guardian_id: str, remote_url: str,
+                         group: Optional[GroupContext] = None):
         return self._stub.call("registerTrustee",
                                pb.msg("RegisterKeyCeremonyTrusteeRequest")(
                                    guardian_id=guardian_id,
-                                   remote_url=remote_url))
+                                   remote_url=remote_url,
+                                   group_fingerprint=(group.fingerprint()
+                                                      if group else b"")))
 
     def close(self):
         self._channel.close()
@@ -284,12 +294,14 @@ class KeyCeremonyTrusteeServer:
         # register with the coordinator; it assigns our x-coordinate
         reg = RemoteKeyCeremonyProxy(coordinator_url)
         try:
-            resp = reg.register_trustee(guardian_id, self.url)
+            resp = reg.register_trustee(guardian_id, self.url, group)
         finally:
             reg.close()
-        if resp.error:
+        err = resp.error or rpc_util.check_group_constants(
+            group, resp.constants)
+        if err:
             self.server.stop(grace=0)
-            raise RuntimeError(f"registration failed: {resp.error}")
+            raise RuntimeError(f"registration failed: {err}")
         self.x_coordinate = int(resp.x_coordinate)
         self.quorum = int(resp.quorum)
         self.trustee = KeyCeremonyTrustee(group, guardian_id,

@@ -63,6 +63,38 @@ class Stub:
         return self._methods[method](request, timeout=timeout)
 
 
+def group_constants_msg(group):
+    """The coordinator's GroupConstants for registration responses."""
+    return pb.msg("GroupConstants")(
+        p=group.p.to_bytes(group.spec.p_bytes, "big"),
+        q=group.q.to_bytes(group.spec.q_bytes, "big"),
+        g=group.g.to_bytes(group.spec.p_bytes, "big"),
+        name=group.spec.name)
+
+
+def check_group_fingerprint(group, fingerprint) -> str:
+    """Coordinator-side handshake check; "" if ok, else the in-band error."""
+    if fingerprint and bytes(fingerprint) != group.fingerprint():
+        return (f"group constants mismatch: coordinator runs group "
+                f"'{group.spec.name}'; start this trustee with the same "
+                f"-group")
+    return ""
+
+
+def check_group_constants(group, constants) -> str:
+    """Trustee-side check of the coordinator's response constants; "" if
+    ok (or constants absent — older coordinator), else the error."""
+    if not constants or not constants.p:
+        return ""
+    if (int.from_bytes(constants.p, "big") != group.p
+            or int.from_bytes(constants.q, "big") != group.q
+            or int.from_bytes(constants.g, "big") != group.g):
+        name = constants.name or "?"
+        return (f"group constants mismatch: coordinator runs group "
+                f"'{name}', this trustee runs '{group.spec.name}'")
+    return ""
+
+
 def make_channel(url: str, max_message: int = MAX_TRUSTEE_MESSAGE,
                  keepalive_ms: int = 60_000) -> grpc.Channel:
     """Plaintext channel with the reference's size/keepalive settings."""

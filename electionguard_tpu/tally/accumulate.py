@@ -28,10 +28,20 @@ from electionguard_tpu.publish.election_record import (ElectionInitialized,
 
 def accumulate_ballots(
         election_init: ElectionInitialized,
-        ballots: Sequence[EncryptedBallot],
+        ballots,
         tally_id: str = "tally",
-        metadata: Optional[dict] = None) -> TallyResult:
-    """Product-reduce all CAST ballots into an EncryptedTally."""
+        metadata: Optional[dict] = None,
+        chunk_size: int = 4096) -> TallyResult:
+    """Product-reduce all CAST ballots into an EncryptedTally.
+
+    ``ballots`` may be ANY iterable (e.g. a lazy
+    ``Consumer.iterate_encrypted_ballots()``): chunks of ``chunk_size``
+    are reduced with one device prod-reduce each and combined host-side
+    (2·nk modmuls per chunk), so a million-ballot record accumulates with
+    O(chunk) host residency (BASELINE.md config 4).
+    """
+    import itertools
+
     g = election_init.joint_public_key.group
     ops = jax_ops(g)
     manifest = election_init.config.manifest
@@ -42,8 +52,17 @@ def accumulate_ballots(
     key_idx = {k: i for i, k in enumerate(keys)}
     nk = len(keys)
 
-    cast = [b for b in ballots if b.state == BallotState.CAST]
-    if cast:
+    prod_ints = [1] * (2 * nk)
+    n_cast = 0
+    it = iter(ballots)
+    while True:
+        chunk = list(itertools.islice(it, chunk_size))
+        if not chunk:
+            break
+        cast = [b for b in chunk if b.state == BallotState.CAST]
+        if not cast:
+            continue
+        n_cast += len(cast)
         # (M, 2*nk) int matrix of pads|datas, ones where a ballot lacks a key
         rows = np.empty((len(cast), 2 * nk), dtype=object)
         rows[:] = 1
@@ -63,9 +82,8 @@ def accumulate_ballots(
         arr = np.stack([ops.to_limbs_p(list(rows[bi]))
                         for bi in range(len(cast))])  # (M, 2nk, n)
         prod = ops.prod_reduce(arr)                   # (2nk, n)
-        prod_ints = ops.from_limbs(np.asarray(prod))
-    else:
-        prod_ints = [1] * (2 * nk)
+        chunk_ints = ops.from_limbs(np.asarray(prod))
+        prod_ints = [a * b % g.p for a, b in zip(prod_ints, chunk_ints)]
 
     contests = []
     for c in manifest.contests:
@@ -80,6 +98,6 @@ def accumulate_ballots(
             c.object_id, c.sequence_order, tuple(sels)))
 
     tally = EncryptedTally(tally_id, tuple(contests),
-                           cast_ballot_count=len(cast))
+                           cast_ballot_count=n_cast)
     return TallyResult(election_init, tally, (tally_id,),
                        dict(metadata or {}))

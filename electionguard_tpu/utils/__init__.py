@@ -6,6 +6,15 @@ import contextlib
 import os
 
 
+def batch_bucket(b: int) -> int:
+    """Shared batch-shape bucketing policy: pad every dispatch batch up to
+    16 or the next power of two, so the whole workflow compiles a handful
+    of shapes.  The group-op plane (core/group_jax.py) and the hash plane
+    (core/sha256_jax.py) must agree on this or they compile mismatched
+    batch shapes for the same workload."""
+    return 16 if b <= 16 else 1 << (b - 1).bit_length()
+
+
 @contextlib.contextmanager
 def maybe_profile(tag: str):
     """JAX profiler trace for one workflow phase when EGTPU_PROFILE=<dir>

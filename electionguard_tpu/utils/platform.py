@@ -1,0 +1,107 @@
+"""Platform selection for driver entry points (bench.py, __graft_entry__).
+
+The single real TPU chip is reached through the experimental ``axon`` PJRT
+tunnel, which dials its relay at backend init regardless of
+``JAX_PLATFORMS`` and can wedge for long stretches — a bare ``import jax``
+then HANGS rather than erroring.  These helpers decide the platform with a
+bounded subprocess probe BEFORE the first ``import jax`` in the calling
+process, falling back to CPU by stripping the tunnel env (the same escape
+hatch tests/conftest.py uses).
+
+Pure stdlib: importing this module must never touch jax.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Mapping, MutableMapping, Optional
+
+#: Env-var name fragments that attach the process to the axon TPU tunnel.
+_TUNNEL_KEYS = ("AXON", "PALLAS")
+
+
+def _is_tunnel_var(key: str) -> bool:
+    return any(t in key for t in _TUNNEL_KEYS) or key.startswith("TPU")
+
+
+def detach_axon(env: Optional[MutableMapping[str, str]] = None) -> None:
+    """Strip the axon/TPU tunnel env and pin JAX to CPU.
+
+    Mutates ``os.environ`` unless an explicit mapping is given.  In this
+    environment a site hook pre-imports jax at interpreter startup, so the
+    ``JAX_PLATFORMS`` env var alone comes too late for the current
+    process — when mutating ``os.environ`` we also flip the live jax
+    config (safe: it does not initialize any backend).
+    """
+    env = os.environ if env is None else env
+    for k in list(env):
+        if _is_tunnel_var(k):
+            env.pop(k)
+    env["JAX_PLATFORMS"] = "cpu"
+    if env is os.environ and "jax" in sys.modules:
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+
+def cpu_mesh_env(n_devices: int,
+                 base: Optional[Mapping[str, str]] = None) -> dict:
+    """A detached copy of the env with ``n_devices`` virtual CPU devices —
+    the same configuration tests/conftest.py forces for sharding tests."""
+    env = dict(os.environ if base is None else base)
+    detach_axon(env)
+    flags = env.get("XLA_FLAGS", "")
+    # drop any stale forced-count flag, then set ours
+    flags = " ".join(f for f in flags.split()
+                     if "xla_force_host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    return env
+
+
+def probe_tpu(timeout: float = 90.0) -> bool:
+    """True iff a fresh subprocess (inheriting this env) can initialise the
+    TPU backend within ``timeout`` seconds.  A wedged relay hangs the
+    child — the timeout kills it; a backend setup error exits nonzero."""
+    code = ("import jax; d = jax.devices(); "
+            "assert d and d[0].platform != 'cpu', d; print(d[0].platform)")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def ensure_tpu_or_cpu(probe_timeout: float = 90.0,
+                      retries: int = 2,
+                      retry_wait: float = 20.0,
+                      log=print) -> str:
+    """Decide the platform for this process, mutating ``os.environ``.
+
+    If no tunnel env is present, leaves everything alone.  Otherwise probes
+    TPU reachability in a subprocess up to ``retries`` times (bounded —
+    never hangs the caller); on failure detaches the tunnel and pins CPU.
+    Returns ``"tpu"`` or ``"cpu"``.  Call before the first backend touch
+    (never calls ``jax.devices()``/``default_backend()`` in this process —
+    with a wedged tunnel those hang).
+    """
+    if not any(_is_tunnel_var(k) for k in os.environ):
+        return "cpu"
+    for attempt in range(max(1, retries)):
+        if attempt:
+            time.sleep(retry_wait)
+        if probe_tpu(probe_timeout):
+            return "tpu"
+        log(f"# tpu probe {attempt + 1}/{retries} failed "
+            f"(timeout={probe_timeout:.0f}s)", file=sys.stderr)
+    log("# falling back to CPU: axon tunnel unreachable", file=sys.stderr)
+    detach_axon()
+    return "cpu"

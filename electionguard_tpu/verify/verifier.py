@@ -39,6 +39,7 @@ from electionguard_tpu.core.group_jax import (jax_exp_ops, jax_ops,
                                               limbs_to_bytes_be)
 from electionguard_tpu.core import sha256_jax
 from electionguard_tpu.core.hash import _encode, hash_elems
+from electionguard_tpu.crypto.cp_batch import batch_cp_verify
 from electionguard_tpu.decrypt.decryption import lagrange_coefficient
 from electionguard_tpu.keyceremony.trustee import commitment_product
 from electionguard_tpu.publish.election_record import ElectionRecord
@@ -64,28 +65,56 @@ class VerificationResult:
         return "\n".join(lines + self.errors)
 
 
+@dataclass
+class _BallotAggregates:
+    """Cross-chunk state for streaming verification: V7 products, the code
+    chain tail, cast/spoiled bookkeeping — everything later checks need,
+    so host residency stays O(chunk) (SURVEY.md §7 hard part 4)."""
+
+    prods: dict = field(default_factory=dict)   # (contest,sel) -> (pa, pb)
+    cast_count: int = 0
+    total_count: int = 0
+    spoiled_ids: set = field(default_factory=set)
+    prev_code: Optional[bytes] = None           # last ballot's code
+
+
 class Verifier:
-    def __init__(self, record: ElectionRecord, group: Optional[GroupContext] = None):
+    """``chunk_size`` bounds how many ballots are resident/dispatched at
+    once; ``record.encrypted_ballots`` may be ANY iterable — pass a lazy
+    ``Consumer.iterate_encrypted_ballots()`` to verify a million-ballot
+    record without materializing it (reference analogue: the 11-thread
+    ``Verifier(record, nthreads)`` loads everything, RunRemoteWorkflowTest.java:180)."""
+
+    def __init__(self, record: ElectionRecord,
+                 group: Optional[GroupContext] = None,
+                 chunk_size: int = 4096):
         self.record = record
         self.group = group if group is not None else \
             record.election_init.joint_public_key.group
         self.ops = jax_ops(self.group)
         self.eops = jax_exp_ops(self.group)
         self.init = record.election_init
+        self.chunk_size = chunk_size
 
     # ==================================================================
     def verify(self) -> VerificationResult:
+        import itertools
         res = VerificationResult()
         self._v1_parameters(res)
         self._v2_guardian_keys(res)
         self._v3_joint_key(res)
-        if self.record.encrypted_ballots:
-            self._v4_v5_v6_ballots(res)
+        agg = _BallotAggregates()
+        it = iter(self.record.encrypted_ballots)
+        while True:
+            chunk = list(itertools.islice(it, self.chunk_size))
+            if not chunk:
+                break
+            self._verify_ballot_chunk(res, chunk, agg)
         if self.record.tally_result is not None:
-            self._v7_aggregation(res)
+            self._v7_aggregation(res, agg)
         if self.record.decryption_result is not None:
             self._v8_to_v12_decryption(res)
-        self._v13_spoiled(res)
+        self._v13_spoiled(res, agg)
         self._v14_coherence(res)
         return res
 
@@ -150,9 +179,9 @@ class Verifier:
                    "extended base hash mismatch")
 
     # ==================================================================
-    def _v4_v5_v6_ballots(self, res):
+    def _verify_ballot_chunk(self, res, ballots, agg: _BallotAggregates):
+        """V4/V5/V6 on one chunk + V7/V13 bookkeeping into ``agg``."""
         g = self.group
-        ballots = self.record.encrypted_ballots
         qbar = self.init.extended_base_hash
 
         # ---- flatten all selections --------------------------------------
@@ -243,6 +272,7 @@ class Verifier:
         S = len(alphas)
         if S == 0:
             res.record("V4.selection_proofs", True)
+            self._chunk_bookkeeping(res, ballots, agg)
             return
         eo, ee = self.ops, self.eops
         A_l = eo.to_limbs_p(alphas)
@@ -374,35 +404,58 @@ class Verifier:
                                f"constant proof fails for {contest_refs[i]}")
         res.record("V5.contest_limits", True)
 
-        # ---- V6: chaining ------------------------------------------------
+        # ---- V6 chain + V7/V13 bookkeeping -------------------------------
+        self._chunk_bookkeeping(res, ballots, agg)
+
+    def _chunk_bookkeeping(self, res, ballots, agg: _BallotAggregates):
+        """V6 chaining (continuity carried across chunks via ``agg``) plus
+        V7 product accumulation (one device prod-reduce per chunk) and
+        cast/spoiled counting."""
+        g = self.group
         for b in ballots:
             if not b.is_valid_code():
                 res.record("V6.ballot_chaining", False,
                            f"{b.ballot_id} confirmation code invalid")
-        # chain continuity: each code_seed equals the previous ballot's code
-        for prev, cur in zip(ballots, ballots[1:]):
-            if cur.code_seed != prev.code:
+            # chain continuity: code_seed equals the previous ballot's code
+            if agg.prev_code is not None and b.code_seed != agg.prev_code:
                 res.record("V6.ballot_chaining", False,
-                           f"{cur.ballot_id} breaks the code chain")
+                           f"{b.ballot_id} breaks the code chain")
+            agg.prev_code = b.code
         res.record("V6.ballot_chaining", True)
 
-    # ==================================================================
-    def _v7_aggregation(self, res):
-        g = self.group
-        tally = self.record.tally_result.encrypted_tally
-        cast = [b for b in self.record.encrypted_ballots
-                if b.state == BallotState.CAST]
-        # group cast ballot ciphertexts per (contest, selection)
-        prods: dict[tuple[str, str], tuple[int, int]] = {}
-        for b in cast:
+        agg.total_count += len(ballots)
+        agg.spoiled_ids.update(b.ballot_id for b in ballots
+                               if b.state == BallotState.SPOILED)
+        cast = [b for b in ballots if b.state == BallotState.CAST]
+        agg.cast_count += len(cast)
+        if not cast:
+            return
+        keys = sorted({(c.contest_id, s.selection_id)
+                       for b in cast for c in b.contests
+                       for s in c.selections if not s.is_placeholder})
+        key_idx = {k: i for i, k in enumerate(keys)}
+        nk = len(keys)
+        rows = np.empty((len(cast), 2 * nk), dtype=object)
+        rows[:] = 1
+        for bi, b in enumerate(cast):
             for c in b.contests:
                 for s in c.selections:
                     if s.is_placeholder:
                         continue
-                    key = (c.contest_id, s.selection_id)
-                    pa, pb = prods.get(key, (1, 1))
-                    prods[key] = (pa * s.ciphertext.pad.value % g.p,
-                                  pb * s.ciphertext.data.value % g.p)
+                    i = key_idx[(c.contest_id, s.selection_id)]
+                    rows[bi, i] = s.ciphertext.pad.value
+                    rows[bi, nk + i] = s.ciphertext.data.value
+        arr = np.stack([self.ops.to_limbs_p(list(rows[bi]))
+                        for bi in range(len(cast))])
+        prod = self.ops.from_limbs(np.asarray(self.ops.prod_reduce(arr)))
+        for i, k in enumerate(keys):
+            pa, pd = agg.prods.get(k, (1, 1))
+            agg.prods[k] = (pa * prod[i] % g.p, pd * prod[nk + i] % g.p)
+
+    # ==================================================================
+    def _v7_aggregation(self, res, agg: _BallotAggregates):
+        tally = self.record.tally_result.encrypted_tally
+        prods = agg.prods
         seen = set()
         for c in tally.contests:
             for s in c.selections:
@@ -414,11 +467,15 @@ class Verifier:
                 if got != want:
                     res.record("V7.aggregation", False,
                                f"tally mismatch at {key}")
-        if self.record.encrypted_ballots:
+        if agg.total_count:
             for key in prods:
                 if key not in seen:
                     res.record("V7.aggregation", False,
                                f"ballot selection {key} missing from tally")
+            if tally.cast_ballot_count != agg.cast_count:
+                res.record("V7.aggregation", False,
+                           f"tally cast count {tally.cast_ballot_count} != "
+                           f"{agg.cast_count} cast ballots in record")
         res.record("V7.aggregation", True)
 
     # ==================================================================
@@ -455,14 +512,32 @@ class Verifier:
 
     def _verify_tally_shares(self, res, tally, avail, labels):
         """Share/proof/combination checks for one decrypted tally — used for
-        the main tally (V8-V11) and each spoiled ballot (V13)."""
+        the main tally (V8-V11) and each spoiled ballot (V13).
+
+        All modexp work is batched on the device plane: the per-share CP
+        proofs go through ``batch_cp_verify`` (one dispatch for the whole
+        tally), the Lagrange reconstruction powers through one ``powmod``
+        dispatch, and the g^t decode checks through one fixed-base
+        dispatch — no per-selection host ``pow`` (the reference's combine
+        loop RunRemoteDecryptor.java:261-273 is the CPU analogue).
+        """
         g = self.group
         qbar = self.init.extended_base_hash
         guardians = {gr.guardian_id: gr for gr in self.init.guardians}
+
+        cp_x, cp_g2, cp_y, cp_c, cp_v = [], [], [], [], []
+        cp_meta: list[tuple[str, str]] = []   # (label, failure message)
+        recon_base, recon_exp = [], []        # Lagrange power rows
+        recon_meta = []                       # (start, count, want, lbl, msg)
+        sel_entries = []                      # (selection, m_total int)
+        # recovery keys depend only on (missing guardian, trustee) — O(n²),
+        # computed once, NOT per selection
+        recovery_cache: dict[tuple[str, str], ElementModP] = {}
+
         for c in tally.contests:
             for s in c.selections:
-                A, B = s.message.pad, s.message.data
-                m_total = g.ONE_MOD_P
+                A = s.message.pad
+                m_total = 1
                 for share in s.shares:
                     gr = guardians.get(share.guardian_id)
                     if gr is None:
@@ -471,65 +546,87 @@ class Verifier:
                                    f"{share.guardian_id}")
                         continue
                     if share.proof is not None:  # direct share
-                        if not share.proof.is_valid(
-                                g.G_MOD_P, gr.coefficient_commitments[0],
-                                A, share.share, qbar):
-                            res.record(labels["direct"], False,
-                                       f"direct proof {share.guardian_id} on "
-                                       f"{s.selection_id} invalid")
+                        cp_x.append(gr.coefficient_commitments[0].value)
+                        cp_g2.append(A.value)
+                        cp_y.append(share.share.value)
+                        cp_c.append(share.proof.challenge.value)
+                        cp_v.append(share.proof.response.value)
+                        cp_meta.append((labels["direct"],
+                                        f"direct proof {share.guardian_id} "
+                                        f"on {s.selection_id} invalid"))
                     else:  # reconstructed missing share
                         if share.recovered_parts is None:
                             res.record(labels["comp"], False,
                                        f"missing share {share.guardian_id} "
                                        f"has no parts")
                             continue
-                        recon = g.ONE_MOD_P
+                        start, count = len(recon_base), 0
                         for t_id, part in share.recovered_parts.items():
                             t_rec = avail.get(t_id)
                             if t_rec is None:
                                 res.record(labels["comp"], False,
                                            f"part from non-participant {t_id}")
                                 continue
-                            expected_recovery = commitment_product(
-                                g, gr.coefficient_commitments,
-                                t_rec.x_coordinate)
+                            key = (share.guardian_id, t_id)
+                            if key not in recovery_cache:
+                                recovery_cache[key] = commitment_product(
+                                    g, gr.coefficient_commitments,
+                                    t_rec.x_coordinate)
                             if part.recovered_public_key_share != \
-                                    expected_recovery:
+                                    recovery_cache[key]:
                                 res.record(labels["comp"], False,
                                            f"recovery key {t_id} for "
                                            f"{share.guardian_id} wrong")
-                            if not part.proof.is_valid(
-                                    g.G_MOD_P,
-                                    part.recovered_public_key_share,
-                                    A, part.partial_decryption, qbar):
-                                res.record(labels["comp"], False,
-                                           f"compensated proof {t_id} for "
-                                           f"{share.guardian_id} invalid")
-                            recon = g.mult_p(recon, g.pow_p(
-                                part.partial_decryption,
-                                t_rec.lagrange_coefficient))
-                        if recon != share.share:
-                            res.record(labels["lagrange"], False,
-                                       f"reconstruction of "
-                                       f"{share.guardian_id} on "
-                                       f"{s.selection_id} mismatched")
-                    m_total = g.mult_p(m_total, share.share)
-                # B / Π Mᵢ == recorded value == g^t
-                value = g.div_p(B, m_total)
-                if value != s.value:
+                            cp_x.append(part.recovered_public_key_share.value)
+                            cp_g2.append(A.value)
+                            cp_y.append(part.partial_decryption.value)
+                            cp_c.append(part.proof.challenge.value)
+                            cp_v.append(part.proof.response.value)
+                            cp_meta.append((labels["comp"],
+                                            f"compensated proof {t_id} for "
+                                            f"{share.guardian_id} invalid"))
+                            recon_base.append(part.partial_decryption.value)
+                            recon_exp.append(
+                                t_rec.lagrange_coefficient.value)
+                            count += 1
+                        recon_meta.append(
+                            (start, count, share.share.value,
+                             labels["lagrange"],
+                             f"reconstruction of {share.guardian_id} on "
+                             f"{s.selection_id} mismatched"))
+                    m_total = m_total * share.share.value % g.p
+                sel_entries.append((s, m_total))
+
+        ok = batch_cp_verify(g, cp_x, cp_g2, cp_y, cp_c, cp_v, qbar)
+        for i in np.nonzero(~ok)[0]:
+            label, msg = cp_meta[int(i)]
+            res.record(label, False, msg)
+
+        if recon_base:  # M_m = Π parts^{w_ℓ}: one powmod dispatch
+            pows = self.ops.powmod_ints(recon_base, recon_exp)
+            for start, count, want, label, msg in recon_meta:
+                prod = 1
+                for v in pows[start:start + count]:
+                    prod = prod * v % g.p
+                if prod != want:
+                    res.record(label, False, msg)
+
+        if sel_entries:  # value·ΠMᵢ == B (no inversion) and g^t == value
+            gt = self.ops.g_pow_ints([s.tally for s, _ in sel_entries])
+            for (s, m_total), gt_i in zip(sel_entries, gt):
+                if s.value.value * m_total % g.p != s.message.data.value:
                     res.record(labels["combine"], False,
                                f"decrypted value mismatch {s.selection_id}")
-                if g.g_pow_p(g.int_to_q(s.tally)) != s.value:
+                if gt_i != s.value.value:
                     res.record(labels["combine"], False,
                                f"g^t != value for {s.selection_id}")
 
     # ==================================================================
-    def _v13_spoiled(self, res):
+    def _v13_spoiled(self, res, agg: _BallotAggregates):
         """Spoiled ballots: excluded from the tally (V7 handles that) and
         any published spoiled-ballot decryption must verify with the same
         share logic as the main tally."""
-        spoiled_ids = {b.ballot_id for b in self.record.encrypted_ballots
-                       if b.state == BallotState.SPOILED}
+        spoiled_ids = agg.spoiled_ids
         dr = self.record.decryption_result
         avail = ({dg.guardian_id: dg for dg in dr.decrypting_guardians}
                  if dr is not None else {})

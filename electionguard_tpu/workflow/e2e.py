@@ -71,6 +71,10 @@ def main(argv=None) -> int:
     ap.add_argument("-nselections", type=int, default=2)
     ap.add_argument("-group", choices=["production", "tiny"],
                     default="tiny")
+    ap.add_argument("-spoilEvery", dest="spoil_every", type=int, default=5,
+                    help="spoil every Nth ballot (0 = none); spoiled "
+                         "ballots are decrypted in phase 4 and checked by "
+                         "verifier V13 in phase 5")
     ap.add_argument("-keep", action="store_true",
                     help="keep going past failures and dump all output")
     args = ap.parse_args(argv)
@@ -132,7 +136,7 @@ def main(argv=None) -> int:
     enc = RunCommand.python_module(
         "batch-encryption", "electionguard_tpu.cli.run_batch_encryption",
         ["-in", record_dir, "-ballots", ballots_dir, "-out", record_dir,
-         "-fixedNonces"] + group_flags,
+         "-fixedNonces", "-spoilEvery", str(args.spoil_every)] + group_flags,
         cmd_out)
     if not wait_all([enc], timeout=600):
         return phase_fail("encryption", [enc])
@@ -156,7 +160,8 @@ def main(argv=None) -> int:
         "decryptor", "electionguard_tpu.cli.run_remote_decryptor",
         ["-in", record_dir, "-out", record_dir,
          "-navailable", str(args.navailable), "-port", str(dec_port),
-         "-timeout", "90"] + group_flags,
+         "-timeout", "90"]
+        + (["-decryptSpoiled"] if args.spoil_every else []) + group_flags,
         cmd_out)
     time.sleep(1.5)
     dec_trustees = []

@@ -71,8 +71,84 @@ print(f"proc {jax.process_index()} OK", flush=True)
 """
 
 
-@pytest.mark.timeout(300)
-def test_two_process_sharded_powmod(tmp_path):
+_VERIFY_WORKER = r"""
+import os, sys
+for k in list(os.environ):
+    if "AXON" in k or "PALLAS" in k or k.startswith("TPU"):
+        os.environ.pop(k)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from electionguard_tpu.parallel.distributed import (
+    distributed_init, global_batch, local_result, multihost_election_mesh)
+
+distributed_init()
+
+from electionguard_tpu.parallel.mesh import DP_AXIS
+from electionguard_tpu.parallel.sharded import ShardedGroupOps
+from electionguard_tpu.core.group import tiny_group
+from electionguard_tpu.core.group_jax import JaxGroupOps
+from electionguard_tpu.core import bignum_jax as bn
+import jax.numpy as jnp
+assert jax.process_count() == 2 and len(jax.devices()) == 8
+
+# a REAL verify step across the process (DCN) boundary: the Schnorr/CP
+# commitment recompute a = g^v x^c (fixed-base PowRadix + variable powmod
+# + modmul, dp-sharded) plus the homomorphic tally product contracting dp
+mesh = multihost_election_mesh(wp=1)
+g = tiny_group()
+ops = JaxGroupOps(g, backend="cios")
+sops = ShardedGroupOps(ops, mesh)
+
+B = 16
+rng = np.random.default_rng(1)
+xs = [pow(g.g, int(e), g.p) for e in rng.integers(1, 1 << 30, B)]
+cs = [int(e) % g.q for e in rng.integers(1, 1 << 30, B)]
+vs = [int(e) % g.q for e in rng.integers(1, 1 << 30, B)]
+X = ops.to_limbs_p(xs)
+C = ops.to_limbs_q(cs)
+V = ops.to_limbs_q(vs)
+dig = np.asarray(sops._digits8(jnp.asarray(V)))
+
+Xg = global_batch(mesh, X)
+Cg = global_batch(mesh, C)
+digg = global_batch(mesh, dig, P(DP_AXIS, None))
+table = jax.device_put(ops.g_table, NamedSharding(mesh, P()))
+
+pow_m = sops._powmod_j
+fix_m = sops._fixed_pow_j
+mul_m = sops._mulmod_j
+prod_m = sops._prod_reduce_j
+
+
+@jax.jit
+def step(X, C, dig, table):
+    a = mul_m(fix_m(table, dig), pow_m(X, C))
+    tally = prod_m(X[:, None, :])
+    rep = NamedSharding(mesh, P())
+    return (jax.lax.with_sharding_constraint(a, rep),
+            jax.lax.with_sharding_constraint(tally, rep))
+
+
+a, tally = step(Xg, Cg, digg, table)
+got_a = bn.limbs_to_ints(local_result(a))
+got_t = bn.limbs_to_ints(local_result(tally))
+want_a = [pow(g.g, v, g.p) * pow(x, c, g.p) % g.p
+          for x, c, v in zip(xs, cs, vs)]
+want_t = 1
+for x in xs:
+    want_t = want_t * x % g.p
+assert got_a == want_a, "cross-host verify commitments mismatch"
+assert got_t == [want_t], "cross-host tally product mismatch"
+print(f"proc {jax.process_index()} OK", flush=True)
+"""
+
+
+def _run_two_workers(worker_src):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -88,12 +164,20 @@ def test_two_process_sharded_powmod(tmp_path):
                    PYTHONPATH=os.path.dirname(os.path.dirname(
                        os.path.abspath(__file__))))
         procs.append(subprocess.Popen(
-            [sys.executable, "-c", _WORKER], env=env,
+            [sys.executable, "-c", worker_src], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=240)
-        outs.append(out)
+    outs = [p.communicate(timeout=240)[0] for p in procs]
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
         assert "OK" in out
+
+
+def test_two_process_sharded_powmod(tmp_path):
+    _run_two_workers(_WORKER)
+
+
+def test_two_process_sharded_verify_step(tmp_path):
+    """SURVEY §5.8 second plane, cross-host: commitment recompute + tally
+    product over a 2-process 8-device mesh, byte-identical to host ints
+    (VERDICT round-2 item 9)."""
+    _run_two_workers(_VERIFY_WORKER)

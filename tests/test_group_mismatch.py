@@ -1,0 +1,53 @@
+"""Registration-time group negotiation: a trustee running a different group
+gets a clean in-band rejection at the handshake — instead of the opaque
+byte-width error mid-protocol the reference would produce (its registration
+response defined a ``constants`` field for this but never populated it:
+reference src/main/proto/decrypting_rpc.proto:20,
+RunRemoteDecryptor.java:356-360)."""
+
+import pytest
+
+from electionguard_tpu.remote.decrypting_remote import (DecryptionCoordinator,
+                                                        RemoteDecryptorProxy)
+from electionguard_tpu.remote.keyceremony_remote import (
+    KeyCeremonyCoordinator, KeyCeremonyTrusteeServer)
+
+
+def test_keyceremony_group_mismatch_rejected(tgroup, pgroup):
+    coord = KeyCeremonyCoordinator(tgroup, 1, 1, port=0)
+    try:
+        with pytest.raises(RuntimeError, match="group constants mismatch"):
+            KeyCeremonyTrusteeServer(pgroup, "g0",
+                                     f"localhost:{coord.port}")
+        assert coord.ready() == 0
+    finally:
+        coord.server.stop(grace=0)
+
+
+def test_keyceremony_group_match_accepted(tgroup):
+    coord = KeyCeremonyCoordinator(tgroup, 1, 1, port=0)
+    try:
+        ts = KeyCeremonyTrusteeServer(tgroup, "g0",
+                                      f"localhost:{coord.port}")
+        assert coord.ready() == 1
+        ts.server.stop(grace=0)
+    finally:
+        coord.server.stop(grace=0)
+
+
+def test_decrypting_group_mismatch_rejected(tgroup, pgroup):
+    coord = DecryptionCoordinator(tgroup, 1, port=0)
+    try:
+        proxy = RemoteDecryptorProxy(f"localhost:{coord.port}")
+        try:
+            resp = proxy.register_trustee(
+                "g0", "localhost:1", 1,
+                pgroup.int_to_p(pow(pgroup.g, 3, pgroup.p)), pgroup)
+        finally:
+            proxy.close()
+        assert "group constants mismatch" in resp.error
+        # the response tells the trustee which group the coordinator runs
+        assert resp.constants.name == tgroup.spec.name
+        assert coord.ready() == 0
+    finally:
+        coord.server.stop(grace=0)

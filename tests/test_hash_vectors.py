@@ -1,0 +1,87 @@
+"""Golden vectors pinning the Fiat–Shamir hash construction byte-for-byte.
+
+This framework deliberately does NOT reproduce ElectionGuard spec-1.03's
+"|"-joined string hashing (the construction the reference's records feed —
+reference: src/main/proto/keyceremony_trustee_rpc.proto:40 "see spec 1.03
+eq 17", src/main/proto/common.proto:6-16): that form is not injective
+across types, and the reference does not vendor the Kotlin library that
+defines it, so byte-compatibility could never be proven here.  Instead
+core/hash.py defines a canonical injective tag-length encoding; records
+are internally consistent and verified end-to-end by our Verifier, but are
+NOT checkable by external spec-1.03 verifiers (documented in README.md
+§Interop).
+
+These vectors freeze that construction: any unintended change to the
+encoding, digest, mod-q reduction, HMAC, or KDF breaks this file.  They
+double as the cross-library comparison points an external implementation
+would need.
+"""
+
+from electionguard_tpu.core.group import production_group
+from electionguard_tpu.core.hash import (_encode, hash_digest, hash_elems,
+                                         hmac_digest, kdf)
+
+
+def test_encode_primitives():
+    assert _encode(None).hex() == "0000000000"
+    assert _encode(0).hex() == "030000000100"
+    assert _encode(255).hex() == "0300000001ff"
+    assert _encode(65536).hex() == "0300000003010000"
+    assert _encode("abc").hex() == "0400000003616263"
+    assert _encode(b"abc").hex() == "0500000003616263"
+    # str and bytes with identical payloads MUST encode differently
+    assert _encode("abc") != _encode(b"abc")
+    # sequences hash their inner encoding (fixed 32-byte digest frame)
+    assert _encode(["a", 1]).hex() == (
+        "0600000020"
+        "acf3ba12785d9b6cb466c0cda666441b1722e104e7978333f755046f1de43a93")
+
+
+def test_encode_group_elements_fixed_width():
+    g = production_group()
+    e = g.int_to_p(pow(g.g, 5, g.p))
+    q5 = g.int_to_q(5)
+    enc_p = _encode(e)
+    enc_q = _encode(q5)
+    # tag(1) + len(4) + 512/32-byte big-endian images — the same framing
+    # sha256_jax._TAG_P_HDR replays on-device
+    assert len(enc_p) == 517 and enc_p[:5].hex() == "0100000200"
+    assert len(enc_q) == 37 and enc_q[:5].hex() == "0200000020"
+
+
+def test_hash_digest_vectors():
+    # empty input = SHA-256("")
+    assert hash_digest().hex() == (
+        "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855")
+    assert hash_digest("spec", 42, b"\x00\x01", None).hex() == (
+        "f09859d778009f0891b0b9d56e15d6e75d14648aa8001a6b5145a750eaba6131")
+
+
+def test_hash_elems_mod_q_vectors():
+    g = production_group()
+    assert hash_elems(g, "x", 123).value == int(
+        "96009231549028838145706641538645905516456599800031253640724677890"
+        "392363932179")
+    e = g.int_to_p(pow(g.g, 5, g.p))
+    assert hash_elems(g, e, g.int_to_q(5)).value == int(
+        "10986852551582276970926743173588022263901486514513943585690547153"
+        "3748118678666")
+
+
+def test_hmac_and_kdf_vectors():
+    assert hmac_digest(b"key", "msg", 7).hex() == (
+        "f18c5e7ac18f3f6044a2cf4e06d00bc85a0777c36dd55f1f4f9c6baf82d0b89c")
+    assert kdf(b"key", "label", b"ctx", 40).hex() == (
+        "0d8f10fc994459c48c1ee8cc0a7f223a64bf3abd7fd75a2b59cc1573331eb4dd"
+        "9969860a136b701b")
+    # counter-mode prefix property: a longer stream extends a shorter one
+    assert kdf(b"key", "label", b"ctx", 64)[:32] != kdf(
+        b"key", "label", b"ctx", 32)  # length is bound into the PRF input
+
+
+def test_injectivity_boundaries():
+    # moving bytes across item boundaries must change the digest
+    assert hash_digest("ab", "c") != hash_digest("a", "bc")
+    assert hash_digest(b"", b"") != hash_digest(b"")
+    assert hash_digest(None) != hash_digest(b"")
+    assert hash_digest(1, 2) != hash_digest((1, 2))

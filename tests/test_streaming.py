@@ -1,0 +1,82 @@
+"""Streaming-scale paths: the verifier and tally accumulator must accept
+lazy ballot iterables, process them in bounded chunks, and produce results
+identical to the materialized-list path (BASELINE.md configs 3-4; VERDICT
+round-1 'nothing streams at 1M-ballot scale')."""
+
+import dataclasses
+
+from electionguard_tpu.ballot.ciphertext import BallotState
+from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+from electionguard_tpu.publish.election_record import (ElectionConfig,
+                                                       ElectionRecord)
+from electionguard_tpu.tally.accumulate import accumulate_ballots
+from electionguard_tpu.verify.verifier import Verifier
+from electionguard_tpu.workflow.e2e import sample_manifest
+
+
+def _make_election(g, nballots=40, spoil_every=5):
+    manifest = sample_manifest(2, 3)
+    trustees = [KeyCeremonyTrustee(g, "g0", 1, 1)]
+    init = key_ceremony_exchange(trustees, g).make_election_initialized(
+        ElectionConfig(manifest, 1, 1), {})
+    ballots = list(RandomBallotProvider(manifest, nballots,
+                                        seed=5).ballots())
+    spoiled = {b.ballot_id for i, b in enumerate(ballots)
+               if spoil_every and (i + 1) % spoil_every == 0}
+    enc = BatchEncryptor(init, g)
+    # two chunks under one seed exercises cross-chunk nonces + code chain
+    half = nballots // 2
+    e1, _ = enc.encrypt_ballots(ballots[:half], seed=g.int_to_q(9),
+                                spoiled_ids=spoiled)
+    e2, _ = enc.encrypt_ballots(ballots[half:], seed=g.int_to_q(9),
+                                code_seed=e1[-1].code,
+                                ballot_index_base=half,
+                                spoiled_ids=spoiled)
+    return init, e1 + e2, spoiled
+
+
+def test_streaming_tally_matches_list(tgroup):
+    init, encrypted, spoiled = _make_election(tgroup)
+    t_list = accumulate_ballots(init, encrypted)
+    t_stream = accumulate_ballots(init, iter(encrypted), chunk_size=7)
+    assert t_stream.encrypted_tally == t_list.encrypted_tally
+    assert (t_stream.encrypted_tally.cast_ballot_count
+            == len(encrypted) - len(spoiled))
+
+
+def test_streaming_verifier_generator_input(tgroup):
+    init, encrypted, spoiled = _make_election(tgroup)
+    tally = accumulate_ballots(init, encrypted)
+    record = ElectionRecord(election_init=init,
+                            encrypted_ballots=iter(encrypted),
+                            tally_result=tally)
+    res = Verifier(record, tgroup, chunk_size=8).verify()
+    assert res.ok, res.summary()
+
+
+def test_streaming_verifier_chain_break_across_chunks(tgroup):
+    init, encrypted, _ = _make_election(tgroup, spoil_every=0)
+    tally = accumulate_ballots(init, encrypted)
+    # break the chain exactly at a chunk boundary (ballot index 8)
+    bad = dataclasses.replace(encrypted[8], code_seed=b"\x00" * 32)
+    tampered = encrypted[:8] + [bad] + encrypted[9:]
+    record = ElectionRecord(election_init=init,
+                            encrypted_ballots=iter(tampered),
+                            tally_result=tally)
+    res = Verifier(record, tgroup, chunk_size=8).verify()
+    assert not res.checks["V6.ballot_chaining"]
+
+
+def test_streaming_verifier_detects_cast_count_mismatch(tgroup):
+    init, encrypted, _ = _make_election(tgroup, spoil_every=0)
+    tally = accumulate_ballots(init, encrypted)
+    # drop one cast ballot from the stream: V7 must notice the count and
+    # the product both disagree with the published tally
+    record = ElectionRecord(election_init=init,
+                            encrypted_ballots=iter(encrypted[:-1]),
+                            tally_result=tally)
+    res = Verifier(record, tgroup, chunk_size=8).verify()
+    assert not res.checks["V7.aggregation"]
