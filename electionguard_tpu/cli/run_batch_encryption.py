@@ -58,7 +58,7 @@ def main(argv=None) -> int:
     # fully streaming: plaintext ballots are loaded, encrypted, written,
     # and dropped one chunk at a time — host memory stays O(batchSize).
     # The confirmation-code chain continues across chunks via code_seed;
-    # ballot_index_base keeps device-derived nonces unique across chunks.
+    # nonces are keyed by ballot identity, so chunking is nonce-safe.
     n_invalid = n_spoiled = 0
     code_seed = None
     inv_pub = Publisher(args.invalid_dir) if args.invalid_dir else publisher

@@ -22,6 +22,7 @@ invalid list (the reference's invalidDir)."""
 
 from __future__ import annotations
 
+import hashlib
 import time
 from dataclasses import dataclass
 from typing import Optional, Sequence
@@ -70,6 +71,12 @@ class BatchEncryptor:
         self.eops: JaxExponentOps = jax_exp_ops(self.group)
         # build/cache the K fixed-base table once
         self.ops.fixed_table(self.K.value)
+        # ballot ids seen across ALL encrypt_ballots calls on this
+        # encryptor: identity keys the nonce PRF, so a repeated id in a
+        # later chunk would reuse pads — reject it in any chunk.  Stored
+        # as 16-byte digest prefixes: ~24 MB of payload per 1M ballots,
+        # the one per-ballot residual on the otherwise O(chunk) path.
+        self._seen_ids: set[bytes] = set()
 
     # ------------------------------------------------------------------
     def encrypt_ballots(
@@ -82,9 +89,14 @@ class BatchEncryptor:
         """Encrypt a batch.  Returns (encrypted, invalid) where invalid is
         [(ballot, reason)] — mirroring batchEncryption's invalidDir.
 
-        ``ballot_index_base``: position of ``ballots[0]`` in the overall
-        stream — callers encrypting chunk-by-chunk under one seed MUST pass
-        it so device-derived nonces stay unique across chunks.
+        Nonces are keyed by BALLOT IDENTITY (SHA-256 of ballot_id), never
+        by batch position, so encrypting chunk-by-chunk under one seed can
+        never reuse a pad across chunks — ballots with distinct ids get
+        distinct nonces no matter how the stream is split.  Duplicate ids
+        within a batch are rejected to the invalid list (and ballot ids
+        must be unique election-wide, as the code chain already requires).
+        ``ballot_index_base`` is retained for API compatibility but no
+        longer participates in nonce derivation.
         ``spoiled_ids``: ballot ids to mark SPOILED instead of CAST — they
         stay in the code chain but are excluded from the tally and become
         eligible for spoiled-ballot decryption (reference:
@@ -100,13 +112,25 @@ class BatchEncryptor:
         valid: list[PlaintextBallot] = []
         invalid: list[tuple[PlaintextBallot, str]] = []
         flat = _FlatSelections([], [], [], [], [], [])
-        nonce_idx: list[int] = []     # (global ballot pos << 24) | ordinal
-        contest_rows: list[tuple[int, int, str, int, int, int]] = []
-        # (ballot_idx, contest_idx, contest_id, seq, limit, nonce_idx)
+        sel_ord: list[int] = []       # selection ordinal within its ballot
+        contest_rows: list[tuple[int, int, str, int, int]] = []
+        # (ballot_idx, contest_ordinal, contest_id, seq, limit)
         contests_by_id = {c.object_id: c for c in self.manifest.contests}
+        # stage this batch's ids locally; merge into the cross-call set
+        # only on success, so a caller retrying a failed dispatch doesn't
+        # see its own ballots as duplicates
+        batch_ids: set[bytes] = set()
+        valid_digests: list[bytes] = []   # full 32-byte identity digests
 
         for pos, b in enumerate(ballots):
             reason = None
+            bid_digest = hashlib.sha256(b.ballot_id.encode()).digest()
+            bid_key = bid_digest[:16]
+            if bid_key in self._seen_ids or bid_key in batch_ids:
+                # identity keys the nonce PRF: a second ballot under the
+                # same id would reuse its pads and leak vote equality
+                invalid.append((b, f"duplicate ballot id {b.ballot_id}"))
+                continue
             cids = [c.contest_id for c in b.contests]
             if len(set(cids)) != len(cids):
                 invalid.append((b, "duplicate contest ids"))
@@ -138,7 +162,8 @@ class BatchEncryptor:
                 continue
             bi = len(valid)
             valid.append(b)
-            ballot_pos = ballot_index_base + pos
+            batch_ids.add(bid_key)
+            valid_digests.append(bid_digest)
             sel_ordinal = 0
             for ci, c in enumerate(b.contests):
                 desc = contests_by_id[c.contest_id]
@@ -149,8 +174,7 @@ class BatchEncryptor:
                 for j in range(limit - sum(votes)):
                     pad_votes[j] = 1  # placeholders top the sum up to limit
                 contest_rows.append((bi, ci, c.contest_id,
-                                     desc.sequence_order, limit,
-                                     (ballot_pos << 24) | ci))
+                                     desc.sequence_order, limit))
                 for si, s in enumerate(c.selections):
                     flat.ballot_idx.append(bi)
                     flat.contest_idx.append(len(contest_rows) - 1)
@@ -158,7 +182,7 @@ class BatchEncryptor:
                     flat.sequence_orders.append(si)
                     flat.votes.append(s.vote)
                     flat.is_placeholder.append(False)
-                    nonce_idx.append((ballot_pos << 24) | sel_ordinal)
+                    sel_ord.append(sel_ordinal)
                     sel_ordinal += 1
                 for j, pv in enumerate(pad_votes):
                     flat.ballot_idx.append(bi)
@@ -168,25 +192,31 @@ class BatchEncryptor:
                     flat.sequence_orders.append(n_real + j)
                     flat.votes.append(pv)
                     flat.is_placeholder.append(True)
-                    nonce_idx.append((ballot_pos << 24) | sel_ordinal)
+                    sel_ord.append(sel_ordinal)
                     sel_ordinal += 1
 
         S = len(flat.votes)
         C = len(contest_rows)
         if S == 0:
+            self._seen_ids |= batch_ids
             return [], invalid
 
         # ---- nonce + fake-branch scalar streams -------------------------
         # The four per-selection scalars (R, U, CF, VF) are internal
         # secrets: they must be deterministic in the seed, unique per
-        # position, and uniform mod q — nothing external ever re-derives
-        # them.  On the production group they come from ONE device SHA-256
-        # dispatch over fixed-width rows binding (seed, stream tag, flat
-        # index); other groups fall back to host hashing.
+        # (ballot identity, position-in-ballot), and uniform mod q —
+        # nothing external ever re-derives them.  On the production group
+        # they come from ONE device SHA-256 dispatch over fixed-width rows
+        # binding (seed, stream tag, SHA-256(ballot_id), ordinal); other
+        # groups fall back to host hashing (which binds ballot_id too).
         q = g.q
+        bid_digests = np.frombuffer(
+            b"".join(valid_digests), np.uint8).reshape(-1, 32)
         if sha256_jax.supports(g):
             R, U, CF, VF = _derive_selection_nonces(
-                g, self.eops, seed, np.asarray(nonce_idx, dtype=np.uint64))
+                g, self.eops, seed,
+                bid_digests[np.asarray(flat.ballot_idx, dtype=np.int64)],
+                np.asarray(sel_ord, dtype=np.uint32))
         else:
             R = np.empty(S, dtype=object)
             U = np.empty(S, dtype=object)
@@ -279,7 +309,10 @@ class BatchEncryptor:
         if sha256_jax.supports(g):
             U2 = _derive_contest_nonces(
                 g, self.eops, seed,
-                np.asarray([row[5] for row in contest_rows], dtype=np.uint64))
+                bid_digests[np.asarray([row[0] for row in contest_rows],
+                                       dtype=np.int64)],
+                np.asarray([row[1] for row in contest_rows],
+                           dtype=np.uint32))
         else:
             U2 = [hash_elems(g, seed, "contest-u", ci,
                              valid[row[0]].ballot_id).value
@@ -375,17 +408,25 @@ class BatchEncryptor:
                 b.ballot_id, b.ballot_style_id, self.init.manifest_hash,
                 prev_code, code, timestamp, contests, state))
             prev_code = code
+        self._seen_ids |= batch_ids
         return out, invalid
 
 
-def _nonce_rows(seed: ElementModQ, tags: np.ndarray,
-                idx: np.ndarray) -> np.ndarray:
-    """Fixed-width SHA-256 input rows: seed(32) || tag(1) || index(8 BE)."""
+def _nonce_rows(seed: ElementModQ, tags: np.ndarray, bids: np.ndarray,
+                ords: np.ndarray) -> np.ndarray:
+    """Fixed-width SHA-256 input rows:
+    seed(32) || tag(1) || SHA-256(ballot_id)(32) || ordinal(4 BE).
+
+    Keying by ballot identity (not batch position) makes cross-chunk
+    nonce reuse structurally impossible: no matter how a caller splits a
+    ballot stream into encrypt_ballots() calls under one seed, distinct
+    ballots hash distinct rows."""
     n = tags.shape[0]
-    msgs = np.zeros((n, 41), np.uint8)
+    msgs = np.zeros((n, 69), np.uint8)
     msgs[:, :32] = np.frombuffer(seed.to_bytes(), np.uint8)
     msgs[:, 32] = tags
-    msgs[:, 33:] = idx.astype(">u8").view(np.uint8).reshape(n, 8)
+    msgs[:, 33:65] = bids
+    msgs[:, 65:] = ords.astype(">u4").view(np.uint8).reshape(n, 4)
     return msgs
 
 
@@ -406,12 +447,14 @@ def _derive_nonce_ints(g, ee, msgs: np.ndarray) -> list[int]:
     return ee.from_limbs(limbs)
 
 
-def _derive_selection_nonces(g, ee, seed: ElementModQ, idx: np.ndarray):
-    """(R, U, CF, VF) for all S selections in one device dispatch; ``idx``
-    is the per-selection global nonce index (unique across chunks)."""
-    S = idx.shape[0]
+def _derive_selection_nonces(g, ee, seed: ElementModQ, bids: np.ndarray,
+                             ords: np.ndarray):
+    """(R, U, CF, VF) for all S selections in one device dispatch; ``bids``
+    is the (S, 32) per-selection ballot-identity digest and ``ords`` the
+    selection ordinal within its ballot."""
+    S = ords.shape[0]
     msgs = _nonce_rows(seed, np.repeat(np.arange(4, dtype=np.uint8), S),
-                       np.tile(idx, 4))
+                       np.tile(bids, (4, 1)), np.tile(ords, 4))
     ints = _derive_nonce_ints(g, ee, msgs)
     return (np.array(ints[:S], dtype=object),
             np.array(ints[S:2 * S], dtype=object),
@@ -419,10 +462,12 @@ def _derive_selection_nonces(g, ee, seed: ElementModQ, idx: np.ndarray):
             np.array(ints[3 * S:], dtype=object))
 
 
-def _derive_contest_nonces(g, ee, seed: ElementModQ,
-                           idx: np.ndarray) -> list[int]:
-    """Contest limit-proof nonces (stream tag 4), one device dispatch."""
-    msgs = _nonce_rows(seed, np.full(idx.shape[0], 4, np.uint8), idx)
+def _derive_contest_nonces(g, ee, seed: ElementModQ, bids: np.ndarray,
+                           ords: np.ndarray) -> list[int]:
+    """Contest limit-proof nonces (stream tag 4), one device dispatch;
+    keyed by (ballot identity, contest ordinal)."""
+    msgs = _nonce_rows(seed, np.full(ords.shape[0], 4, np.uint8),
+                       bids, ords)
     return _derive_nonce_ints(g, ee, msgs)
 
 

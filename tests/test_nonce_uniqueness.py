@@ -1,0 +1,61 @@
+"""Nonce-reuse must be structurally impossible (VERDICT r2/r3 medium
+finding): device-derived nonces were once keyed by batch position, so a
+caller encrypting multiple chunks under one seed WITHOUT threading
+``ballot_index_base`` silently reused R across chunks — identical ElGamal
+pads leaking vote equality.  Nonces are now keyed by ballot identity
+(SHA-256 of ballot_id); these tests pin that on the PRODUCTION group (the
+device SHA-256 path the hazard lived in) by replaying the old footgun
+call pattern and asserting every pad is distinct."""
+
+from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+from electionguard_tpu.core.group import production_group
+from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+from electionguard_tpu.publish.election_record import ElectionConfig
+from electionguard_tpu.workflow.e2e import sample_manifest
+
+
+def _production_election(nballots):
+    g = production_group()
+    manifest = sample_manifest(1, 2)
+    trustees = [KeyCeremonyTrustee(g, "g0", 1, 1)]
+    init = key_ceremony_exchange(trustees, g).make_election_initialized(
+        ElectionConfig(manifest, 1, 1), {})
+    ballots = list(RandomBallotProvider(manifest, nballots,
+                                        seed=7).ballots())
+    return g, init, ballots
+
+
+def _all_pads(encrypted):
+    return [s.ciphertext.pad.value
+            for b in encrypted for c in b.contests for s in c.selections]
+
+
+def test_chunked_seed_reuse_yields_distinct_pads():
+    # the exact footgun: two chunks, one seed, NO ballot_index_base
+    g, init, ballots = _production_election(4)
+    enc = BatchEncryptor(init, g)
+    seed = g.int_to_q(1234)
+    e1, inv1 = enc.encrypt_ballots(ballots[:2], seed=seed)
+    e2, inv2 = enc.encrypt_ballots(ballots[2:], seed=seed,
+                                   code_seed=e1[-1].code)
+    assert not inv1 and not inv2
+    pads = _all_pads(e1) + _all_pads(e2)
+    assert len(pads) == len(set(pads)), "ElGamal pad reused across chunks"
+
+
+def test_duplicate_ballot_id_rejected():
+    g, init, ballots = _production_election(2)
+    enc = BatchEncryptor(init, g)
+    dup = ballots[0]
+    out, invalid = enc.encrypt_ballots([dup, ballots[1], dup],
+                                       seed=g.int_to_q(5))
+    assert len(out) == 2
+    assert len(invalid) == 1 and "duplicate ballot id" in invalid[0][1]
+    # ... and ACROSS chunks on the same encryptor: a repeated id in a
+    # later encrypt_ballots call would replay the same nonce rows
+    out2, invalid2 = enc.encrypt_ballots([dup], seed=g.int_to_q(5),
+                                         code_seed=out[-1].code)
+    assert not out2
+    assert len(invalid2) == 1 and "duplicate ballot id" in invalid2[0][1]
