@@ -10,6 +10,13 @@ Holds: the guardian's own secret ``a_{i0}``, the received backup shares
 missing guardians), and everyone's public commitments (for recovery keys).
 Secrets never leave; only shares Mᵢ = A^s and proofs do (SURVEY.md §7 hard
 part 5).
+
+The reference hands its trustee the whole rpc batch and loops per
+ciphertext on the JVM (RunRemoteDecryptingTrustee.java:189-193 🔥); here the
+guardian-side hot loop runs on the device batch plane: shares A^s and proof
+commitments (g^u, A^u) in two powmod dispatches, Fiat–Shamir challenges in
+one device SHA-256 dispatch, responses in one Z_q dispatch — no per-text
+host ``pow`` on the production group.
 """
 
 from __future__ import annotations
@@ -17,8 +24,14 @@ from __future__ import annotations
 import json
 from typing import Sequence, Union
 
+import numpy as np
+
 from electionguard_tpu.core.group import (ElementModP, ElementModQ,
                                           GroupContext)
+from electionguard_tpu.core import sha256_jax
+from electionguard_tpu.core.group_jax import (jax_exp_ops, jax_ops,
+                                              limbs_to_bytes_be)
+from electionguard_tpu.core.hash import _encode
 from electionguard_tpu.crypto.chaum_pedersen import (
     GenericChaumPedersenProof, make_generic_cp_proof)
 from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
@@ -27,6 +40,67 @@ from electionguard_tpu.decrypt.interface import (
     DirectDecryptionAndProof)
 from electionguard_tpu.keyceremony.interface import Result
 from electionguard_tpu.keyceremony.trustee import commitment_product
+
+
+def _batch_shares_and_proofs(
+        g: GroupContext, texts: Sequence[ElGamalCiphertext],
+        s: ElementModQ, x: ElementModP, qbar: ElementModQ,
+) -> list[tuple[ElementModP, GenericChaumPedersenProof]]:
+    """Batched (Mᵢ = A^s, CP proof) for every ciphertext.
+
+    Device plan (production group): one ``powmod`` dispatch computes both
+    the shares A^s and the proof commitments A^u, one fixed-base dispatch
+    computes g^u, one device SHA-256 dispatch derives every challenge
+    c = H(Q̄, g, A, x, y, a, b), and one Z_q dispatch closes the responses
+    v = u − c·s.  ``x = g^s`` is the public counterpart of ``s`` (the
+    guardian's election public key for direct decryption, the recovery key
+    for compensated) — supplied by the caller, never recomputed from the
+    secret per text.  Non-production groups fall back to the host loop.
+    """
+    n = len(texts)
+    if n == 0:
+        return []
+    if not sha256_jax.supports(g):
+        out = []
+        for ct in texts:
+            share = g.pow_p(ct.pad, s)
+            proof = make_generic_cp_proof(
+                g, s, g.G_MOD_P, ct.pad, g.rand_q(), qbar)
+            out.append((share, proof))
+        return out
+
+    ops = jax_ops(g)
+    ee = jax_exp_ops(g)
+    pads = [ct.pad.value for ct in texts]
+    A_l = ops.to_limbs_p(pads)
+    s_l = ops.to_limbs_q([s.value] * n)
+    u_ints = [g.rand_q().value for _ in range(n)]
+    u_l = ops.to_limbs_q(u_ints)
+
+    # shares y = A^s and commitments b = A^u: ONE variable-base dispatch
+    pows = np.asarray(ops.powmod(np.concatenate([A_l, A_l]),
+                                 np.concatenate([s_l, u_l])))
+    y_l, b_l = pows[:n], pows[n:]
+    a_l = np.asarray(ops.g_pow(u_l))
+
+    # device Fiat–Shamir: c = H(Q̄, g, A, x, y, a, b); fixed items (Q̄, g)
+    # fold into the host prefix, the fixed x broadcasts as a row
+    x_b = np.broadcast_to(
+        np.frombuffer(x.to_bytes(), np.uint8), (n, g.spec.p_bytes))
+    prefix = _encode(qbar) + _encode(g.G_MOD_P)
+    c_l = np.asarray(sha256_jax.batch_challenge_p(
+        g, prefix,
+        [limbs_to_bytes_be(A_l), x_b, limbs_to_bytes_be(y_l),
+         limbs_to_bytes_be(a_l), limbs_to_bytes_be(b_l)]))
+
+    v_l = np.asarray(ee.a_minus_bc(u_l, c_l, s_l))
+    y_i = ops.from_limbs(y_l)
+    c_i = ee.from_limbs(c_l)
+    v_i = ee.from_limbs(v_l)
+    return [(ElementModP(y_i[k], g),
+             GenericChaumPedersenProof(g.int_to_q(c_i[k]),
+                                       g.int_to_q(v_i[k])))
+            for k in range(n)]
 
 
 class DecryptingTrustee(DecryptingTrusteeIF):
@@ -62,16 +136,14 @@ class DecryptingTrustee(DecryptingTrusteeIF):
             extended_base_hash: ElementModQ,
     ) -> Union[list[DirectDecryptionAndProof], Result]:
         """Mᵢ = A^{a_i0} + CP proof, for every ciphertext in the batch
-        (the trustee-side hot loop — SURVEY.md §3.2 🔥)."""
-        g = self.group
-        out = []
-        for ct in texts:
-            share = g.pow_p(ct.pad, self._secret)
-            proof = make_generic_cp_proof(
-                g, self._secret, g.G_MOD_P, ct.pad, g.rand_q(),
-                extended_base_hash)
-            out.append(DirectDecryptionAndProof(share, proof))
-        return out
+        (the trustee-side hot loop — SURVEY.md §3.2 🔥), in a handful of
+        device dispatches (reference per-text analogue:
+        RunRemoteDecryptingTrustee.java:189-193)."""
+        pairs = _batch_shares_and_proofs(
+            self.group, texts, self._secret, self.election_public_key,
+            extended_base_hash)
+        return [DirectDecryptionAndProof(share, proof)
+                for share, proof in pairs]
 
     def compensated_decrypt(
             self, missing_guardian_id: str,
@@ -93,14 +165,10 @@ class DecryptingTrustee(DecryptingTrusteeIF):
         if g.g_pow_p(backup) != recovery:
             return Result.Err(
                 f"backup for {missing_guardian_id} fails commitment check")
-        out = []
-        for ct in texts:
-            share = g.pow_p(ct.pad, backup)
-            proof = make_generic_cp_proof(
-                g, backup, g.G_MOD_P, ct.pad, g.rand_q(),
-                extended_base_hash)
-            out.append(CompensatedDecryptionAndProof(share, proof, recovery))
-        return out
+        pairs = _batch_shares_and_proofs(
+            g, texts, backup, recovery, extended_base_hash)
+        return [CompensatedDecryptionAndProof(share, proof, recovery)
+                for share, proof in pairs]
 
     # ------------------------------------------------------------------
     # persistence (the trustee-file checkpoint of SURVEY.md §5.4)
