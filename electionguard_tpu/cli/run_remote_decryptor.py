@@ -35,6 +35,8 @@ def main(argv=None) -> int:
     ap.add_argument("-port", type=int, default=17711)
     ap.add_argument("-decryptSpoiled", dest="decrypt_spoiled",
                     action="store_true")
+    ap.add_argument("-chunkSize", dest="chunk_size", type=int, default=512,
+                    help="spoiled ballots decrypted per trustee round trip")
     ap.add_argument("-timeout", type=float, default=300.0)
     add_group_flag(ap)
     args = ap.parse_args(argv)
@@ -76,10 +78,24 @@ def main(argv=None) -> int:
         publisher.write_decryption_result(result)
 
         if args.decrypt_spoiled:
-            spoiled = [b for b in consumer.iterate_encrypted_ballots()
-                       if b.state == BallotState.SPOILED]
-            tallies = [decryption.decrypt_ballot(b) for b in spoiled]
-            n_sp = publisher.write_spoiled_ballot_tallies(tallies)
+            # streamed + batched: spoiled ballots are collected into
+            # chunks, each chunk decrypted with ONE rpc leg per trustee
+            # per protocol, and its tallies written (and dropped) before
+            # the next chunk loads — O(chunks) round trips, O(chunk)
+            # memory (reference shape: one decryptBallot rpc per trustee
+            # per ballot, RunRemoteDecryptor.java:264-269)
+            def spoiled_tallies():
+                chunk = []
+                for b in consumer.iterate_encrypted_ballots():
+                    if b.state != BallotState.SPOILED:
+                        continue
+                    chunk.append(b)
+                    if len(chunk) >= args.chunk_size:
+                        yield from decryption.decrypt_ballots(chunk)
+                        chunk.clear()
+                if chunk:
+                    yield from decryption.decrypt_ballots(chunk)
+            n_sp = publisher.write_spoiled_ballot_tallies(spoiled_tallies())
             log.info("decrypted %d spoiled ballots", n_sp)
 
         log.info("published DecryptionResult to %s (%s)",

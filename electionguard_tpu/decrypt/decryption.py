@@ -233,33 +233,58 @@ class Decryption:
         return out
 
     # ------------------------------------------------------------------
-    def _decrypt_contests(self, tally_id: str, contests) -> PlaintextTally:
-        """Shared assembly for tally and single-ballot decryption; contest
-        items need (contest_id, selections[(selection_id, ciphertext)])."""
+    def _decrypt_groups(
+            self, groups: Sequence[tuple[str, Sequence]]
+    ) -> list[PlaintextTally]:
+        """Shared assembly: decrypt every selection of every group (one
+        ``_decrypt_batch`` — one rpc leg per trustee per protocol for the
+        whole lot) and rebuild one PlaintextTally per group.  Keys index
+        by GROUP POSITION, not id, so duplicated ballot ids in a tampered
+        record decrypt independently instead of silently sharing one
+        result."""
         texts, keys = [], []
-        for c in contests:
-            for s in c.selections:
-                texts.append(s.ciphertext)
-                keys.append((c.contest_id, s.selection_id))
+        for gi, (_, contests) in enumerate(groups):
+            for c in contests:
+                for s in c.selections:
+                    texts.append(s.ciphertext)
+                    keys.append((gi, c.contest_id, s.selection_id))
         by_key = dict(zip(keys, self._decrypt_batch(texts)))
-        out = tuple(
-            PlaintextTallyContest(
-                contest_id=c.contest_id,
-                selections=tuple(
-                    PlaintextTallySelection(
-                        selection_id=s.selection_id,
-                        tally=by_key[(c.contest_id, s.selection_id)][0],
-                        value=by_key[(c.contest_id, s.selection_id)][1],
-                        message=s.ciphertext,
-                        shares=by_key[(c.contest_id, s.selection_id)][2])
-                    for s in c.selections))
-            for c in contests)
-        return PlaintextTally(tally_id, out)
+        out = []
+        for gi, (tally_id, contests) in enumerate(groups):
+            out.append(PlaintextTally(tally_id, tuple(
+                PlaintextTallyContest(
+                    contest_id=c.contest_id,
+                    selections=tuple(
+                        PlaintextTallySelection(
+                            selection_id=s.selection_id,
+                            tally=by_key[(gi, c.contest_id,
+                                          s.selection_id)][0],
+                            value=by_key[(gi, c.contest_id,
+                                          s.selection_id)][1],
+                            message=s.ciphertext,
+                            shares=by_key[(gi, c.contest_id,
+                                           s.selection_id)][2])
+                        for s in c.selections))
+                for c in contests)))
+        return out
 
     def decrypt(self, tally: EncryptedTally) -> PlaintextTally:
-        return self._decrypt_contests(tally.tally_id, tally.contests)
+        return self._decrypt_groups(
+            [(tally.tally_id, tally.contests)])[0]
 
     def decrypt_ballot(self, ballot: EncryptedBallot) -> PlaintextTally:
         """Decrypt one (spoiled) ballot as a single-ballot tally
         (reference: RunRemoteDecryptor.java:264-269)."""
-        return self._decrypt_contests(ballot.ballot_id, ballot.contests)
+        return self.decrypt_ballots([ballot])[0]
+
+    def decrypt_ballots(
+            self, ballots: Sequence[EncryptedBallot]
+    ) -> list[PlaintextTally]:
+        """Decrypt a batch of (spoiled) ballots with ONE ``_decrypt_batch``
+        across every selection of every ballot — one rpc leg per trustee
+        per protocol for the whole chunk, where the reference shape is one
+        round trip per trustee per ballot
+        (RunRemoteDecryptor.java:264-269).  Callers stream large spoiled
+        sets chunk-by-chunk to keep memory O(chunk)."""
+        return self._decrypt_groups(
+            [(b.ballot_id, b.contests) for b in ballots])
