@@ -38,7 +38,7 @@ from electionguard_tpu.core.group import ElementModP, GroupContext
 from electionguard_tpu.core.group_jax import (jax_exp_ops, jax_ops,
                                               limbs_to_bytes_be)
 from electionguard_tpu.core import sha256_jax
-from electionguard_tpu.core.hash import _encode, hash_elems
+from electionguard_tpu.core.hash import _encode, hash_digest, hash_elems
 from electionguard_tpu.crypto.cp_batch import batch_cp_verify
 from electionguard_tpu.decrypt.decryption import lagrange_coefficient
 from electionguard_tpu.keyceremony.trustee import commitment_product
@@ -416,8 +416,18 @@ class Verifier:
             if not b.is_valid_code():
                 res.record("V6.ballot_chaining", False,
                            f"{b.ballot_id} confirmation code invalid")
-            # chain continuity: code_seed equals the previous ballot's code
-            if agg.prev_code is not None and b.code_seed != agg.prev_code:
+            if agg.prev_code is None:
+                # chain start must anchor to the manifest (the encryptor's
+                # start value, encrypt/encryptor.py): otherwise truncating
+                # leading ballots is invisible to the chain check
+                anchor = hash_digest("code-chain-start",
+                                     self.init.manifest_hash)
+                if b.code_seed != anchor:
+                    res.record("V6.ballot_chaining", False,
+                               f"{b.ballot_id} chain start is not anchored "
+                               f"to the manifest (leading ballots removed?)")
+            elif b.code_seed != agg.prev_code:
+                # chain continuity: code_seed = previous ballot's code
                 res.record("V6.ballot_chaining", False,
                            f"{b.ballot_id} breaks the code chain")
             agg.prev_code = b.code
@@ -495,16 +505,54 @@ class Verifier:
                            f"lagrange coefficient of {dg.guardian_id} wrong")
         res.record("V10.lagrange", True)
 
-        cast_count = dr.tally_result.encrypted_tally.cast_ballot_count
+        # anchor against the independently verified record tally (V7
+        # checked it against the ballots), NOT the copy embedded in the
+        # attacker-publishable DecryptionResult — otherwise dropping a
+        # selection from both halves of that one file passes
+        anchor_tally = (self.record.tally_result.encrypted_tally
+                        if self.record.tally_result is not None
+                        else dr.tally_result.encrypted_tally)
+        cast_count = anchor_tally.cast_ballot_count
         labels = {"direct": "V8.direct_proofs", "comp": "V9.compensated",
                   "lagrange": "V10.lagrange",
                   "combine": "V11.share_combination"}
         self._verify_tally_shares(res, dr.decrypted_tally, avail, labels)
+
+        # V12: decode sanity — per-selection and per-contest bounds, and
+        # the decrypted tally must cover the encrypted tally one-for-one
+        # (dropping a selection from the published decryption would
+        # otherwise go unnoticed)
+        contests_by_id = {c.object_id: c
+                          for c in self.init.config.manifest.contests}
+        enc_keys = {(c.contest_id, s.selection_id)
+                    for c in anchor_tally.contests
+                    for s in c.selections}
+        dec_keys = set()
         for c in dr.decrypted_tally.contests:
+            contest_sum = 0
             for s in c.selections:
+                dec_keys.add((c.contest_id, s.selection_id))
+                contest_sum += s.tally
                 if cast_count and s.tally > cast_count:
                     res.record("V12.tally_decode", False,
                                f"tally {s.tally} exceeds cast ballots")
+            desc = contests_by_id.get(c.contest_id)
+            if desc is None:
+                res.record("V12.tally_decode", False,
+                           f"decrypted contest {c.contest_id} not in "
+                           f"manifest")
+            elif cast_count and \
+                    contest_sum > desc.votes_allowed * cast_count:
+                res.record("V12.tally_decode", False,
+                           f"contest {c.contest_id} decoded sum "
+                           f"{contest_sum} exceeds votes_allowed "
+                           f"({desc.votes_allowed}) x cast ({cast_count})")
+        if dec_keys != enc_keys:
+            res.record("V12.tally_decode", False,
+                       f"decrypted tally selections do not match the "
+                       f"encrypted tally (missing: "
+                       f"{sorted(enc_keys - dec_keys)}, extra: "
+                       f"{sorted(dec_keys - enc_keys)})")
         res.record("V8.direct_proofs", True)
         res.record("V9.compensated", True)
         res.record("V11.share_combination", True)
@@ -534,10 +582,33 @@ class Verifier:
         # computed once, NOT per selection
         recovery_cache: dict[tuple[str, str], ElementModP] = {}
 
+        all_ids = set(guardians)
+        avail_ids = set(avail)
         for c in tally.contests:
             for s in c.selections:
                 A = s.message.pad
                 m_total = 1
+                # share coverage: every available guardian must contribute
+                # a proved direct share, every missing guardian a
+                # reconstructed share — dropping or duplicating one would
+                # silently shift M = Π Mᵢ
+                direct_ids = [sh.guardian_id for sh in s.shares
+                              if sh.proof is not None]
+                recon_ids = [sh.guardian_id for sh in s.shares
+                             if sh.proof is None]
+                # sorted-list comparison also rejects duplicates (the
+                # right-hand sides are duplicate-free)
+                if sorted(direct_ids) != sorted(avail_ids):
+                    res.record(labels["direct"], False,
+                               f"{s.selection_id}: direct shares from "
+                               f"{sorted(direct_ids)} != available "
+                               f"guardians {sorted(avail_ids)}")
+                want_missing = sorted(all_ids - avail_ids)
+                if sorted(recon_ids) != want_missing:
+                    res.record(labels["comp"], False,
+                               f"{s.selection_id}: reconstructed shares "
+                               f"from {sorted(recon_ids)} != missing "
+                               f"guardians {want_missing}")
                 for share in s.shares:
                     gr = guardians.get(share.guardian_id)
                     if gr is None:
@@ -560,6 +631,12 @@ class Verifier:
                                        f"missing share {share.guardian_id} "
                                        f"has no parts")
                             continue
+                        if set(share.recovered_parts) != avail_ids:
+                            res.record(labels["comp"], False,
+                                       f"{s.selection_id}: parts for "
+                                       f"{share.guardian_id} from "
+                                       f"{sorted(share.recovered_parts)} != "
+                                       f"available {sorted(avail_ids)}")
                         start, count = len(recon_base), 0
                         for t_id, part in share.recovered_parts.items():
                             t_rec = avail.get(t_id)
@@ -632,17 +709,43 @@ class Verifier:
                  if dr is not None else {})
         labels = {k: "V13.spoiled"
                   for k in ("direct", "comp", "lagrange", "combine")}
+        manifest_contests = {c.object_id: c
+                             for c in self.init.config.manifest.contests}
+        seen_tally_ids = set()
         for t in self.record.spoiled_ballot_tallies:
             if t.tally_id not in spoiled_ids:
                 res.record("V13.spoiled", False,
                            f"spoiled tally {t.tally_id} for non-spoiled "
                            f"ballot")
                 continue
+            if t.tally_id in seen_tally_ids:
+                res.record("V13.spoiled", False,
+                           f"duplicate spoiled tally {t.tally_id}")
+                continue
+            seen_tally_ids.add(t.tally_id)
             if dr is None:
                 res.record("V13.spoiled", False,
                            f"spoiled tally {t.tally_id} without a "
                            f"decryption result")
                 continue
+            # structure vs manifest: contests must exist, selections must
+            # be manifest selections or that contest's placeholders
+            for c in t.contests:
+                desc = manifest_contests.get(c.contest_id)
+                if desc is None:
+                    res.record("V13.spoiled", False,
+                               f"{t.tally_id}: contest {c.contest_id} not "
+                               f"in manifest")
+                    continue
+                known = {s.object_id for s in desc.selections}
+                for s in c.selections:
+                    if (s.selection_id not in known
+                            and not s.selection_id.startswith(
+                                f"{c.contest_id}-placeholder-")):
+                        res.record("V13.spoiled", False,
+                                   f"{t.tally_id}: selection "
+                                   f"{s.selection_id} not in manifest "
+                                   f"contest {c.contest_id}")
             self._verify_tally_shares(res, t, avail, labels)
         res.record("V13.spoiled", True)
 

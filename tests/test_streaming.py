@@ -70,6 +70,19 @@ def test_streaming_verifier_chain_break_across_chunks(tgroup):
     assert not res.checks["V6.ballot_chaining"]
 
 
+def test_streaming_verifier_truncate_front(tgroup):
+    """Removing LEADING ballots must break V6: the first surviving
+    ballot's code_seed no longer equals the manifest-anchored chain-start
+    value (VERDICT r3 weak item 5 — previously invisible to V6)."""
+    init, encrypted, _ = _make_election(tgroup, spoil_every=0)
+    tally = accumulate_ballots(init, encrypted)
+    record = ElectionRecord(election_init=init,
+                            encrypted_ballots=iter(encrypted[1:]),
+                            tally_result=tally)
+    res = Verifier(record, tgroup, chunk_size=8).verify()
+    assert not res.checks["V6.ballot_chaining"]
+
+
 def test_streaming_verifier_detects_cast_count_mismatch(tgroup):
     init, encrypted, _ = _make_election(tgroup, spoil_every=0)
     tally = accumulate_ballots(init, encrypted)
