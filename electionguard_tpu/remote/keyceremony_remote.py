@@ -276,6 +276,7 @@ class KeyCeremonyTrusteeServer:
         self.trustee: Optional[KeyCeremonyTrustee] = None
         self._all_ok: Optional[bool] = None
         self._done = threading.Event()
+        self._ready = threading.Event()
 
         self.server, self.port = rpc_util.make_server(port)
         self.url = f"{host}:{self.port}"
@@ -306,12 +307,27 @@ class KeyCeremonyTrusteeServer:
         self.quorum = int(resp.quorum)
         self.trustee = KeyCeremonyTrustee(group, guardian_id,
                                           self.x_coordinate, self.quorum)
+        self._ready.set()
         log.info("trustee %s registered: x=%d quorum=%d url=%s",
                  guardian_id, self.x_coordinate, self.quorum, self.url)
 
+    def _delegate(self) -> Optional[KeyCeremonyTrustee]:
+        """The server must listen BEFORE registering (the coordinator
+        dials back), but the delegate is only built after the
+        registration response assigns x/quorum — and on the production
+        group that construction (polynomial commitments + Schnorr proofs)
+        takes long enough that the coordinator's first sendPublicKeys can
+        land in the gap.  Block the rpc briefly instead of racing."""
+        if self._ready.wait(timeout=60.0):
+            return self.trustee
+        return None
+
     # ---- rpc impls ---------------------------------------------------
     def _send_public_keys(self, request, context):
-        keys = self.trustee.send_public_keys()
+        trustee = self._delegate()
+        if trustee is None:
+            return pb.msg("PublicKeySet")(error="trustee not ready")
+        keys = trustee.send_public_keys()
         if isinstance(keys, Result):
             return pb.msg("PublicKeySet")(error=keys.error)
         return pb.msg("PublicKeySet")(
@@ -332,11 +348,17 @@ class KeyCeremonyTrusteeServer:
                       for p in request.coefficient_proofs))
         except ValueError as e:
             return Resp(ok=False, error=f"malformed keys: {e}")
-        res = self.trustee.receive_public_keys(keys)
+        trustee = self._delegate()
+        if trustee is None:
+            return Resp(ok=False, error="trustee not ready")
+        res = trustee.receive_public_keys(keys)
         return Resp(ok=res.ok, error=res.error)
 
     def _send_secret_key_share(self, request, context):
-        share = self.trustee.send_secret_key_share(
+        trustee = self._delegate()
+        if trustee is None:
+            return pb.msg("PartialKeyBackup")(error="trustee not ready")
+        share = trustee.send_secret_key_share(
             request.designated_guardian_id)
         if isinstance(share, Result):
             return pb.msg("PartialKeyBackup")(error=share.error)
@@ -358,11 +380,18 @@ class KeyCeremonyTrusteeServer:
                     self.group, request.encrypted_coordinate))
         except ValueError as e:
             return Resp(ok=False, error=f"malformed share: {e}")
-        res = self.trustee.receive_secret_key_share(share)
+        trustee = self._delegate()
+        if trustee is None:
+            return Resp(ok=False, error="trustee not ready")
+        res = trustee.receive_secret_key_share(share)
         return Resp(ok=res.ok, error=res.error)
 
     def _challenge_share(self, request, context):
-        resp = self.trustee.challenge_share(request.challenger_guardian_id)
+        trustee = self._delegate()
+        if trustee is None:
+            return pb.msg("PartialKeyChallengeResponse")(
+                error="trustee not ready")
+        resp = trustee.challenge_share(request.challenger_guardian_id)
         if isinstance(resp, Result):
             return pb.msg("PartialKeyChallengeResponse")(error=resp.error)
         return pb.msg("PartialKeyChallengeResponse")(
@@ -379,7 +408,10 @@ class KeyCeremonyTrusteeServer:
                 serialize.import_q(self.group, request.coordinate))
         except ValueError as e:
             return Resp(ok=False, error=f"malformed challenge response: {e}")
-        res = self.trustee.receive_challenged_share(resp)
+        trustee = self._delegate()
+        if trustee is None:
+            return Resp(ok=False, error="trustee not ready")
+        res = trustee.receive_challenged_share(resp)
         return Resp(ok=res.ok, error=res.error)
 
     def _save_state(self, request, context):
@@ -387,7 +419,11 @@ class KeyCeremonyTrusteeServer:
         if not out:
             return pb.msg("BoolResponse")(ok=False,
                                           error="no output dir configured")
-        res = self.trustee.save_state(out)
+        trustee = self._delegate()
+        if trustee is None:
+            return pb.msg("BoolResponse")(ok=False,
+                                          error="trustee not ready")
+        res = trustee.save_state(out)
         return pb.msg("BoolResponse")(ok=res.ok, error=res.error)
 
     def _finish(self, request, context):
