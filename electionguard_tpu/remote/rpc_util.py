@@ -85,6 +85,14 @@ def check_group_constants(group, constants) -> str:
     """Trustee-side check of the coordinator's response constants; "" if
     ok (or constants absent — older coordinator), else the error."""
     if not constants or not constants.p:
+        # an old-style coordinator that never populates constants skips
+        # the handshake check — warn so a later opaque byte-width failure
+        # is traceable to the missing negotiation, not silent
+        import logging
+        logging.getLogger("rpc_util").warning(
+            "coordinator sent no group constants; cannot confirm it runs "
+            "group '%s' — a mismatch will surface as a decode failure "
+            "later", group.spec.name)
         return ""
     if (int.from_bytes(constants.p, "big") != group.p
             or int.from_bytes(constants.q, "big") != group.q

@@ -19,12 +19,15 @@ import sys
 import time
 from typing import Mapping, MutableMapping, Optional
 
-#: Env-var name fragments that attach the process to the axon TPU tunnel.
-_TUNNEL_KEYS = ("AXON", "PALLAS")
+#: Env-var name prefixes that attach the process to the axon TPU tunnel.
+#: Prefix-matched (not substring) so unrelated vars that merely contain
+#: one of these words (e.g. JAX_PALLAS_* debug knobs or third-party
+#: *_AXON_* settings) are never scrubbed from subprocess envs.
+_TUNNEL_PREFIXES = ("AXON_", "PALLAS_", "TPU_")
 
 
 def _is_tunnel_var(key: str) -> bool:
-    return any(t in key for t in _TUNNEL_KEYS) or key.startswith("TPU")
+    return key.startswith(_TUNNEL_PREFIXES) or key in ("AXON", "TPU")
 
 
 def detach_axon(env: Optional[MutableMapping[str, str]] = None) -> None:

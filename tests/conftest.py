@@ -10,11 +10,11 @@ import os
 
 # Detach from the axon TPU tunnel entirely: tests are CPU-only, and a wedged
 # relay otherwise hangs `import jax` (the axon plugin dials the relay at
-# backend init regardless of JAX_PLATFORMS).
-for _k in list(os.environ):
-    if "AXON" in _k or "PALLAS" in _k or _k.startswith("TPU"):
-        os.environ.pop(_k)
-os.environ["JAX_PLATFORMS"] = "cpu"
+# backend init regardless of JAX_PLATFORMS).  One scrub rule for the whole
+# codebase: utils.platform (pure stdlib, safe to import before jax).
+from electionguard_tpu.utils.platform import detach_axon  # noqa: E402
+
+detach_axon()
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
