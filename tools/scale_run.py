@@ -1,0 +1,214 @@
+"""Scale evidence for BASELINE configs 3-5 (VERDICT r3 item 6).
+
+Two phases, each optional:
+
+  --stream N   : N-ballot (default 100k) fully-streamed run on the tiny
+                 group — encrypt chunk-by-chunk to a framed on-disk record,
+                 accumulate the tally from the stream, then verify from the
+                 stream — with peak-RSS tracking proving O(chunk) host
+                 residency end-to-end (the reference's analogue loads the
+                 record in memory with an 11-thread pool,
+                 RunRemoteWorkflowTest.java:140,180).
+  --prod N     : N-ballot production-4096 encrypt+verify wall-clock on the
+                 current platform, extrapolated to the 1M/60s north star.
+
+Writes SCALE.json (machine-readable) and appends a row to SCALE.md.
+
+Usage:  python tools/scale_run.py --stream 100000 --prod 2048
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def stream_phase(nballots: int, chunk: int, workdir: str) -> dict:
+    from electionguard_tpu.ballot.ciphertext import BallotState
+    from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+    from electionguard_tpu.core.group import tiny_group
+    from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+    from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+    from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+    from electionguard_tpu.publish.election_record import (ElectionConfig,
+                                                           ElectionRecord)
+    from electionguard_tpu.publish.publisher import Consumer, Publisher
+    from electionguard_tpu.tally.accumulate import accumulate_ballots
+    from electionguard_tpu.verify.verifier import Verifier
+    from electionguard_tpu.workflow.e2e import sample_manifest
+
+    g = tiny_group()
+    manifest = sample_manifest(1, 2)
+    trustees = [KeyCeremonyTrustee(g, "g0", 1, 1)]
+    init = key_ceremony_exchange(trustees, g).make_election_initialized(
+        ElectionConfig(manifest, 1, 1), {"created_by": "scale_run"})
+    pub = Publisher(workdir)
+    pub.write_election_initialized(init)
+    enc = BatchEncryptor(init, g)
+    seed = g.int_to_q(42)
+
+    # ---- encrypt: generate, encrypt, write, DROP, one chunk at a time
+    t0 = time.time()
+    provider = RandomBallotProvider(manifest, nballots, seed=3).ballots()
+    code_seed = None
+    written = 0
+    with pub.open_encrypted_ballots() as stream:
+        done = False
+        while not done:
+            batch = []
+            for _ in range(chunk):
+                try:
+                    batch.append(next(provider))
+                except StopIteration:
+                    done = True
+                    break
+            if not batch:
+                break
+            spoiled = {b.ballot_id for i, b in enumerate(batch)
+                       if (written + i + 1) % 10 == 0}
+            out, invalid = enc.encrypt_ballots(
+                batch, seed=seed, code_seed=code_seed, spoiled_ids=spoiled)
+            assert not invalid
+            for b in out:
+                stream.write(b)
+            code_seed = out[-1].code
+            written += len(out)
+    t_encrypt = time.time() - t0
+    rss_after_encrypt = rss_mb()
+
+    consumer = Consumer(workdir, g)
+
+    # ---- tally: streamed accumulation from disk
+    t0 = time.time()
+    tally_result = accumulate_ballots(
+        init, consumer.iterate_encrypted_ballots(), chunk_size=chunk)
+    pub.write_tally_result(tally_result)
+    t_tally = time.time() - t0
+    rss_after_tally = rss_mb()
+
+    # ---- verify: streamed verification from disk (V4-V7)
+    t0 = time.time()
+    record = ElectionRecord(
+        election_init=init,
+        encrypted_ballots=consumer.iterate_encrypted_ballots(),
+        tally_result=tally_result)
+    res = Verifier(record, g, chunk_size=chunk).verify()
+    t_verify = time.time() - t0
+    assert res.ok, res.summary()
+
+    n_spoiled = sum(1 for b in consumer.iterate_encrypted_ballots()
+                    if b.state == BallotState.SPOILED)
+    record_bytes = os.path.getsize(os.path.join(workdir,
+                                                "encrypted_ballots.pb"))
+    return {
+        "phase": "stream", "group": "tiny", "nballots": written,
+        "n_spoiled": n_spoiled, "chunk_size": chunk,
+        "record_mb": round(record_bytes / 1e6, 1),
+        "encrypt_s": round(t_encrypt, 1),
+        "encrypt_per_s": round(written / t_encrypt, 1),
+        "tally_s": round(t_tally, 1),
+        "verify_s": round(t_verify, 1),
+        "verify_per_s": round(written / t_verify, 1),
+        "peak_rss_mb": {"after_encrypt": round(rss_after_encrypt, 1),
+                        "after_tally": round(rss_after_tally, 1),
+                        "final": round(rss_mb(), 1)},
+        "verifier_ok": res.ok,
+    }
+
+
+def prod_phase(nballots: int) -> dict:
+    import jax
+
+    from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+    from electionguard_tpu.core.group import production_group
+    from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+    from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+    from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+    from electionguard_tpu.publish.election_record import (ElectionConfig,
+                                                           ElectionRecord)
+    from electionguard_tpu.tally.accumulate import accumulate_ballots
+    from electionguard_tpu.verify.verifier import Verifier
+    from electionguard_tpu.workflow.e2e import sample_manifest
+
+    g = production_group()
+    manifest = sample_manifest(1, 2)
+    trustees = [KeyCeremonyTrustee(g, "g0", 1, 1)]
+    init = key_ceremony_exchange(trustees, g).make_election_initialized(
+        ElectionConfig(manifest, 1, 1), {"created_by": "scale_run"})
+    ballots = list(RandomBallotProvider(manifest, nballots,
+                                        seed=1).ballots())
+    enc = BatchEncryptor(init, g)
+    t0 = time.time()
+    encrypted, invalid = enc.encrypt_ballots(ballots, seed=g.int_to_q(42))
+    t_encrypt = time.time() - t0
+    assert not invalid
+    tally_result = accumulate_ballots(init, encrypted)
+    record = ElectionRecord(election_init=init, encrypted_ballots=encrypted,
+                            tally_result=tally_result)
+    res = Verifier(record, g).verify()        # warmup/compile
+    assert res.ok, res.summary()
+    t0 = time.time()
+    res = Verifier(record, g).verify()
+    t_verify = time.time() - t0
+    assert res.ok, res.summary()
+    rate = nballots / t_verify
+    return {
+        "phase": "prod", "group": "production-4096",
+        "platform": jax.devices()[0].platform, "nballots": nballots,
+        "encrypt_s": round(t_encrypt, 1),
+        "encrypt_per_s": round(nballots / t_encrypt, 1),
+        "verify_s": round(t_verify, 1),
+        "verify_per_s_per_chip": round(rate, 1),
+        "extrapolated_1m_verify_s_on_8_chips": round(1e6 / rate / 8, 1),
+        "peak_rss_mb": round(rss_mb(), 1),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser("scale_run")
+    ap.add_argument("--stream", type=int, default=0,
+                    help="streamed tiny-group ballots (e.g. 100000)")
+    ap.add_argument("--prod", type=int, default=0,
+                    help="production-group verify wall-clock ballots")
+    ap.add_argument("--chunk", type=int, default=4096)
+    ap.add_argument("--workdir", default="/tmp/egtpu_scale")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "SCALE.json"))
+    args = ap.parse_args()
+
+    from electionguard_tpu.utils import enable_compile_cache
+    enable_compile_cache()
+
+    results = []
+    if args.stream:
+        os.makedirs(args.workdir, exist_ok=True)
+        r = stream_phase(args.stream, args.chunk, args.workdir)
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    if args.prod:
+        r = prod_phase(args.prod)
+        print(json.dumps(r), flush=True)
+        results.append(r)
+
+    existing = []
+    if os.path.exists(args.out):
+        with open(args.out) as f:
+            existing = json.load(f)
+    with open(args.out, "w") as f:
+        json.dump(existing + results, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
