@@ -35,6 +35,62 @@ from electionguard_tpu.core import ntt_mxu
 from electionguard_tpu.core.group import GroupContext
 
 
+def _dispatch_tile() -> int:
+    """Row cap per device dispatch (EGTPU_TILE, default 4096): batches
+    larger than this run as a loop of cap-shaped tiles, bounding the set
+    of compiled batch shapes for any workload size."""
+    return max(16, int(os.environ.get("EGTPU_TILE", "4096")))
+
+
+def dispatch_bucket(n: int, cap: int) -> int:
+    """Rows per dispatch for a batch of ``n`` ≤ ``cap``: power-of-two
+    buckets up to cap/8, then straight to the cap.  The compiled shape
+    set per op is therefore tiny — {16 … cap/8, cap} — and every LARGE
+    dispatch in a workload hits the one cap shape, which a benchmark (or
+    first production run) can prewarm with a single dummy dispatch per
+    op instead of paying a multi-minute XLA compile per batch size
+    mid-run."""
+    from electionguard_tpu.utils import batch_bucket
+    nb = batch_bucket(n)
+    return nb if nb <= cap // 8 else cap
+
+
+def pad_rows(arr, nb: int, fill_one: bool = False):
+    """Pad (B, ...) rows up to nb; pad rows are 0, or 1 (first limb) for
+    ops whose neutral element is 1."""
+    b = arr.shape[0]
+    if nb == b:
+        return arr
+    pad = jnp.zeros((nb - b,) + arr.shape[1:], dtype=arr.dtype)
+    if fill_one:
+        pad = pad.at[:, 0].set(jnp.asarray(1, dtype=arr.dtype))
+    return jnp.concatenate([arr, pad], axis=0)
+
+
+def run_tiled(jfn, arrays, fills, cap: int | None = None):
+    """THE dispatch policy, shared by every batch plane (group ops,
+    exponent ops, device SHA-256): dispatch ``jfn(*arrays)`` over
+    row-tiles — batches ≤ cap pad to their ``dispatch_bucket`` shape,
+    larger batches loop over cap-sized tiles (last tile padded to the
+    cap) — so any workload size compiles the same bounded set of
+    programs.  ``fills[i]`` selects 1-rows (True) or 0-rows (False) as
+    the i-th array's padding."""
+    arrays = [jnp.asarray(a) for a in arrays]
+    n = arrays[0].shape[0]
+    cap = cap or _dispatch_tile()
+
+    def one(tiles, nb):
+        m = tiles[0].shape[0]
+        return jfn(*[pad_rows(a, nb, f)
+                     for a, f in zip(tiles, fills)])[:m]
+
+    if n <= cap:
+        return one(arrays, dispatch_bucket(n, cap))
+    return jnp.concatenate(
+        [one([a[lo:lo + cap] for a in arrays], cap)
+         for lo in range(0, n, cap)], axis=0)
+
+
 def _default_backend() -> str:
     """MXU NTT engine on TPU, VPU CIOS elsewhere; override with
     EGTPU_BIGNUM=ntt|cios."""
@@ -176,56 +232,53 @@ class JaxGroupOps:
     # ------------------------------------------------------------------
     # public array API (jnp/np arrays of limbs in and out)
     #
-    # Batch axes are padded up to power-of-two buckets (with neutral
-    # elements) before dispatch so the whole workflow compiles a handful
-    # of shapes instead of one per distinct batch size — compile time is
-    # the practical cost of the big NTT programs.
+    # Every op dispatches through the shared ``run_tiled`` policy: padded
+    # power-of-two buckets capped at a fixed tile size, so the whole
+    # workflow compiles a BOUNDED set of shapes no matter how large the
+    # workload — compile time is the practical cost of the big NTT
+    # programs, and an arbitrary-size election must not pay a fresh
+    # multi-minute compile per batch size (EGTPU_TILE overrides the cap).
     # ------------------------------------------------------------------
-    @staticmethod
-    def _bucket(b: int) -> int:
-        from electionguard_tpu.utils import batch_bucket
-        return batch_bucket(b)
-
-    def _pad(self, arr, fill_one: bool):
-        """Pad (B, n) to the bucketed batch; fill rows with 1 or 0."""
-        arr = jnp.asarray(arr)
-        b = arr.shape[0]
-        nb = self._bucket(b)
-        if nb == b:
-            return arr, b
-        pad = jnp.zeros((nb - b, arr.shape[1]), dtype=arr.dtype)
-        if fill_one:
-            pad = pad.at[:, 0].set(jnp.asarray(1, dtype=arr.dtype))
-        return jnp.concatenate([arr, pad], axis=0), b
+    @property
+    def tile(self) -> int:
+        return _dispatch_tile()
 
     def powmod(self, base, exp):
         """Elementwise batch base^exp mod p; base (B,n), exp (B,ne)."""
-        base, b = self._pad(base, fill_one=True)   # 1^0 = 1 padding
-        exp, _ = self._pad(exp, fill_one=False)
-        return self._powmod_j(base, exp)[:b]
+        return run_tiled(self._powmod_j, [base, exp],
+                         [True, False])   # 1^0 = 1 padding
 
     def mulmod(self, a, b_arr):
-        a, b = self._pad(a, fill_one=True)
-        b_arr, _ = self._pad(b_arr, fill_one=True)
-        return self._mulmod_j(a, b_arr)[:b]
+        return run_tiled(self._mulmod_j, [a, b_arr], [True, True])
 
     def g_pow(self, exp):
         """g^exp via the PowRadix table; exp (B, ne)."""
-        exp, b = self._pad(exp, fill_one=False)    # g^0 = 1 padding
-        return self._fixed_pow_j(self.g_table, exp)[:b]
+        return run_tiled(
+            lambda e: self._fixed_pow_j(self.g_table, e),
+            [exp], [False])               # g^0 = 1 padding
 
     def base_pow(self, base: int, exp):
         """base^exp for a host-known base (K, g^{-1}, ...) via cached table."""
-        exp, b = self._pad(exp, fill_one=False)
-        return self._fixed_pow_j(self.fixed_table(base), exp)[:b]
+        table = self.fixed_table(base)
+        return run_tiled(
+            lambda e: self._fixed_pow_j(table, e), [exp], [False])
 
     def prod_reduce(self, x):
         """Product over axis 0: (M, B, n) -> (B, n).  Both the reduced M
         axis (which varies with ballot count) and the B axis are bucketed
-        with neutral 1-rows."""
+        with neutral 1-rows (same bounded shape set as _run_tiled)."""
         x = jnp.asarray(x)
         m, b = x.shape[0], x.shape[1]
-        nm, nb = self._bucket(m), self._bucket(b)
+        cap = self.tile
+        if m > cap:   # reduce cap-sized slabs, then combine the partials
+            parts = [self.prod_reduce(x[lo:lo + cap])
+                     for lo in range(0, m, cap)]
+            return self.prod_reduce(jnp.stack(parts))
+        if b > cap:   # tile the passive axis
+            return jnp.concatenate(
+                [self.prod_reduce(x[:, lo:lo + cap])
+                 for lo in range(0, b, cap)], axis=0)
+        nm, nb = dispatch_bucket(m, cap), dispatch_bucket(b, cap)
         if nm != m or nb != b:
             one = jnp.zeros((1, 1, x.shape[2]), dtype=x.dtype)
             one = one.at[..., 0].set(jnp.asarray(1, dtype=x.dtype))
@@ -241,11 +294,12 @@ class JaxGroupOps:
 
     def is_valid_residue(self, x):
         """Batched subgroup membership x^q == 1 (and 0 < x < p)."""
-        x, b = self._pad(x, fill_one=True)         # 1 is a valid residue
-        q_exp = jnp.broadcast_to(
-            jnp.asarray(bn.int_to_limbs(self.group.q, self.ne)),
-            x.shape[:-1] + (self.ne,))
-        return self._verify_residue_j(x, q_exp)[:b]
+        q_l = jnp.asarray(bn.int_to_limbs(self.group.q, self.ne))
+
+        def fn(xt):                               # 1 is a valid residue
+            q_exp = jnp.broadcast_to(q_l, xt.shape[:-1] + (self.ne,))
+            return self._verify_residue_j(xt, q_exp)
+        return run_tiled(fn, [x], [True])
 
     # ------------------------------------------------------------------
     # int-facing convenience (tests, small control-plane batches)
@@ -288,17 +342,19 @@ class JaxExponentOps:
         return bn.limbs_to_ints(np.asarray(arr))
 
     def mul(self, a, b):
-        return self._mul_j(jnp.asarray(a), jnp.asarray(b))
+        return run_tiled(self._mul_j, [a, b], [False, False])
 
     def add(self, a, b):
-        return self._add_j(jnp.asarray(a), jnp.asarray(b))
+        return run_tiled(self._add_j, [a, b], [False, False])
 
     def sub(self, a, b):
-        return self._sub_j(jnp.asarray(a), jnp.asarray(b))
+        return run_tiled(self._sub_j, [a, b], [False, False])
 
     def a_minus_bc(self, a, b, c):
         """a - b·c mod q, the response equation of every proof."""
-        return self.sub(a, self.mul(b, c))
+        return run_tiled(
+            lambda x, y, z: self._sub_j(x, self._mul_j(y, z)),
+            [a, b, c], [False, False, False])
 
 
 def limbs_to_bytes_be(arr: np.ndarray) -> np.ndarray:

@@ -136,11 +136,6 @@ def _hash_rows_mod_q(msgs: jax.Array, q_limbs: jax.Array) -> jax.Array:
     return _digest_mod_q(sha256_rows(msgs), q_limbs)
 
 
-def _bucket(b: int) -> int:
-    from electionguard_tpu.utils import batch_bucket
-    return batch_bucket(b)
-
-
 def supports(group) -> bool:
     """Whether the device challenge path applies: the production group's
     256-bit q (single-subtract mod-q reduction) AND 4096-bit p (the fixed
@@ -165,19 +160,19 @@ def batch_challenge_p(group, prefix: bytes, elem_bytes: list) -> np.ndarray:
     if not supports(group):
         raise ValueError("batch_challenge_p requires the production group "
                          "(256-bit q, 4096-bit p)")
-    arrs = [jnp.asarray(e, dtype=jnp.uint8) for e in elem_bytes]
-    b = arrs[0].shape[0]
-    nb = _bucket(b)
-    if nb != b:
-        arrs = [jnp.concatenate(
-            [a, jnp.zeros((nb - b, a.shape[1]), jnp.uint8)]) for a in arrs]
-    hdr = jnp.broadcast_to(
-        jnp.asarray(np.frombuffer(_TAG_P_HDR, np.uint8)), (nb, 5))
-    parts = [jnp.broadcast_to(
-        jnp.asarray(np.frombuffer(prefix, np.uint8)), (nb, len(prefix)))]
-    for a in arrs:
-        parts.append(hdr)
-        parts.append(a)
-    msgs = jnp.concatenate(parts, axis=1)
+    from electionguard_tpu.core.group_jax import run_tiled
+
+    arrs = [np.asarray(e, dtype=np.uint8) for e in elem_bytes]
     q_limbs = jnp.asarray(bn.int_to_limbs(group.q, 16))
-    return _hash_rows_mod_q(msgs, q_limbs)[:b]
+    prefix_row = jnp.asarray(np.frombuffer(prefix, np.uint8))
+    hdr_row = jnp.asarray(np.frombuffer(_TAG_P_HDR, np.uint8))
+
+    def jfn(*padded):
+        nb = padded[0].shape[0]
+        parts = [jnp.broadcast_to(prefix_row, (nb, len(prefix)))]
+        for a in padded:
+            parts.append(jnp.broadcast_to(hdr_row, (nb, 5)))
+            parts.append(a)
+        return _hash_rows_mod_q(jnp.concatenate(parts, axis=1), q_limbs)
+
+    return run_tiled(jfn, arrs, [False] * len(arrs))

@@ -431,20 +431,14 @@ def _nonce_rows(seed: ElementModQ, tags: np.ndarray, bids: np.ndarray,
 
 
 def _derive_nonce_ints(g, ee, msgs: np.ndarray) -> list[int]:
-    """Hash rows on-device, reduce mod q, return host ints.  Rows are
-    padded to the shared batch bucket so the whole workflow compiles a
-    handful of SHA shapes."""
-    import jax.numpy as jnp
-
-    from electionguard_tpu.utils import batch_bucket
-    n = msgs.shape[0]
-    nb = batch_bucket(n)
-    if nb != n:
-        msgs = np.concatenate(
-            [msgs, np.zeros((nb - n, msgs.shape[1]), np.uint8)])
-    limbs = np.asarray(sha256_jax.digest_to_q_limbs(
-        g, sha256_jax.sha256_rows(jnp.asarray(msgs))))[:n]
-    return ee.from_limbs(limbs)
+    """Hash rows on-device, reduce mod q, return host ints.  Dispatches
+    through the shared ``run_tiled`` policy so the whole workflow
+    compiles a bounded set of SHA shapes."""
+    from electionguard_tpu.core.group_jax import run_tiled
+    limbs = run_tiled(
+        lambda m: sha256_jax.digest_to_q_limbs(g, sha256_jax.sha256_rows(m)),
+        [msgs], [False])
+    return ee.from_limbs(np.asarray(limbs))
 
 
 def _derive_selection_nonces(g, ee, seed: ElementModQ, bids: np.ndarray,

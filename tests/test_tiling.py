@@ -1,0 +1,49 @@
+"""Dispatch tiling (EGTPU_TILE) must be transparent: batches above the
+cap run as a loop of cap-shaped tiles, and results must be identical to
+the single-dispatch path.  The cap exists so an arbitrary-size election
+compiles a BOUNDED set of batch shapes instead of one multi-minute XLA
+compile per power-of-two (the r4 TPU bench died in exactly those
+compiles)."""
+
+import numpy as np
+import pytest
+
+from electionguard_tpu.core.group_jax import JaxGroupOps
+from electionguard_tpu.core import sha256_jax
+from electionguard_tpu.core.group import production_group
+
+
+@pytest.fixture
+def tiny_tile(monkeypatch):
+    monkeypatch.setenv("EGTPU_TILE", "16")
+
+
+def test_group_ops_tiled_match_host(tgroup, tiny_tile):
+    g = tgroup
+    ops = JaxGroupOps(g)
+    assert ops.tile == 16
+    rng = np.random.default_rng(4)
+    n = 45  # 2 full tiles + remainder
+    bases = [1 + int.from_bytes(rng.bytes(16), "big") % (g.p - 1)
+             for _ in range(n)]
+    exps = [int.from_bytes(rng.bytes(16), "big") % g.q for _ in range(n)]
+    assert ops.powmod_ints(bases, exps) == \
+        [pow(b, e, g.p) for b, e in zip(bases, exps)]
+    assert ops.g_pow_ints(exps) == [pow(g.g, e, g.p) for e in exps]
+    assert ops.mulmod_ints(bases, bases) == \
+        [b * b % g.p for b in bases]
+    ok = np.asarray(ops.is_valid_residue(ops.to_limbs_p(
+        [pow(g.g, e, g.p) for e in exps])))
+    assert ok.all()
+
+
+def test_sha_challenge_tiled_matches_untiled(monkeypatch):
+    g = production_group()
+    rng = np.random.default_rng(5)
+    n = 37
+    elem = rng.integers(0, 256, size=(n, g.spec.p_bytes), dtype=np.uint8)
+    monkeypatch.setenv("EGTPU_TILE", "4096")
+    want = np.asarray(sha256_jax.batch_challenge_p(g, b"ctx", [elem]))
+    monkeypatch.setenv("EGTPU_TILE", "16")
+    got = np.asarray(sha256_jax.batch_challenge_p(g, b"ctx", [elem]))
+    np.testing.assert_array_equal(got, want)
