@@ -110,6 +110,16 @@ def sha256_rows(msgs: jax.Array) -> jax.Array:
     return out.reshape(B, 32)
 
 
+_sha256_rows_j = jax.jit(sha256_rows)
+
+
+def sha256_rows_np(msgs: np.ndarray) -> np.ndarray:
+    """Host convenience: (B, L) uint8 -> (B, 32) uint8 digests, jitted
+    and dispatched through the shared bounded-shape tiling policy."""
+    from electionguard_tpu.core.group_jax import run_tiled
+    return np.asarray(run_tiled(_sha256_rows_j, [msgs], [False]))
+
+
 def _digest_mod_q(digest: jax.Array, q_limbs: jax.Array) -> jax.Array:
     """(B, 32) uint8 big-endian digests -> (B, 16) limbs of digest mod q
     (single conditional subtract; valid because 2^256 < 2q)."""

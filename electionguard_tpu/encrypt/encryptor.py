@@ -22,6 +22,7 @@ invalid list (the reference's invalidDir)."""
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import time
 from dataclasses import dataclass
@@ -395,18 +396,24 @@ class BatchEncryptor:
         out: list[EncryptedBallot] = []
         prev_code = code_seed
         timestamp = int(time.time())
+        # the ballot crypto hash is chain-independent, so the whole batch
+        # hashes in a few device dispatches; only the (cheap) code chain
+        # itself is sequential
+        from electionguard_tpu.ballot.code_batch import batch_crypto_hashes
+        structured = []
         for bi, b in enumerate(valid):
             contests = tuple(contests_by_ballot.get(bi, []))
             state = (BallotState.SPOILED if b.ballot_id in spoiled_ids
                      else BallotState.CAST)
-            partial = EncryptedBallot(
+            structured.append(EncryptedBallot(
                 b.ballot_id, b.ballot_style_id, self.init.manifest_hash,
-                prev_code, b"", timestamp, contests, state)
+                b"", b"", timestamp, contests, state))
+        hashes = batch_crypto_hashes(structured)
+        for i, partial in enumerate(structured):
             code = EncryptedBallot.make_code(prev_code, timestamp,
-                                             partial.crypto_hash())
-            out.append(EncryptedBallot(
-                b.ballot_id, b.ballot_style_id, self.init.manifest_hash,
-                prev_code, code, timestamp, contests, state))
+                                             hashes[i].tobytes())
+            out.append(dataclasses.replace(
+                partial, code_seed=prev_code, code=code))
             prev_code = code
         self._seen_ids |= batch_ids
         return out, invalid

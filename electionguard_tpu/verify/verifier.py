@@ -412,8 +412,10 @@ class Verifier:
         V7 product accumulation (one device prod-reduce per chunk) and
         cast/spoiled counting."""
         g = self.group
-        for b in ballots:
-            if not b.is_valid_code():
+        from electionguard_tpu.ballot.code_batch import batch_codes
+        codes = batch_codes(ballots)   # recomputed hash tree, batched
+        for i, b in enumerate(ballots):
+            if b.code != codes[i].tobytes():
                 res.record("V6.ballot_chaining", False,
                            f"{b.ballot_id} confirmation code invalid")
             if agg.prev_code is None:

@@ -1,0 +1,60 @@
+"""batch_crypto_hashes / batch_codes must be byte-identical to the
+per-ballot hash_digest tree (EncryptedBallot.crypto_hash /
+is_valid_code) — including heterogeneous ballots (different id widths,
+contest counts) and both the hashlib and device-SHA row paths."""
+
+import dataclasses
+
+import numpy as np
+
+from electionguard_tpu.ballot.code_batch import (batch_codes,
+                                                 batch_crypto_hashes)
+from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+from electionguard_tpu.publish.election_record import ElectionConfig
+from electionguard_tpu.workflow.e2e import sample_manifest
+
+
+def _encrypted(g, nballots, ncontests=2):
+    manifest = sample_manifest(ncontests, 2)
+    init = key_ceremony_exchange(
+        [KeyCeremonyTrustee(g, "g0", 1, 1)], g).make_election_initialized(
+        ElectionConfig(manifest, 1, 1), {})
+    ballots = list(RandomBallotProvider(manifest, nballots,
+                                        seed=6).ballots())
+    enc = BatchEncryptor(init, g)
+    out, invalid = enc.encrypt_ballots(ballots, seed=g.int_to_q(4))
+    assert not invalid
+    return out
+
+
+def test_batch_matches_per_ballot(tgroup):
+    encrypted = _encrypted(tgroup, 9)
+    # make widths heterogeneous: stretch one ballot's id
+    encrypted[3] = dataclasses.replace(
+        encrypted[3], ballot_id=encrypted[3].ballot_id + "-stretched-id")
+    hashes = batch_crypto_hashes(encrypted)
+    codes = batch_codes(encrypted)
+    for i, b in enumerate(encrypted):
+        assert hashes[i].tobytes() == b.crypto_hash()
+        assert codes[i].tobytes() == b.make_code(
+            b.code_seed, b.timestamp, b.crypto_hash())
+
+
+def test_encryptor_codes_still_valid_and_chained(tgroup):
+    encrypted = _encrypted(tgroup, 7)
+    assert all(b.is_valid_code() for b in encrypted)
+    for prev, cur in zip(encrypted, encrypted[1:]):
+        assert cur.code_seed == prev.code
+
+
+def test_device_row_path_matches_hashlib(tgroup, monkeypatch):
+    """Force the device SHA path (threshold 1) and compare."""
+    encrypted = _encrypted(tgroup, 6, ncontests=1)
+    want = batch_codes(encrypted)
+    import electionguard_tpu.ballot.code_batch as cb
+    monkeypatch.setattr(cb, "_DEVICE_MIN_ROWS", 1)
+    got = batch_codes(encrypted)
+    np.testing.assert_array_equal(got, want)
