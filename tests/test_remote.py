@@ -134,3 +134,44 @@ def test_finish_releases_trustee(tgroup, tmp_path):
     coord.shutdown(all_ok=True)
     th.join(timeout=20)
     assert waiter.get("ok") is True
+
+
+def test_first_rpc_waits_for_slow_trustee_construction(tgroup, monkeypatch,
+                                                       tmp_path):
+    """The coordinator's first sendPublicKeys can land before the trustee
+    finishes building its KeyCeremonyTrustee delegate (registration
+    response -> slow production-group polynomial build).  The rpc must
+    block on the readiness gate instead of dying on a None delegate —
+    the race the first production-group workflow run exposed."""
+    import time
+
+    import electionguard_tpu.remote.keyceremony_remote as kr
+
+    real_ctor = kr.KeyCeremonyTrustee
+
+    def slow_ctor(*args, **kwargs):
+        time.sleep(1.5)
+        return real_ctor(*args, **kwargs)
+
+    monkeypatch.setattr(kr, "KeyCeremonyTrustee", slow_ctor)
+    coord = KeyCeremonyCoordinator(tgroup, 1, 1, port=0)
+    server_box = {}
+
+    def build():
+        server_box["s"] = KeyCeremonyTrusteeServer(
+            tgroup, "slow-guardian", f"localhost:{coord.port}",
+            out_dir=str(tmp_path))
+
+    t = threading.Thread(target=build)
+    t.start()
+    try:
+        # fire the first rpc the moment registration lands, mid-sleep
+        assert coord.wait_for_registrations(timeout=10)
+        keys = coord.proxies[0].send_public_keys()
+        assert not isinstance(keys, Result), keys
+        assert keys.guardian_id == "slow-guardian"
+    finally:
+        t.join(timeout=10)
+        coord.shutdown(all_ok=True)
+        if "s" in server_box:
+            server_box["s"].shutdown()
