@@ -140,24 +140,38 @@ class DecryptionCoordinator:
     def _register_trustee(self, request, context):
         Resp = pb.msg("RegisterDecryptingTrusteeResponse")
         with self._lock:
-            if self._started:
-                return Resp(error="decryption already started")
+            gid = request.guardian_id
+            # fingerprint first: a cross-group trustee must get the
+            # negotiation error (+ constants), not a decode failure
             err = rpc_util.check_group_fingerprint(
                 self.group, request.group_fingerprint)
             if err:
                 return Resp(
                     error=err,
                     constants=rpc_util.group_constants_msg(self.group))
-            gid = request.guardian_id
-            for p in self.proxies:
-                if p.id == gid:
-                    return Resp(error=f"duplicate guardian id {gid}")
-            if len(self.proxies) >= self.navailable:
-                return Resp(error="enough guardians already registered")
             try:
                 pubkey = serialize.import_p(self.group, request.public_key)
             except ValueError as e:
                 return Resp(error=f"bad public key: {e}")
+            for p in self.proxies:
+                if p.id == gid:
+                    if (p.url == request.remote_url
+                            and p.x_coordinate == int(request.x_coordinate)
+                            and p.election_public_key == pubkey):
+                        # idempotent re-registration after a lost
+                        # response (retried by rpc_util.Stub.call);
+                        # checked BEFORE the started guard (the last
+                        # registration's lost response races the start)
+                        # and only for a FULL identity match — a trustee
+                        # relaunched with a different state file must
+                        # not silently keep the stale proxy
+                        return Resp(constants=rpc_util.group_constants_msg(
+                            self.group))
+                    return Resp(error=f"duplicate guardian id {gid}")
+            if self._started:
+                return Resp(error="decryption already started")
+            if len(self.proxies) >= self.navailable:
+                return Resp(error="enough guardians already registered")
             proxy = RemoteDecryptingTrusteeProxy(
                 self.group, gid, int(request.x_coordinate), pubkey,
                 request.remote_url)

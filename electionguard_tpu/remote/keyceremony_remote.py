@@ -184,6 +184,23 @@ class KeyCeremonyCoordinator:
     def _register_trustee(self, request, context):
         Resp = pb.msg("RegisterKeyCeremonyTrusteeResponse")
         with self._lock:
+            gid = request.guardian_id
+            for p in self.proxies:
+                if p.id == gid:
+                    if p.url == request.remote_url:
+                        # idempotent re-registration: the response to a
+                        # processed registration can be lost to a
+                        # transport drop and retried (rpc_util.Stub.call)
+                        # — hand back the coordinate already assigned.
+                        # Checked BEFORE the started guard: the lost
+                        # response of the LAST registration races the
+                        # ceremony start.
+                        return Resp(guardian_id=gid,
+                                    x_coordinate=p.x_coordinate,
+                                    quorum=self.quorum,
+                                    constants=rpc_util.group_constants_msg(
+                                        self.group))
+                    return Resp(error=f"duplicate guardian id {gid}")
             if self._started_ceremony:
                 return Resp(error="ceremony already started")
             err = rpc_util.check_group_fingerprint(
@@ -192,10 +209,6 @@ class KeyCeremonyCoordinator:
                 return Resp(
                     error=err,
                     constants=rpc_util.group_constants_msg(self.group))
-            gid = request.guardian_id
-            for p in self.proxies:
-                if p.id == gid:
-                    return Resp(error=f"duplicate guardian id {gid}")
             if len(self.proxies) >= self.n:
                 return Resp(error="all guardians already registered")
             self._next_coordinate += 1

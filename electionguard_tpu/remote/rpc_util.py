@@ -11,7 +11,9 @@ RemoteKeyCeremonyProxy.java:27).
 
 from __future__ import annotations
 
+import os
 import socket
+import time
 from typing import Callable
 
 import grpc
@@ -21,6 +23,21 @@ from electionguard_tpu.publish import pb
 
 MAX_TRUSTEE_MESSAGE = 51 * 1000 * 1000   # key exchange / batch decrypt plane
 MAX_REGISTRATION_MESSAGE = 2000          # registration plane
+
+#: attempts per rpc on transient transport failure (UNAVAILABLE) — the
+#: reference retries nothing (SURVEY.md §5.3); we retry the one status
+#: that means "peer not reachable right now" so a guardian restart or a
+#: slow dial-back doesn't kill a whole ceremony.  EGTPU_RPC_RETRIES=1
+#: restores the reference's posture.
+try:
+    RPC_ATTEMPTS = max(1, int(os.environ.get("EGTPU_RPC_RETRIES", "3")))
+except ValueError:
+    import logging
+    logging.getLogger("rpc_util").warning(
+        "EGTPU_RPC_RETRIES=%r is not an integer; using 3",
+        os.environ.get("EGTPU_RPC_RETRIES"))
+    RPC_ATTEMPTS = 3
+_RPC_RETRY_WAIT = 0.5
 
 
 def _method_classes(method_desc):
@@ -60,7 +77,33 @@ class Stub:
                 response_deserializer=resp_cls.FromString)
 
     def call(self, method: str, request, timeout: float = 60.0):
-        return self._methods[method](request, timeout=timeout)
+        """One rpc with a TOTAL deadline of ``timeout`` seconds.
+
+        UNAVAILABLE (transport-level) is retried with backoff while
+        budget remains, up to RPC_ATTEMPTS.  Retries pass
+        ``wait_for_ready`` so the channel actually re-dials a peer that
+        is coming (back) up instead of failing fast inside gRPC's own
+        reconnect backoff window.  Safe because every service method is
+        idempotent: the batch/exchange rpcs are pure functions of the
+        request (plus fresh randomness), and both coordinators treat
+        re-registration from the same (id, url) as idempotent.
+        """
+        deadline = time.monotonic() + timeout
+        attempt = 0
+        while True:
+            remaining = deadline - time.monotonic()
+            try:
+                return self._methods[method](
+                    request, timeout=max(0.001, remaining),
+                    wait_for_ready=attempt > 0)
+            except grpc.RpcError as e:
+                attempt += 1
+                wait = _RPC_RETRY_WAIT * attempt
+                if (e.code() != grpc.StatusCode.UNAVAILABLE
+                        or attempt >= RPC_ATTEMPTS
+                        or deadline - time.monotonic() <= wait):
+                    raise
+                time.sleep(wait)
 
 
 def group_constants_msg(group):

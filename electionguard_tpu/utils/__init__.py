@@ -7,11 +7,10 @@ import os
 
 
 def batch_bucket(b: int) -> int:
-    """Shared batch-shape bucketing policy: pad every dispatch batch up to
-    16 or the next power of two, so the whole workflow compiles a handful
-    of shapes.  The group-op plane (core/group_jax.py) and the hash plane
-    (core/sha256_jax.py) must agree on this or they compile mismatched
-    batch shapes for the same workload."""
+    """Power-of-two batch rounding (16 minimum) — the small-batch half of
+    the dispatch policy; every plane reaches it through
+    ``core.group_jax.dispatch_bucket``/``run_tiled``, which cap large
+    batches at the fixed tile so the compiled shape set stays bounded."""
     return 16 if b <= 16 else 1 << (b - 1).bit_length()
 
 

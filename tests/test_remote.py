@@ -65,8 +65,10 @@ def test_remote_key_ceremony(remote_ceremony, tgroup):
 
 
 def test_duplicate_registration_rejected(remote_ceremony, tgroup):
+    # a DIFFERENT server claiming an existing guardian id (its own fresh
+    # port, so not an idempotent same-(id,url) replay) must be rejected
     coord = remote_ceremony["coord"]
-    with pytest.raises(RuntimeError, match="already"):
+    with pytest.raises(RuntimeError, match="duplicate guardian id"):
         KeyCeremonyTrusteeServer(tgroup, "guardian-0",
                                  f"localhost:{coord.port}")
 
@@ -175,3 +177,64 @@ def test_first_rpc_waits_for_slow_trustee_construction(tgroup, monkeypatch,
         coord.shutdown(all_ok=True)
         if "s" in server_box:
             server_box["s"].shutdown()
+
+
+def test_rpc_retries_transient_unavailable(tgroup):
+    """The rpc plane retries UNAVAILABLE (peer not up yet) with backoff —
+    beyond the reference's no-retry posture (SURVEY.md §5.3): a
+    coordinator that comes up between attempts is reached on retry."""
+    import time
+
+    import grpc
+
+    from electionguard_tpu.publish import pb
+    from electionguard_tpu.remote import rpc_util
+
+    port = rpc_util.find_free_port()
+    channel = rpc_util.make_channel(f"localhost:{port}",
+                                    rpc_util.MAX_REGISTRATION_MESSAGE)
+    stub = rpc_util.Stub(channel, "RemoteKeyCeremonyService")
+    req = pb.msg("RegisterKeyCeremonyTrusteeRequest")(
+        guardian_id="late", remote_url="localhost:1")
+
+    # nothing listening: attempts exhaust within the TOTAL deadline
+    t0 = time.time()
+    with pytest.raises(grpc.RpcError):
+        stub.call("registerTrustee", req, timeout=4)
+    elapsed = time.time() - t0
+    assert 0.5 <= elapsed <= 10  # backoff happened; total deadline held
+
+    # coordinator appears mid-retry: the SAME call now succeeds (the
+    # wait_for_ready retry re-dials instead of failing fast)
+    box = {}
+    timer = threading.Timer(
+        0.7, lambda: box.update(
+            c=KeyCeremonyCoordinator(tgroup, 1, 1, port=port)))
+    timer.start()
+    try:
+        resp = stub.call("registerTrustee", req, timeout=8)
+        assert resp.x_coordinate == 1 and not resp.error
+        # a retried registration whose response was lost is idempotent:
+        # same (id, url) re-registration returns the SAME coordinate
+        again = stub.call("registerTrustee", req, timeout=8)
+        assert again.x_coordinate == 1 and not again.error
+        # ... but a different trustee claiming the same id is rejected
+        imposter = pb.msg("RegisterKeyCeremonyTrusteeRequest")(
+            guardian_id="late", remote_url="localhost:2")
+        rej = stub.call("registerTrustee", imposter, timeout=8)
+        assert "duplicate guardian id" in rej.error
+        # the lost response of the LAST registration races the ceremony
+        # start: the idempotent replay must be honored even after start
+        with box["c"]._lock:
+            box["c"]._started_ceremony = True
+        late_replay = stub.call("registerTrustee", req, timeout=8)
+        assert late_replay.x_coordinate == 1 and not late_replay.error
+        fresh = pb.msg("RegisterKeyCeremonyTrusteeRequest")(
+            guardian_id="too-late", remote_url="localhost:3")
+        closed = stub.call("registerTrustee", fresh, timeout=8)
+        assert "already started" in closed.error
+    finally:
+        timer.join()
+        if "c" in box:
+            box["c"].shutdown(all_ok=True)
+        channel.close()
