@@ -16,10 +16,11 @@ from __future__ import annotations
 import argparse
 import sys
 
-from electionguard_tpu.ballot.ciphertext import BallotState
 from electionguard_tpu.cli.common import (Stopwatch, add_group_flag,
                                           resolve_group, setup_logging)
-from electionguard_tpu.decrypt.decryption import Decryption, DecryptionError
+from electionguard_tpu.decrypt.decryption import (Decryption,
+                                                  DecryptionError,
+                                                  stream_spoiled_tallies)
 from electionguard_tpu.publish.election_record import DecryptionResult
 from electionguard_tpu.publish.publisher import Consumer, Publisher
 from electionguard_tpu.remote.decrypting_remote import DecryptionCoordinator
@@ -78,24 +79,10 @@ def main(argv=None) -> int:
         publisher.write_decryption_result(result)
 
         if args.decrypt_spoiled:
-            # streamed + batched: spoiled ballots are collected into
-            # chunks, each chunk decrypted with ONE rpc leg per trustee
-            # per protocol, and its tallies written (and dropped) before
-            # the next chunk loads — O(chunks) round trips, O(chunk)
-            # memory (reference shape: one decryptBallot rpc per trustee
-            # per ballot, RunRemoteDecryptor.java:264-269)
-            def spoiled_tallies():
-                chunk = []
-                for b in consumer.iterate_encrypted_ballots():
-                    if b.state != BallotState.SPOILED:
-                        continue
-                    chunk.append(b)
-                    if len(chunk) >= args.chunk_size:
-                        yield from decryption.decrypt_ballots(chunk)
-                        chunk.clear()
-                if chunk:
-                    yield from decryption.decrypt_ballots(chunk)
-            n_sp = publisher.write_spoiled_ballot_tallies(spoiled_tallies())
+            n_sp = publisher.write_spoiled_ballot_tallies(
+                stream_spoiled_tallies(
+                    consumer.iterate_encrypted_ballots(), decryption,
+                    args.chunk_size))
             log.info("decrypted %d spoiled ballots", n_sp)
 
         log.info("published DecryptionResult to %s (%s)",

@@ -26,7 +26,7 @@ from typing import Optional, Sequence, Union
 
 import numpy as np
 
-from electionguard_tpu.ballot.ciphertext import EncryptedBallot
+from electionguard_tpu.ballot.ciphertext import BallotState, EncryptedBallot
 from electionguard_tpu.ballot.tally import (EncryptedTally, PartialDecryption,
                                             PlaintextTally,
                                             PlaintextTallyContest,
@@ -288,3 +288,23 @@ class Decryption:
         sets chunk-by-chunk to keep memory O(chunk)."""
         return self._decrypt_groups(
             [(b.ballot_id, b.contests) for b in ballots])
+
+
+def stream_spoiled_tallies(ballots, decryption: Decryption,
+                           chunk_size: int = 512):
+    """Lazily decrypt the SPOILED ballots of a (possibly huge) ballot
+    stream: collect chunk_size spoiled ballots, decrypt them with one
+    batched rpc leg per trustee per protocol, yield their tallies, drop
+    the chunk — O(chunks) round trips, O(chunk) memory (the reference
+    decrypts one rpc per trustee per ballot,
+    RunRemoteDecryptor.java:264-269)."""
+    chunk: list[EncryptedBallot] = []
+    for b in ballots:
+        if b.state != BallotState.SPOILED:
+            continue
+        chunk.append(b)
+        if len(chunk) >= chunk_size:
+            yield from decryption.decrypt_ballots(chunk)
+            chunk = []
+    if chunk:
+        yield from decryption.decrypt_ballots(chunk)

@@ -20,6 +20,7 @@ Mirrors the reference's four key-ceremony classes (SURVEY.md §2 rows 1-4):
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Optional, Union
@@ -53,6 +54,7 @@ class RemoteTrusteeProxy(KeyCeremonyTrusteeIF):
         self._id = guardian_id
         self._x = x_coordinate
         self.url = url
+        self.reg_nonce = b""   # set by the coordinator at registration
         self._channel = rpc_util.make_channel(url)
         self._stub = rpc_util.Stub(self._channel,
                                    "RemoteKeyCeremonyTrusteeService")
@@ -185,16 +187,29 @@ class KeyCeremonyCoordinator:
         Resp = pb.msg("RegisterKeyCeremonyTrusteeResponse")
         with self._lock:
             gid = request.guardian_id
+            # fingerprint first: a cross-group trustee must get the
+            # negotiation error (+ constants), never a duplicate/replay
+            # answer (same ordering as the decryption coordinator)
+            err = rpc_util.check_group_fingerprint(
+                self.group, request.group_fingerprint)
+            if err:
+                return Resp(
+                    error=err,
+                    constants=rpc_util.group_constants_msg(self.group))
             for p in self.proxies:
                 if p.id == gid:
-                    if p.url == request.remote_url:
+                    if (p.url == request.remote_url
+                            and p.reg_nonce == bytes(
+                                request.registration_nonce)):
                         # idempotent re-registration: the response to a
                         # processed registration can be lost to a
                         # transport drop and retried (rpc_util.Stub.call)
                         # — hand back the coordinate already assigned.
                         # Checked BEFORE the started guard: the lost
                         # response of the LAST registration races the
-                        # ceremony start.
+                        # ceremony start.  The per-process nonce keeps a
+                        # RELAUNCHED trustee (fresh secret polynomial)
+                        # from silently keeping its stale registration.
                         return Resp(guardian_id=gid,
                                     x_coordinate=p.x_coordinate,
                                     quorum=self.quorum,
@@ -203,17 +218,12 @@ class KeyCeremonyCoordinator:
                     return Resp(error=f"duplicate guardian id {gid}")
             if self._started_ceremony:
                 return Resp(error="ceremony already started")
-            err = rpc_util.check_group_fingerprint(
-                self.group, request.group_fingerprint)
-            if err:
-                return Resp(
-                    error=err,
-                    constants=rpc_util.group_constants_msg(self.group))
             if len(self.proxies) >= self.n:
                 return Resp(error="all guardians already registered")
             self._next_coordinate += 1
             x = self._next_coordinate
             proxy = RemoteTrusteeProxy(self.group, gid, x, request.remote_url)
+            proxy.reg_nonce = bytes(request.registration_nonce)
             self.proxies.append(proxy)
             log.info("registered trustee %s x=%d url=%s", gid, x,
                      request.remote_url)
@@ -265,13 +275,15 @@ class RemoteKeyCeremonyProxy:
         self._stub = rpc_util.Stub(self._channel, "RemoteKeyCeremonyService")
 
     def register_trustee(self, guardian_id: str, remote_url: str,
-                         group: Optional[GroupContext] = None):
+                         group: Optional[GroupContext] = None,
+                         nonce: bytes = b""):
         return self._stub.call("registerTrustee",
                                pb.msg("RegisterKeyCeremonyTrusteeRequest")(
                                    guardian_id=guardian_id,
                                    remote_url=remote_url,
                                    group_fingerprint=(group.fingerprint()
-                                                      if group else b"")))
+                                                      if group else b""),
+                                   registration_nonce=nonce))
 
     def close(self):
         self._channel.close()
@@ -305,10 +317,14 @@ class KeyCeremonyTrusteeServer:
              "finish": self._finish}),))
         self.server.start()
 
-        # register with the coordinator; it assigns our x-coordinate
+        # register with the coordinator; it assigns our x-coordinate.
+        # The nonce identifies THIS process: a transport-level retry of a
+        # lost response replays idempotently, a relaunch does not.
+        self._reg_nonce = os.urandom(16)
         reg = RemoteKeyCeremonyProxy(coordinator_url)
         try:
-            resp = reg.register_trustee(guardian_id, self.url, group)
+            resp = reg.register_trustee(guardian_id, self.url, group,
+                                        nonce=self._reg_nonce)
         finally:
             reg.close()
         err = resp.error or rpc_util.check_group_constants(

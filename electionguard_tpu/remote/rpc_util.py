@@ -38,6 +38,7 @@ except ValueError:
         os.environ.get("EGTPU_RPC_RETRIES"))
     RPC_ATTEMPTS = 3
 _RPC_RETRY_WAIT = 0.5
+_RPC_CONNECT_WINDOW = 5.0   # max seconds a wait_for_ready retry may block
 
 
 def _method_classes(method_desc):
@@ -83,24 +84,35 @@ class Stub:
         budget remains, up to RPC_ATTEMPTS.  Retries pass
         ``wait_for_ready`` so the channel actually re-dials a peer that
         is coming (back) up instead of failing fast inside gRPC's own
-        reconnect backoff window.  Safe because every service method is
-        idempotent: the batch/exchange rpcs are pure functions of the
-        request (plus fresh randomness), and both coordinators treat
-        re-registration from the same (id, url) as idempotent.
+        reconnect backoff window — but each such wait is BOUNDED
+        (``_RPC_CONNECT_WINDOW``) so a permanently-dead peer fails in
+        seconds, not the whole deadline.  Safe because every service
+        method is idempotent: the batch/exchange rpcs are pure functions
+        of the request (plus fresh randomness), and both coordinators
+        treat a same-identity re-registration as idempotent.
         """
         deadline = time.monotonic() + timeout
         attempt = 0
         while True:
             remaining = deadline - time.monotonic()
+            wfr = attempt > 0
+            per_try = max(0.001, min(remaining, _RPC_CONNECT_WINDOW)
+                          if wfr else remaining)
             try:
                 return self._methods[method](
-                    request, timeout=max(0.001, remaining),
-                    wait_for_ready=attempt > 0)
+                    request, timeout=per_try, wait_for_ready=wfr)
             except grpc.RpcError as e:
                 attempt += 1
+                code = e.code()
+                # a DEADLINE on a BOUNDED connect-wait means "still not
+                # reachable" — transient like UNAVAILABLE; a deadline on
+                # a full-budget attempt is a real timeout
+                transient = (code == grpc.StatusCode.UNAVAILABLE
+                             or (wfr and per_try < remaining
+                                 and code ==
+                                 grpc.StatusCode.DEADLINE_EXCEEDED))
                 wait = _RPC_RETRY_WAIT * attempt
-                if (e.code() != grpc.StatusCode.UNAVAILABLE
-                        or attempt >= RPC_ATTEMPTS
+                if (not transient or attempt >= RPC_ATTEMPTS
                         or deadline - time.monotonic() <= wait):
                     raise
                 time.sleep(wait)

@@ -223,6 +223,13 @@ def test_rpc_retries_transient_unavailable(tgroup):
             guardian_id="late", remote_url="localhost:2")
         rej = stub.call("registerTrustee", imposter, timeout=8)
         assert "duplicate guardian id" in rej.error
+        # ... and so is a RELAUNCHED process (same id+url, new nonce —
+        # it holds a fresh secret polynomial, not the registered one)
+        relaunch = pb.msg("RegisterKeyCeremonyTrusteeRequest")(
+            guardian_id="late", remote_url="localhost:1",
+            registration_nonce=b"fresh-process")
+        rej2 = stub.call("registerTrustee", relaunch, timeout=8)
+        assert "duplicate guardian id" in rej2.error
         # the lost response of the LAST registration races the ceremony
         # start: the idempotent replay must be honored even after start
         with box["c"]._lock:

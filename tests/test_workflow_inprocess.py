@@ -339,6 +339,51 @@ def test_verifier_catches_dropped_share(election):
     assert not res.checks["V8.direct_proofs"]
 
 
+def test_stream_spoiled_tallies_chunks(election):
+    """stream_spoiled_tallies must filter SPOILED ballots, decrypt in
+    chunk-sized batches (ceil(n/chunk) rpc legs per trustee per
+    protocol), and yield one tally per spoiled ballot in order."""
+    import dataclasses
+
+    from electionguard_tpu.decrypt.decryption import stream_spoiled_tallies
+    g, init = election["group"], election["init"]
+    dec_trustees = [DecryptingTrustee.from_state(
+        g, t.decrypting_trustee_state()) for t in election["trustees"]]
+
+    calls = {"n": 0}
+
+    class CountingTrustee:
+        def __init__(self, inner):
+            self.inner = inner
+
+        id = property(lambda self: self.inner.id)
+        x_coordinate = property(lambda self: self.inner.x_coordinate)
+        election_public_key = property(
+            lambda self: self.inner.election_public_key)
+
+        def direct_decrypt(self, texts, h):
+            calls["n"] += 1
+            return self.inner.direct_decrypt(texts, h)
+
+        def compensated_decrypt(self, m, texts, h):
+            calls["n"] += 1
+            return self.inner.compensated_decrypt(m, texts, h)
+
+    decryption = Decryption(
+        g, init, [CountingTrustee(t) for t in dec_trustees[:2]],
+        [dec_trustees[2].id], DLog(g, max_exponent=100))
+    ballots = [dataclasses.replace(b, state=BallotState.SPOILED)
+               if i % 2 == 0 else b
+               for i, b in enumerate(election["encrypted"][:10])]
+    tallies = list(stream_spoiled_tallies(iter(ballots), decryption,
+                                          chunk_size=2))
+    spoiled_ids = [b.ballot_id for b in ballots
+                   if b.state == BallotState.SPOILED]
+    assert [t.tally_id for t in tallies] == spoiled_ids  # 5, in order
+    # 5 spoiled / chunk 2 = 3 chunks x 2 trustees x (direct + comp)
+    assert calls["n"] == 3 * 2 * 2
+
+
 def test_spoiled_tally_forgery_detected(election):
     """A fabricated spoiled-ballot decryption must fail V13."""
     import dataclasses
