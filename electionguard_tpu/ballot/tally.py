@@ -8,10 +8,10 @@ decrypt — SURVEY.md §3.2).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
-from electionguard_tpu.core.group import ElementModP, ElementModQ
+from electionguard_tpu.core.group import ElementModP
 from electionguard_tpu.crypto.chaum_pedersen import GenericChaumPedersenProof
 from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
 

@@ -8,7 +8,7 @@ reference: src/test/java/electionguard/workflow/RunRemoteWorkflowTest.java:140
 
 from __future__ import annotations
 
-from electionguard_tpu.core.group import ElementModQ, GroupContext
+from electionguard_tpu.core.group import ElementModQ
 from electionguard_tpu.core.hash import hash_elems
 
 

@@ -20,7 +20,6 @@ dominant verification workload the TPU plane batches (SURVEY.md §3.4 phase 5
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
 
 from electionguard_tpu.core.group import ElementModP, ElementModQ, GroupContext
 from electionguard_tpu.core.hash import hash_elems

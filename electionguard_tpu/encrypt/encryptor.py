@@ -33,7 +33,6 @@ import numpy as np
 from electionguard_tpu.ballot.ciphertext import (BallotState, EncryptedBallot,
                                                  EncryptedContest,
                                                  EncryptedSelection)
-from electionguard_tpu.ballot.manifest import Manifest
 from electionguard_tpu.ballot.plaintext import PlaintextBallot
 from electionguard_tpu.core.group import ElementModP, ElementModQ
 from electionguard_tpu.core.group_jax import (JaxExponentOps, JaxGroupOps,

@@ -21,9 +21,9 @@ from typing import Optional, Sequence, Union
 from electionguard_tpu.core.group import ElementModP, GroupContext
 from electionguard_tpu.core.hash import hash_elems
 from electionguard_tpu.keyceremony.interface import (KeyCeremonyTrusteeIF,
-                                                     KeyShareChallengeResponse,
-                                                     PublicKeys, Result,
-                                                     SecretKeyShare)
+    PublicKeys,
+    Result,
+    SecretKeyShare)
 from electionguard_tpu.keyceremony.trustee import commitment_product
 from electionguard_tpu.publish.election_record import (ElectionConfig,
                                                        ElectionInitialized,

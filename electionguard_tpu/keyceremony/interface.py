@@ -15,7 +15,7 @@ reference's in-band error strings (src/main/proto/common_rpc.proto:10-12).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Protocol, Union
+from typing import Protocol, Union
 
 from electionguard_tpu.core.group import ElementModP, ElementModQ
 from electionguard_tpu.crypto.hashed_elgamal import HashedElGamalCiphertext

@@ -25,9 +25,8 @@ from typing import Optional, Union
 
 from electionguard_tpu.core.group import (ElementModP, ElementModQ,
                                           GroupContext)
-from electionguard_tpu.crypto.hashed_elgamal import (HashedElGamalCiphertext,
-                                                     hashed_elgamal_encrypt)
-from electionguard_tpu.crypto.schnorr import SchnorrProof, make_schnorr_proof
+from electionguard_tpu.crypto.hashed_elgamal import hashed_elgamal_encrypt
+from electionguard_tpu.crypto.schnorr import make_schnorr_proof
 from electionguard_tpu.keyceremony.interface import (KeyCeremonyTrusteeIF,
                                                      KeyShareChallengeResponse,
                                                      PublicKeys, Result,

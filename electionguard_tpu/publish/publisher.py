@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import os
 import struct
-from typing import Iterator, Optional
+from typing import Iterator
 
 from electionguard_tpu.ballot.ciphertext import EncryptedBallot
 from electionguard_tpu.ballot.plaintext import PlaintextBallot

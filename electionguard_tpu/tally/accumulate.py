@@ -11,11 +11,11 @@ multi-chip mesh this axis is sharded and the tree rides ICI
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional
 
 import numpy as np
 
-from electionguard_tpu.ballot.ciphertext import BallotState, EncryptedBallot
+from electionguard_tpu.ballot.ciphertext import BallotState
 from electionguard_tpu.ballot.tally import (EncryptedTally,
                                             EncryptedTallyContest,
                                             EncryptedTallySelection)

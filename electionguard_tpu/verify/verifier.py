@@ -32,7 +32,7 @@ from typing import Optional
 
 import numpy as np
 
-from electionguard_tpu.ballot.ciphertext import BallotState, EncryptedBallot
+from electionguard_tpu.ballot.ciphertext import BallotState
 from electionguard_tpu.ballot.manifest import validate_manifest
 from electionguard_tpu.core.group import ElementModP, GroupContext
 from electionguard_tpu.core.group_jax import (jax_exp_ops, jax_ops,
