@@ -282,46 +282,62 @@ class Verifier:
         c1_l = ee.to_limbs(c1s)
         v1_l = ee.to_limbs(v1s)
 
-        # subgroup membership (V4 part 1)
-        both = np.concatenate([A_l, B_l])
-        ok_residue = np.asarray(eo.is_valid_residue(both))
-        for i in np.nonzero(~ok_residue)[0]:
-            res.record("V4.selection_proofs", False,
-                       f"ciphertext element {sel_refs[int(i) % S]} not in "
-                       f"subgroup")
-
-        # recompute commitments (V4 part 2):
-        # a0 = g^v0 α^c0, b0 = K^v0 β^c0, a1 = g^v1 α^c1, b1 = K^v1 (β/g)^c1
-        ginv = g.GINV_MOD_P.value
-        ginv_l = eo.to_limbs_p([ginv])[0]
-        Bg_l = np.asarray(eo.mulmod(
-            B_l, np.broadcast_to(ginv_l, B_l.shape)))
-        var_bases = np.concatenate([A_l, B_l, A_l, Bg_l])
-        var_exps = np.concatenate([c0_l, c0_l, c1_l, c1_l])
-        var_pows = np.asarray(eo.powmod(var_bases, var_exps))
-        g_pows = np.asarray(eo.g_pow(np.concatenate([v0_l, v1_l])))
+        # range check on host (the ints are already in hand); everything
+        # element-sized stays on device
+        in_range = np.fromiter(
+            ((0 < a < g.p) and (0 < b < g.p)
+             for a, b in zip(alphas, betas)), dtype=bool, count=S)
         K = self.init.joint_public_key.value
-        k_pows = np.asarray(eo.base_pow(K, np.concatenate([v0_l, v1_l])))
-        a0 = np.asarray(eo.mulmod(g_pows[:S], var_pows[:S]))
-        b0 = np.asarray(eo.mulmod(k_pows[:S], var_pows[S:2 * S]))
-        a1 = np.asarray(eo.mulmod(g_pows[S:], var_pows[2 * S:3 * S]))
-        b1 = np.asarray(eo.mulmod(k_pows[S:], var_pows[3 * S:]))
-
-        alpha_b = limbs_to_bytes_be(A_l)
-        beta_b = limbs_to_bytes_be(B_l)
-        a0b, b0b = limbs_to_bytes_be(a0), limbs_to_bytes_be(b0)
-        a1b, b1b = limbs_to_bytes_be(a1), limbs_to_bytes_be(b1)
         q = g.q
         if sha256_jax.supports(g):
-            # device Fiat–Shamir: challenge c = H(Q̄, α, β, a0, b0, a1, b1)
-            # hashed + reduced mod q on-device, compared limb-wise to c0+c1
-            c_limbs = np.asarray(sha256_jax.batch_challenge_p(
-                g, _encode(qbar), [alpha_b, beta_b, a0b, b0b, a1b, b1b]))
-            sum_c = np.asarray(ee.add(c0_l, c1_l))
-            for i in np.nonzero(~(sum_c == c_limbs).all(axis=1))[0]:
+            # fused device program (verify/fused.py): shared-base
+            # multi-exp {q, c0, c1} per ciphertext element, commitment
+            # recompute, device Fiat–Shamir, challenge compare — one
+            # (S, 2) boolean array comes back, nothing element-sized.
+            ok2 = self._fused().v4_selections(
+                A_l, B_l, c0_l, v0_l, c1_l, v1_l,
+                eo.fixed_table(K), _encode(qbar))
+            for i in np.nonzero(~(ok2[:, 0] & in_range))[0]:
+                res.record("V4.selection_proofs", False,
+                           f"ciphertext element {sel_refs[int(i)]} not in "
+                           f"subgroup")
+            for i in np.nonzero(~ok2[:, 1])[0]:
                 res.record("V4.selection_proofs", False,
                            f"disjunctive proof fails for {sel_refs[int(i)]}")
         else:
+            # unfused fallback (tiny group / host hash): shared-base
+            # multi-exp still halves the ladder work, hash runs on host
+            q_row = ee.to_limbs([g.q])[0]
+            q_rep = np.broadcast_to(q_row, (S, q_row.shape[0]))
+            pows_a = np.asarray(eo.multi_powmod(
+                A_l, np.stack([q_rep, np.asarray(c0_l),
+                               np.asarray(c1_l)], axis=1)))
+            pows_b = np.asarray(eo.multi_powmod(
+                B_l, np.stack([q_rep, np.asarray(c0_l),
+                               np.asarray(c1_l)], axis=1)))
+            one_l = np.zeros_like(pows_a[:, 0])
+            one_l[:, 0] = 1
+            in_subgroup = ((pows_a[:, 0] == one_l).all(axis=1)
+                           & (pows_b[:, 0] == one_l).all(axis=1))
+            for i in np.nonzero(~(in_subgroup & in_range))[0]:
+                res.record("V4.selection_proofs", False,
+                           f"ciphertext element {sel_refs[int(i)]} not in "
+                           f"subgroup")
+
+            ginv = g.GINV_MOD_P.value
+            g_pows = np.asarray(eo.g_pow(np.concatenate([v0_l, v1_l])))
+            k_pows = np.asarray(eo.base_pow(K, np.concatenate([v0_l, v1_l])))
+            ginv_c1 = np.asarray(eo.base_pow(ginv, c1_l))
+            a0 = np.asarray(eo.mulmod(g_pows[:S], pows_a[:, 1]))
+            b0 = np.asarray(eo.mulmod(k_pows[:S], pows_b[:, 1]))
+            a1 = np.asarray(eo.mulmod(g_pows[S:], pows_a[:, 2]))
+            b1 = np.asarray(eo.mulmod(
+                k_pows[S:], np.asarray(eo.mulmod(pows_b[:, 2], ginv_c1))))
+
+            alpha_b = limbs_to_bytes_be(A_l)
+            beta_b = limbs_to_bytes_be(B_l)
+            a0b, b0b = limbs_to_bytes_be(a0), limbs_to_bytes_be(b0)
+            a1b, b1b = limbs_to_bytes_be(a1), limbs_to_bytes_be(b1)
             for i in range(S):
                 c = hash_elems(
                     g, qbar,

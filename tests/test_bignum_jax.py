@@ -137,3 +137,34 @@ def test_production_g_pow_and_prod():
     for row in rows:
         want = [w * r % g.p for w, r in zip(want, row)]
     assert ops.prod_ints(rows) == want
+
+
+def test_multi_powmod_tiny():
+    """Shared-base bucket multi-exp == k independent host pows, incl.
+    edge exponents (0, 1, q-1) and base 1 / p-1."""
+    g = tiny_group()
+    ops = jax_ops(g)
+    B, k = 6, 3
+    bases = [1, g.p - 1, g.g] + [rng.randrange(1, g.p) for _ in range(B - 3)]
+    exps = [[0, 1, g.q - 1]] + \
+        [[rng.randrange(g.q) for _ in range(k)] for _ in range(B - 1)]
+    base_l = ops.to_limbs_p(bases)
+    exps_l = np.stack([ops.to_limbs_q(e) for e in exps])
+    out = np.asarray(ops.multi_powmod(base_l, exps_l))
+    got = [ops.from_limbs(out[i]) for i in range(B)]
+    want = [[pow(bases[i], e, g.p) for e in exps[i]] for i in range(B)]
+    assert got == want
+
+
+def test_multi_powmod_production():
+    g = production_group()
+    ops = jax_ops(g)
+    B, k = 3, 3
+    bases = [rng.randrange(1, g.p) for _ in range(B)]
+    exps = [[rng.randrange(g.q) for _ in range(k)] for _ in range(B)]
+    base_l = ops.to_limbs_p(bases)
+    exps_l = np.stack([ops.to_limbs_q(e) for e in exps])
+    out = np.asarray(ops.multi_powmod(base_l, exps_l))
+    got = [ops.from_limbs(out[i]) for i in range(B)]
+    assert got == [[pow(bases[i], e, g.p) for e in exps[i]]
+                   for i in range(B)]

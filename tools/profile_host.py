@@ -1,0 +1,80 @@
+"""cProfile the full Verifier pass (and optionally encrypt) at a small
+ballot count to expose host-side hotspots: limb codecs, Python loops,
+hash glue, d2h transfers.  Run after bench.py so compiles are warm.
+
+Usage: python tools/profile_host.py [nballots] [encrypt|verify|both]
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import os
+import pstats
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    nballots = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    what = sys.argv[2] if len(sys.argv) > 2 else "both"
+    from electionguard_tpu.utils import enable_compile_cache
+    enable_compile_cache()
+
+    from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+    from electionguard_tpu.core.group import production_group
+    from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+    from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+    from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+    from electionguard_tpu.publish.election_record import (ElectionConfig,
+                                                           ElectionRecord)
+    from electionguard_tpu.tally.accumulate import accumulate_ballots
+    from electionguard_tpu.verify.verifier import Verifier
+    from electionguard_tpu.workflow.e2e import sample_manifest
+
+    g = production_group()
+    manifest = sample_manifest(ncontests=1, nselections=2)
+    trustees = [KeyCeremonyTrustee(g, "guardian-0", 1, 1)]
+    init = key_ceremony_exchange(trustees, g).make_election_initialized(
+        ElectionConfig(manifest, 1, 1), {"created_by": "profile"})
+    ballots = list(RandomBallotProvider(manifest, nballots, seed=1).ballots())
+
+    def report(tag, pr, dt):
+        s = io.StringIO()
+        ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+        ps.print_stats(25)
+        print(f"==== {tag}: {dt:.2f}s for {nballots} ballots "
+              f"({nballots / dt:.1f}/s) ====")
+        print("\n".join(s.getvalue().splitlines()[:40]))
+
+    enc = BatchEncryptor(init, g)
+    if what in ("encrypt", "both"):
+        pr = cProfile.Profile()
+        t0 = time.time()
+        pr.enable()
+        encrypted, invalid = enc.encrypt_ballots(ballots, seed=g.int_to_q(42))
+        pr.disable()
+        report("encrypt", pr, time.time() - t0)
+    else:
+        encrypted, invalid = enc.encrypt_ballots(ballots, seed=g.int_to_q(42))
+    assert not invalid
+
+    tally_result = accumulate_ballots(init, encrypted)
+    record = ElectionRecord(election_init=init, encrypted_ballots=encrypted,
+                            tally_result=tally_result)
+    Verifier(record, g).verify()  # warm pass
+    if what in ("verify", "both"):
+        pr = cProfile.Profile()
+        t0 = time.time()
+        pr.enable()
+        res = Verifier(record, g).verify()
+        pr.disable()
+        assert res.ok, res.summary()
+        report("verify", pr, time.time() - t0)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

