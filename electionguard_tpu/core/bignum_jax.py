@@ -364,7 +364,6 @@ def mont_multi_pow_shared(ctx: MontCtx, base_mont: jax.Array,
     digits = (limb >> ((widx % 4) * 4).astype(jnp.uint32)) & U32(0xF)
     digits = jnp.moveaxis(digits, -1, 0).astype(jnp.int32)
 
-    one_bk = jnp.broadcast_to(ctx.r_mod_p, (B, k, n))
     buckets0 = jnp.broadcast_to(ctx.r_mod_p, (B, k, 16, n))
 
     def step(carry, d):

@@ -140,7 +140,8 @@ class JaxGroupOps:
         # fixed-base tables for g and (lazily) other bases: 8-bit windows
         self.nwin8 = (self.exp_bits + 7) // 8
         self._fixed_tables: dict[int, jax.Array] = {}
-        self.g_table = self._make_fixed_table(group.g)
+        self.g_table = self.fixed_table(group.g)  # registered: base g
+        # cache hits for later fixed_table(g.g) callers
 
         # jitted entry points
         self._powmod_j = jax.jit(self._powmod_impl)

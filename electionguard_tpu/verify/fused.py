@@ -45,6 +45,18 @@ def limbs_to_bytes_j(x: jax.Array) -> jax.Array:
                                                 2 * x.shape[-1])
 
 
+def get_fused(ops: JaxGroupOps) -> "FusedVerifier":
+    """One FusedVerifier per batch plane, stored ON the plane so the
+    jitted programs and g/g^-1 tables are reused across Verifier
+    instances and the pairing can never dangle (an id()-keyed side table
+    could alias a GC'd plane to a different group's tables)."""
+    fv = getattr(ops, "_fused_verifier", None)
+    if fv is None:
+        fv = FusedVerifier(ops)
+        ops._fused_verifier = fv
+    return fv
+
+
 class FusedVerifier:
     """Per-``JaxGroupOps`` jitted V4/V5 selection+contest checkers.
 
@@ -59,7 +71,6 @@ class FusedVerifier:
         g = ops.group
         self._q_limbs = jnp.asarray(bn.int_to_limbs(g.q, 16))
         self._hdr = jnp.asarray(_P_HDR)
-        ops.fixed_table(g.g)  # g_table already built; ensure ginv too
         self._ginv_table = ops.fixed_table(g.GINV_MOD_P.value)
         self._v4_j = jax.jit(self._v4_impl)
         self._v5_j = jax.jit(self._v5_impl)

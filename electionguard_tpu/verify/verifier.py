@@ -27,6 +27,7 @@ Verification steps (numbered in the result):
 
 from __future__ import annotations
 
+import itertools
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -96,9 +97,15 @@ class Verifier:
         self.init = record.election_init
         self.chunk_size = chunk_size
 
+    def _fused(self):
+        """The fused on-device V4/V5 checker for this verifier's batch
+        plane (verify/fused.py) — shared process-wide per plane, so its
+        jitted programs compile once per group."""
+        from electionguard_tpu.verify.fused import get_fused
+        return get_fused(self.ops)
+
     # ==================================================================
     def verify(self) -> VerificationResult:
-        import itertools
         res = VerificationResult()
         self._v1_parameters(res)
         self._v2_guardian_keys(res)
@@ -351,19 +358,16 @@ class Verifier:
         res.record("V4.selection_proofs", True)
 
         # ---- V5: contest limits ------------------------------------------
-        contest_alphas, contest_betas = [], []
         contest_cs, contest_vs, contest_consts = [], [], []
         contest_refs = []
+        contest_spans = []   # (start, count) into the V4 selection rows
         contests_by_id = {c.object_id: c
                           for c in self.init.config.manifest.contests}
+        off = 0
         for b in ballots:
             for c in b.contests:
-                acc_a, acc_b = 1, 1
-                for s in c.selections:
-                    acc_a = acc_a * s.ciphertext.pad.value % g.p
-                    acc_b = acc_b * s.ciphertext.data.value % g.p
-                contest_alphas.append(acc_a)
-                contest_betas.append(acc_b)
+                contest_spans.append((off, len(c.selections)))
+                off += len(c.selections)
                 contest_cs.append(c.proof.challenge.value)
                 contest_vs.append(c.proof.response.value)
                 contest_consts.append(c.proof.constant)
@@ -374,42 +378,63 @@ class Verifier:
                                f"{b.ballot_id}/{c.contest_id} limit proof "
                                f"constant {c.proof.constant} != "
                                f"{desc.votes_allowed}")
-        C = len(contest_alphas)
-        CA_l = eo.to_limbs_p(contest_alphas)
-        CB_l = eo.to_limbs_p(contest_betas)
-        cc_l = ee.to_limbs(contest_cs)
-        cv_l = ee.to_limbs(contest_vs)
-        # B / g^L per contest
-        gL = [pow(ginv, L, g.p) for L in contest_consts]
-        gL_l = eo.to_limbs_p(gL)
-        CBs_l = np.asarray(eo.mulmod(CB_l, gL_l))
-        var2 = np.asarray(eo.powmod(
-            np.concatenate([CA_l, CBs_l]), np.concatenate([cc_l, cc_l])))
-        gp2 = np.asarray(eo.g_pow(cv_l))
-        kp2 = np.asarray(eo.base_pow(K, cv_l))
-        a_c = np.asarray(eo.mulmod(gp2, var2[:C]))
-        b_c = np.asarray(eo.mulmod(kp2, var2[C:]))
-        CAb = limbs_to_bytes_be(CA_l)
-        CBb = limbs_to_bytes_be(CB_l)
-        acb = limbs_to_bytes_be(a_c)
-        bcb = limbs_to_bytes_be(b_c)
+        C = len(contest_refs)
+        # contest ciphertext accumulation Π(α,β) on DEVICE: gather each
+        # contest's selection rows (identity-padded to the widest contest)
+        # and product-reduce — the per-selection host BigInteger loop this
+        # replaces was the verifier's last O(S) host math
+        span = max(cnt for _, cnt in contest_spans)
+        gather = np.zeros((C, span), dtype=np.int64)
+        mask = np.zeros((C, span), dtype=bool)
+        for j, (start, cnt) in enumerate(contest_spans):
+            gather[j, :cnt] = np.arange(start, start + cnt)
+            mask[j, :cnt] = True
+        one_row = np.zeros((eo.n,), np.uint32)
+        one_row[0] = 1
+        A_np, B_np = np.asarray(A_l), np.asarray(B_l)
+        GA = np.where(mask[..., None], A_np[gather], one_row)
+        GB = np.where(mask[..., None], B_np[gather], one_row)
+        CA_l = np.asarray(eo.prod_reduce(GA.transpose(1, 0, 2)))
+        CB_l = np.asarray(eo.prod_reduce(GB.transpose(1, 0, 2)))
+        cc_l = np.asarray(ee.to_limbs(contest_cs))
+        cv_l = np.asarray(ee.to_limbs(contest_vs))
         if sha256_jax.supports(g):
-            # rows share a message layout only within one constant value;
-            # group by constant (in practice one group per election)
+            # fused device program: (g^-1)^L fixed-base pass, commitment
+            # recompute, device Fiat–Shamir, challenge compare — booleans
+            # back.  Rows share a hash-message layout only within one
+            # constant value; group by constant (in practice one group
+            # per election).
+            Lq_l = np.asarray(ee.to_limbs(contest_consts))
             by_const: dict[int, list[int]] = {}
             for i, const in enumerate(contest_consts):
                 by_const.setdefault(const, []).append(i)
+            fused = self._fused()
+            k_table = eo.fixed_table(K)
             for const, idxs in by_const.items():
                 ix = np.asarray(idxs)
-                prefix = _encode(qbar) + _encode(const)
-                c_limbs = np.asarray(sha256_jax.batch_challenge_p(
-                    g, prefix, [CAb[ix], CBb[ix], acb[ix], bcb[ix]]))
-                want = np.asarray(cc_l)[ix]
-                for j in np.nonzero(~(want == c_limbs).all(axis=1))[0]:
+                ok5 = fused.v5_contests(
+                    CA_l[ix], CB_l[ix], Lq_l[ix], cc_l[ix], cv_l[ix],
+                    k_table, _encode(qbar) + _encode(const))
+                for j in np.nonzero(~ok5)[0]:
                     res.record(
                         "V5.contest_limits", False,
                         f"constant proof fails for {contest_refs[idxs[int(j)]]}")
         else:
+            # unfused fallback: device group math, host Fiat–Shamir
+            ginv = g.GINV_MOD_P.value
+            gL = [pow(ginv, L, g.p) for L in contest_consts]  # B / g^L
+            gL_l = eo.to_limbs_p(gL)
+            CBs_l = np.asarray(eo.mulmod(CB_l, gL_l))
+            var2 = np.asarray(eo.powmod(
+                np.concatenate([CA_l, CBs_l]), np.concatenate([cc_l, cc_l])))
+            gp2 = np.asarray(eo.g_pow(cv_l))
+            kp2 = np.asarray(eo.base_pow(K, cv_l))
+            a_c = np.asarray(eo.mulmod(gp2, var2[:C]))
+            b_c = np.asarray(eo.mulmod(kp2, var2[C:]))
+            CAb = limbs_to_bytes_be(CA_l)
+            CBb = limbs_to_bytes_be(CB_l)
+            acb = limbs_to_bytes_be(a_c)
+            bcb = limbs_to_bytes_be(b_c)
             for i in range(C):
                 c = hash_elems(
                     g, qbar, contest_consts[i],
@@ -510,8 +535,6 @@ class Verifier:
     def _v8_to_v12_decryption(self, res):
         g = self.group
         dr = self.record.decryption_result
-        qbar = self.init.extended_base_hash
-        guardians = {gr.guardian_id: gr for gr in self.init.guardians}
         avail = {dg.guardian_id: dg for dg in dr.decrypting_guardians}
         xs = [dg.x_coordinate for dg in dr.decrypting_guardians]
 
