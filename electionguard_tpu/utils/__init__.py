@@ -28,8 +28,9 @@ def maybe_profile(tag: str):
         yield
 
 
-def enable_compile_cache(path: str | None = None) -> None:
-    """Turn on JAX's persistent compilation cache (best-effort).
+def enable_compile_cache(path: str | None = None) -> str:
+    """Turn on JAX's persistent compilation cache (best-effort); returns
+    the resolved cache directory so callers can inspect it.
 
     The MXU NTT programs are expensive to compile (~minutes for the full
     modexp ladder); caching makes every process after the first warm.
@@ -44,3 +45,4 @@ def enable_compile_cache(path: str | None = None) -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass  # cache is an optimization; never fail the workload for it
+    return cache
