@@ -184,7 +184,7 @@ def _microbench(group) -> None:
     note(f"microbench batch={B}: " + "  ".join(lines))
 
 
-def _prewarm_tiles(g, init) -> None:
+def _prewarm_tiles(g, init, mesh=None) -> None:
     """Compile every cap-shaped program the measured pass will hit, one
     cheap retried dummy dispatch per op.  dispatch_bucket collapses all
     large batches onto the one tile shape, so after this the full pass
@@ -192,42 +192,42 @@ def _prewarm_tiles(g, init) -> None:
     retry, not the run."""
     import numpy as np
 
-    from electionguard_tpu.core import sha256_jax
     from electionguard_tpu.core.group_jax import jax_exp_ops, jax_ops
     from electionguard_tpu.core.hash import _encode
-    from electionguard_tpu.encrypt.encryptor import (_derive_nonce_ints,
-                                                     _nonce_rows)
+    from electionguard_tpu.encrypt.fused import get_fused_encryptor
+    from electionguard_tpu.verify.fused import get_fused
 
     ops = jax_ops(g)
     ee = jax_exp_ops(g)
+    fe = get_fused_encryptor(ops, ee, mesh)
+    fv = get_fused(ops, mesh)
     cap = ops.tile
     ones = np.zeros((cap, ops.n), np.uint32)
     ones[:, 0] = 1
     zq = np.zeros((cap, ee.ne), np.uint32)
     K = init.joint_public_key.value
     qbar = init.extended_base_hash
-    elem = np.zeros((cap, g.spec.p_bytes), np.uint8)
-    elem[:, -1] = 1
+    k_table = ops.fixed_table(K)
+    seed_row = np.zeros(32, np.uint8)
+    bids = np.zeros((cap, 32), np.uint8)
+    ords = np.zeros(cap, np.uint32)
+    votes = np.zeros(cap, np.int64)
     prod_in = np.broadcast_to(ones[:, None, :], (cap, 16, ops.n))
-    nonce_msgs = _nonce_rows(g.int_to_q(3), np.zeros(cap, np.uint8),
-                             np.zeros((cap, 32), np.uint8),
-                             np.zeros(cap, np.uint32))
+    prod_in_t = np.broadcast_to(ones[None], (16, cap, ops.n))
     steps = [
-        ("powmod", lambda: np.asarray(ops.powmod(ones, zq))),
-        ("g-pow", lambda: np.asarray(ops.g_pow(zq))),
-        ("k-pow", lambda: np.asarray(ops.base_pow(K, zq))),
+        ("enc-selections", lambda: fe.encrypt_selections(
+            seed_row, bids, ords, votes, k_table, _encode(qbar))),
+        ("enc-contests", lambda: fe.encrypt_contests(
+            seed_row, bids, ords, zq, zq, k_table,
+            _encode(qbar) + _encode(1))),
+        ("ver-selections", lambda: fv.v4_selections(
+            ones, ones, zq, zq, zq, zq, k_table, _encode(qbar))),
+        ("ver-contests", lambda: fv.v5_contests(
+            ones, ones, zq, zq, zq, k_table,
+            _encode(qbar) + _encode(1))),
         ("mulmod", lambda: np.asarray(ops.mulmod(ones, ones))),
-        ("residue", lambda: np.asarray(ops.is_valid_residue(ones))),
         ("prod-reduce", lambda: np.asarray(ops.prod_reduce(prod_in))),
-        ("zq-mul", lambda: np.asarray(ee.mul(zq, zq))),
-        ("zq-add", lambda: np.asarray(ee.add(zq, zq))),
-        ("zq-sub", lambda: np.asarray(ee.sub(zq, zq))),
-        ("zq-aminusbc", lambda: np.asarray(ee.a_minus_bc(zq, zq, zq))),
-        ("sha-nonce", lambda: _derive_nonce_ints(g, ee, nonce_msgs)),
-        ("sha-selection", lambda: np.asarray(sha256_jax.batch_challenge_p(
-            g, _encode(qbar), [elem] * 6))),
-        ("sha-contest", lambda: np.asarray(sha256_jax.batch_challenge_p(
-            g, _encode(qbar) + _encode(1), [elem] * 4))),
+        ("prod-reduce-wide", lambda: np.asarray(ops.prod_reduce(prod_in_t))),
     ]
     t_all = time.time()
     for tag, fn in steps:
@@ -258,6 +258,13 @@ def run_workload(nballots: int, n_chips: int) -> None:
 
     t_setup = time.time()
     g = production_group()
+    mesh = None
+    if os.environ.get("BENCH_SHARDED"):
+        # route the fused encrypt/verify programs through the dp-sharded
+        # plane (1-chip mesh on the real chip; n-chip when a pod exists)
+        from electionguard_tpu.parallel.mesh import DP_AXIS, election_mesh
+        mesh = election_mesh()
+        RESULT["sharded_dp"] = mesh.shape[DP_AXIS]
     manifest = sample_manifest(ncontests=1, nselections=2)
     trustees = [KeyCeremonyTrustee(g, "guardian-0", 1, 1)]
     init = key_ceremony_exchange(trustees, g).make_election_initialized(
@@ -276,7 +283,7 @@ def run_workload(nballots: int, n_chips: int) -> None:
                     RESULT.get("phases_done", "") + f" {phase}"
                 RESULT.update(extra)
 
-        enc = BatchEncryptor(init, g)
+        enc = BatchEncryptor(init, g, mesh=mesh)
         t0 = time.time()
         encrypted, invalid = retry(
             f"{tag}-encrypt", lambda: enc.encrypt_ballots(bs, seed=seed))
@@ -292,13 +299,13 @@ def run_workload(nballots: int, n_chips: int) -> None:
                                 tally_result=tally_result)
         # warmup pass compiles every kernel at the measured shapes
         res = retry(f"{tag}-verify-warm",
-                    lambda: Verifier(record, g).verify())
+                    lambda: Verifier(record, g, mesh=mesh).verify())
         assert res.ok, res.summary()
         done("verify_warm")
         t0 = time.time()
         with maybe_profile(f"bench-verify-{tag}"):
             res = retry(f"{tag}-verify",
-                        lambda: Verifier(record, g).verify())
+                        lambda: Verifier(record, g, mesh=mesh).verify())
         dt_ver = time.time() - t0
         assert res.ok, res.summary()
         done("verify")
@@ -319,7 +326,7 @@ def run_workload(nballots: int, n_chips: int) -> None:
         # batches stay in the small power-of-two buckets)
         note(f"warm-up done in {time.time() - t_setup:.1f}s; prewarming "
              f"tile-shaped programs ...")
-        _prewarm_tiles(g, init)
+        _prewarm_tiles(g, init, mesh)
     t_setup = time.time() - t_setup
     RESULT["setup_s"] = round(t_setup, 1)
     note(f"setup done in {t_setup:.1f}s; full pass ({nballots} ballots)")

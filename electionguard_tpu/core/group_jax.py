@@ -91,6 +91,27 @@ def run_tiled(jfn, arrays, fills, cap: int | None = None):
          for lo in range(0, n, cap)], axis=0)
 
 
+def run_tiled_multi(jfn, arrays, fills, cap: int | None = None):
+    """``run_tiled`` for programs returning a TUPLE of per-row arrays
+    (fused pipelines that keep many products of one dispatch).  Same
+    bounded-shape bucketing; each output is sliced back to the tile's
+    true row count and concatenated across tiles."""
+    arrays = [jnp.asarray(a) for a in arrays]
+    n = arrays[0].shape[0]
+    cap = cap or _dispatch_tile()
+
+    def one(tiles, nb):
+        m = tiles[0].shape[0]
+        out = jfn(*[pad_rows(a, nb, f) for a, f in zip(tiles, fills)])
+        return [o[:m] for o in out]
+
+    if n <= cap:
+        return one(arrays, dispatch_bucket(n, cap))
+    parts = [one([a[lo:lo + cap] for a in arrays], cap)
+             for lo in range(0, n, cap)]
+    return [jnp.concatenate(ps, axis=0) for ps in zip(*parts)]
+
+
 def _default_backend() -> str:
     """MXU NTT engine on TPU, VPU CIOS elsewhere; override with
     EGTPU_BIGNUM=ntt|cios."""

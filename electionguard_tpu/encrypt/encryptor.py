@@ -60,8 +60,12 @@ class _FlatSelections:
 
 class BatchEncryptor:
     def __init__(self, election_init: ElectionInitialized,
-                 group=None):
+                 group=None, mesh=None):
+        """``mesh``: optional device mesh — shards the fused selection/
+        contest encryption programs' batch axis over dp (production
+        group only; see encrypt/fused.py)."""
         self.init = election_init
+        self.mesh = mesh
         self.group = group if group is not None else \
             election_init.joint_public_key.group
         self.manifest = election_init.config.manifest
@@ -201,22 +205,67 @@ class BatchEncryptor:
             self._seen_ids |= batch_ids
             return [], invalid
 
-        # ---- nonce + fake-branch scalar streams -------------------------
+        # ---- per-selection scalars + group math -------------------------
         # The four per-selection scalars (R, U, CF, VF) are internal
         # secrets: they must be deterministic in the seed, unique per
         # (ballot identity, position-in-ballot), and uniform mod q —
         # nothing external ever re-derives them.  On the production group
-        # they come from ONE device SHA-256 dispatch over fixed-width rows
-        # binding (seed, stream tag, SHA-256(ballot_id), ordinal); other
-        # groups fall back to host hashing (which binds ballot_id too).
+        # the ENTIRE pipeline (nonce PRF, exponent algebra, fixed-base
+        # passes, Fiat–Shamir, responses) runs as one fused device
+        # program per tile (encrypt/fused.py); other groups fall back to
+        # host hashing with batched group math.
         q = g.q
         bid_digests = np.frombuffer(
             b"".join(valid_digests), np.uint8).reshape(-1, 32)
+        votes = np.array(flat.votes, dtype=np.int64)
+        eo = self.ops
+        ee = self.eops
+        V_sum = [0] * C
+        for i in range(S):
+            V_sum[flat.contest_idx[i]] += flat.votes[i]
+
         if sha256_jax.supports(g):
-            R, U, CF, VF = _derive_selection_nonces(
-                g, self.eops, seed,
-                bid_digests[np.asarray(flat.ballot_idx, dtype=np.int64)],
-                np.asarray(sel_ord, dtype=np.uint32))
+            bids_con = bid_digests[
+                np.asarray([row[0] for row in contest_rows], np.int64)]
+            ords_con = np.asarray([row[1] for row in contest_rows],
+                                  dtype=np.uint32)
+            by_limit: dict[int, list[int]] = {}
+            for ci, row in enumerate(contest_rows):
+                by_limit.setdefault(row[4], []).append(ci)
+            from electionguard_tpu.encrypt.fused import get_fused_encryptor
+            fe = get_fused_encryptor(eo, ee, self.mesh)
+            seed_row = np.frombuffer(seed.to_bytes(), np.uint8)
+            k_table = eo.fixed_table(self.K.value)
+            alpha, beta, R_l, CR_l, VR_l, CF_l, VF_l = \
+                fe.encrypt_selections(
+                    seed_row,
+                    bid_digests[np.asarray(flat.ballot_idx, np.int64)],
+                    np.asarray(sel_ord, np.uint32), votes,
+                    k_table, _encode(self.qbar))
+            # per-contest ΣR mod q from the nonce limbs: unsorted-safe
+            # segment sum (a contest with zero selection rows — possible
+            # only for an unvalidated votes_allowed=0 manifest — still
+            # lands ΣR=0 at its own index instead of shifting the rest)
+            sums = np.zeros((C, R_l.shape[1]), dtype=np.uint64)
+            np.add.at(sums, np.asarray(flat.contest_idx, np.int64),
+                      R_l.astype(np.uint64))
+            R_sum = [int(sum(int(v) << (16 * k)
+                             for k, v in enumerate(row))) % q
+                     for row in sums]
+            RS_l = np.asarray(ee.to_limbs(R_sum))
+            VS_l = np.asarray(ee.to_limbs(V_sum))
+            A_c = np.empty((C, eo.n), dtype=np.uint32)
+            B_c = np.empty((C, eo.n), dtype=np.uint32)
+            C2_l = np.empty((C, ee.ne), dtype=np.uint32)
+            V2_l = np.empty((C, ee.ne), dtype=np.uint32)
+            for limit, idxs in by_limit.items():
+                ix = np.asarray(idxs)
+                a_g, b_g, c2_g, v2_g = fe.encrypt_contests(
+                    seed_row, bids_con[ix], ords_con[ix],
+                    RS_l[ix], VS_l[ix], k_table,
+                    _encode(self.qbar) + _encode(limit))
+                A_c[ix], B_c[ix] = a_g, b_g
+                C2_l[ix], V2_l[ix] = c2_g, v2_g
         else:
             R = np.empty(S, dtype=object)
             U = np.empty(S, dtype=object)
@@ -230,60 +279,44 @@ class BatchEncryptor:
                 CF[i] = hash_elems(g, h, "cf").value
                 VF[i] = hash_elems(g, h, "vf").value
 
-        votes = np.array(flat.votes, dtype=np.int64)
+            # batched group math on device, Fiat–Shamir on host
+            R_l = ee.to_limbs(R)
+            U_l = ee.to_limbs(U)
+            CF_l = np.asarray(ee.to_limbs(CF))
+            VF_l = np.asarray(ee.to_limbs(VF))
+            # w = v_f + R*c_f mod q
+            W_l = np.asarray(ee.add(VF_l, ee.mul(R_l, CF_l)))
+            # s = +c_f (vote==1) or q - c_f (vote==0): exponent of g in
+            # the fake-branch commitment b_f
+            negCF = np.asarray(ee.sub(ee.to_limbs([0] * S), CF_l))
+            S_l = np.where((votes == 1)[:, None], CF_l,
+                           negCF).astype(np.uint32)
 
-        # ---- device: exponent algebra then one big fixed-base pass ------
-        eo = self.ops
-        ee = self.eops
-        R_l = ee.to_limbs(R)
-        U_l = ee.to_limbs(U)
-        CF_l = ee.to_limbs(CF)
-        VF_l = ee.to_limbs(VF)
-        # w = v_f + R*c_f mod q
-        W_l = np.asarray(ee.add(VF_l, ee.mul(R_l, CF_l)))
-        # s = +c_f (vote==1) or q - c_f (vote==0), exponent of g in b_fake
-        CF_np = CF_l
-        negCF = np.asarray(ee.sub(ee.to_limbs([0] * S), CF_l))
-        S_l = np.where((votes == 1)[:, None], CF_np, negCF).astype(np.uint32)
+            g_exps = np.concatenate([R_l, U_l, W_l, S_l])      # (4S, ne)
+            k_exps = np.concatenate([R_l, U_l, W_l])           # (3S, ne)
+            g_pows = np.asarray(eo.g_pow(g_exps))
+            k_pows = np.asarray(eo.base_pow(self.K.value, k_exps))
+            alpha = g_pows[:S]
+            a_real = g_pows[S:2 * S]
+            a_fake = g_pows[2 * S:3 * S]
+            g_s = g_pows[3 * S:]
+            beta_k = k_pows[:S]
+            b_real = k_pows[S:2 * S]
+            k_w = k_pows[2 * S:]
 
-        g_exps = np.concatenate([R_l, U_l, W_l, S_l])      # (4S, ne)
-        k_exps = np.concatenate([R_l, U_l, W_l])           # (3S, ne)
-        g_pows = np.asarray(eo.g_pow(g_exps))
-        k_pows = np.asarray(eo.base_pow(self.K.value, k_exps))
-        alpha = g_pows[:S]
-        a_real = g_pows[S:2 * S]
-        a_fake = g_pows[2 * S:3 * S]
-        g_s = g_pows[3 * S:]
-        beta_k = k_pows[:S]
-        b_real = k_pows[S:2 * S]
-        k_w = k_pows[2 * S:]
+            g_limbs = eo.to_limbs_p([g.g])[0]
+            beta1 = np.asarray(eo.mulmod(
+                beta_k, np.broadcast_to(g_limbs, beta_k.shape)))
+            beta = np.where((votes == 1)[:, None], beta1,
+                            beta_k).astype(np.uint32)
+            b_fake = np.asarray(eo.mulmod(g_s, k_w))
 
-        g_limbs = eo.to_limbs_p([g.g])[0]
-        beta1 = np.asarray(eo.mulmod(
-            beta_k, np.broadcast_to(g_limbs, beta_k.shape)))
-        beta = np.where((votes == 1)[:, None], beta1, beta_k).astype(np.uint32)
-        b_fake = np.asarray(eo.mulmod(g_s, k_w))
-
-        # ---- host: Fiat-Shamir challenges -------------------------------
-        alpha_b = limbs_to_bytes_be(alpha)
-        beta_b = limbs_to_bytes_be(beta)
-        a_real_b = limbs_to_bytes_be(a_real)
-        b_real_b = limbs_to_bytes_be(b_real)
-        a_fake_b = limbs_to_bytes_be(a_fake)
-        b_fake_b = limbs_to_bytes_be(b_fake)
-
-        if sha256_jax.supports(g):
-            # device Fiat–Shamir over the whole batch; the (real, fake)
-            # branch order depends on the vote, selected with np.where
-            v1 = (votes == 1)[:, None]
-            a0b = np.where(v1, a_fake_b, a_real_b)
-            b0b = np.where(v1, b_fake_b, b_real_b)
-            a1b = np.where(v1, a_real_b, a_fake_b)
-            b1b = np.where(v1, b_real_b, b_fake_b)
-            C_l = np.asarray(sha256_jax.batch_challenge_p(
-                g, _encode(self.qbar),
-                [alpha_b, beta_b, a0b, b0b, a1b, b1b]))
-        else:
+            alpha_b = limbs_to_bytes_be(alpha)
+            beta_b = limbs_to_bytes_be(beta)
+            a_real_b = limbs_to_bytes_be(a_real)
+            b_real_b = limbs_to_bytes_be(b_real)
+            a_fake_b = limbs_to_bytes_be(a_fake)
+            b_fake_b = limbs_to_bytes_be(b_fake)
             C_chal = np.empty(S, dtype=object)
             for i in range(S):
                 if votes[i] == 0:
@@ -296,63 +329,42 @@ class BatchEncryptor:
                     g, self.qbar, alpha_b[i], beta_b[i], a0, b0, a1, b1)
             C_l = ee.to_limbs(C_chal)
 
-        # c_real = c - c_f ; v_real = u - c_real * R  (device, mod q)
-        CR_l = np.asarray(ee.sub(C_l, CF_l))
-        VR_l = np.asarray(ee.a_minus_bc(U_l, CR_l, R_l))
+            # c_real = c - c_f ; v_real = u - c_real * R  (device, mod q)
+            CR_l = np.asarray(ee.sub(C_l, CF_l))
+            VR_l = np.asarray(ee.a_minus_bc(U_l, CR_l, R_l))
 
-        # ---- contests: accumulation + limit proof -----------------------
-        R_sum = [0] * C
-        V_sum = [0] * C
-        for i in range(S):
-            R_sum[flat.contest_idx[i]] = (R_sum[flat.contest_idx[i]] + R[i]) % q
-            V_sum[flat.contest_idx[i]] += flat.votes[i]
-        if sha256_jax.supports(g):
-            U2 = _derive_contest_nonces(
-                g, self.eops, seed,
-                bid_digests[np.asarray([row[0] for row in contest_rows],
-                                       dtype=np.int64)],
-                np.asarray([row[1] for row in contest_rows],
-                           dtype=np.uint32))
-        else:
+            # contests: accumulation + limit proof
+            R_sum = [0] * C
+            for i in range(S):
+                R_sum[flat.contest_idx[i]] = \
+                    (R_sum[flat.contest_idx[i]] + R[i]) % q
             U2 = [hash_elems(g, seed, "contest-u", ci,
                              valid[row[0]].ballot_id).value
                   for ci, row in enumerate(contest_rows)]
-        RS_l = ee.to_limbs(R_sum)
-        U2_l = ee.to_limbs(U2)
-        VS_l = ee.to_limbs(V_sum)
-        g_exps2 = np.concatenate([RS_l, U2_l, VS_l])
-        k_exps2 = np.concatenate([RS_l, U2_l])
-        g_pows2 = np.asarray(eo.g_pow(g_exps2))
-        k_pows2 = np.asarray(eo.base_pow(self.K.value, k_exps2))
-        A_c = g_pows2[:C]
-        a_c = g_pows2[C:2 * C]
-        gV = g_pows2[2 * C:]
-        BK_c = k_pows2[:C]
-        b_c = k_pows2[C:2 * C]
-        B_c = np.asarray(eo.mulmod(gV, BK_c))
+            RS_l = ee.to_limbs(R_sum)
+            U2_l = ee.to_limbs(U2)
+            VS_l = ee.to_limbs(V_sum)
+            g_exps2 = np.concatenate([RS_l, U2_l, VS_l])
+            k_exps2 = np.concatenate([RS_l, U2_l])
+            g_pows2 = np.asarray(eo.g_pow(g_exps2))
+            k_pows2 = np.asarray(eo.base_pow(self.K.value, k_exps2))
+            A_c = g_pows2[:C]
+            a_c = g_pows2[C:2 * C]
+            gV = g_pows2[2 * C:]
+            BK_c = k_pows2[:C]
+            b_c = k_pows2[C:2 * C]
+            B_c = np.asarray(eo.mulmod(gV, BK_c))
 
-        A_b = limbs_to_bytes_be(A_c)
-        B_b = limbs_to_bytes_be(B_c)
-        a_cb = limbs_to_bytes_be(a_c)
-        b_cb = limbs_to_bytes_be(b_c)
-        if sha256_jax.supports(g):
-            C2_l = np.empty((C, ee.ne), dtype=np.uint32)
-            by_limit: dict[int, list[int]] = {}
-            for ci, row in enumerate(contest_rows):
-                by_limit.setdefault(row[4], []).append(ci)
-            for limit, idxs in by_limit.items():
-                ix = np.asarray(idxs)
-                prefix = _encode(self.qbar) + _encode(limit)
-                C2_l[ix] = np.asarray(sha256_jax.batch_challenge_p(
-                    g, prefix, [A_b[ix], B_b[ix], a_cb[ix], b_cb[ix]]))
-            C2 = np.array(ee.from_limbs(C2_l), dtype=object)
-        else:
+            A_b = limbs_to_bytes_be(A_c)
+            B_b = limbs_to_bytes_be(B_c)
+            a_cb = limbs_to_bytes_be(a_c)
+            b_cb = limbs_to_bytes_be(b_c)
             C2 = np.empty(C, dtype=object)
             for ci, row in enumerate(contest_rows):
                 C2[ci] = _hash_constant(g, self.qbar, row[4], A_b[ci],
                                         B_b[ci], a_cb[ci], b_cb[ci])
             C2_l = ee.to_limbs(C2)
-        V2_l = np.asarray(ee.a_minus_bc(U2_l, C2_l, RS_l))
+            V2_l = np.asarray(ee.a_minus_bc(U2_l, C2_l, RS_l))
 
         # ---- materialize ballots ---------------------------------------
         alpha_i = self.ops.from_limbs(alpha)
@@ -361,9 +373,9 @@ class BatchEncryptor:
         B_i = self.ops.from_limbs(B_c)
         CR = ee.from_limbs(CR_l)
         VR = ee.from_limbs(VR_l)
-        CF_i = [int(x) for x in CF]
-        VF_i = [int(x) for x in VF]
-        C2_i = [int(x) for x in C2]
+        CF_i = ee.from_limbs(CF_l)
+        VF_i = ee.from_limbs(VF_l)
+        C2_i = ee.from_limbs(C2_l)
         V2 = ee.from_limbs(V2_l)
 
         sel_by_contest: dict[int, list[EncryptedSelection]] = {}
@@ -437,38 +449,15 @@ def _nonce_rows(seed: ElementModQ, tags: np.ndarray, bids: np.ndarray,
 
 
 def _derive_nonce_ints(g, ee, msgs: np.ndarray) -> list[int]:
-    """Hash rows on-device, reduce mod q, return host ints.  Dispatches
-    through the shared ``run_tiled`` policy so the whole workflow
-    compiles a bounded set of SHA shapes."""
+    """Host-visible twin of the fused pipeline's nonce PRF (hash rows on
+    device, reduce mod q, return ints).  The fused programs derive these
+    in-dispatch (encrypt/fused.py _nonce_mod_q); this twin exists for
+    differential tests pinning the two byte-identical."""
     from electionguard_tpu.core.group_jax import run_tiled
     limbs = run_tiled(
         lambda m: sha256_jax.digest_to_q_limbs(g, sha256_jax.sha256_rows(m)),
         [msgs], [False])
     return ee.from_limbs(np.asarray(limbs))
-
-
-def _derive_selection_nonces(g, ee, seed: ElementModQ, bids: np.ndarray,
-                             ords: np.ndarray):
-    """(R, U, CF, VF) for all S selections in one device dispatch; ``bids``
-    is the (S, 32) per-selection ballot-identity digest and ``ords`` the
-    selection ordinal within its ballot."""
-    S = ords.shape[0]
-    msgs = _nonce_rows(seed, np.repeat(np.arange(4, dtype=np.uint8), S),
-                       np.tile(bids, (4, 1)), np.tile(ords, 4))
-    ints = _derive_nonce_ints(g, ee, msgs)
-    return (np.array(ints[:S], dtype=object),
-            np.array(ints[S:2 * S], dtype=object),
-            np.array(ints[2 * S:3 * S], dtype=object),
-            np.array(ints[3 * S:], dtype=object))
-
-
-def _derive_contest_nonces(g, ee, seed: ElementModQ, bids: np.ndarray,
-                           ords: np.ndarray) -> list[int]:
-    """Contest limit-proof nonces (stream tag 4), one device dispatch;
-    keyed by (ballot identity, contest ordinal)."""
-    msgs = _nonce_rows(seed, np.full(ords.shape[0], 4, np.uint8),
-                       bids, ords)
-    return _derive_nonce_ints(g, ee, msgs)
 
 
 def _hash_disjunctive(g, qbar, alpha_b, beta_b, a0, b0, a1, b1) -> int:

@@ -1,0 +1,219 @@
+"""Fused on-device ballot encryption programs.
+
+Round-4 hardware profiling left encryption as the slowest phase (88.5
+ballots/s vs 188.5 verify, TPU_RESULTS.md): the columnar encryptor ran
+~12 separate device dispatches per chunk — nonce SHA, five Z_q algebra
+ops, two fixed-base passes, two Montgomery products, the challenge SHA,
+and two response ops — each a synchronous host round-trip over the
+single-chip tunnel, with the nonces even pulled to host ints and pushed
+straight back as limbs.
+
+These programs keep the ENTIRE selection / contest encryption pipeline
+on device in one jitted dispatch per tile: nonce PRF (SHA-256 rows),
+exponent algebra in Z_q, PowRadix fixed-base passes in the Montgomery
+domain, ciphertext assembly, byte imaging, the device Fiat–Shamir
+challenge, and the response equations.  The host uploads ballot-identity
+digests + ordinals + votes and downloads the finished columns (α, β,
+proof scalars) once.
+
+Byte-identical to the unfused path: the nonce rows replay
+``encryptor._nonce_rows`` exactly and the challenge framing replays
+``sha256_jax.batch_challenge_p``; the differential test
+(tests/test_fused_encrypt.py) pins ciphertext-for-ciphertext equality.
+
+Applies to groups supported by the device SHA path (production
+4096/256-bit geometry); other groups keep the host-hash fallback.
+Reference analogue of the whole pipeline: ``batchEncryption(...,
+nthreads=11, ...)`` — src/test/java/electionguard/workflow/
+RunRemoteWorkflowTest.java:140.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from electionguard_tpu.core import bignum_jax as bn
+from electionguard_tpu.core import sha256_jax
+from electionguard_tpu.core.group_jax import (JaxExponentOps, JaxGroupOps,
+                                              run_tiled_multi)
+from electionguard_tpu.verify.fused import (challenge_rows, fixed_pow_mont,
+                                            limbs_to_bytes_j)
+
+_P_HDR = np.frombuffer(sha256_jax._TAG_P_HDR, np.uint8)
+
+
+def get_fused_encryptor(ops: JaxGroupOps, eops: JaxExponentOps,
+                        mesh=None) -> "FusedEncryptor":
+    """One FusedEncryptor per (batch plane, mesh), stored ON the plane
+    (same lifetime/aliasing rationale as verify.fused.get_fused)."""
+    cache = getattr(ops, "_fused_encryptors", None)
+    if cache is None:
+        cache = ops._fused_encryptors = {}
+    key = None if mesh is None else id(mesh)
+    fe = cache.get(key)
+    if fe is None:
+        fe = FusedEncryptor(ops, eops, mesh)
+        cache[key] = fe
+    return fe
+
+
+class FusedEncryptor:
+    """Jitted selection/contest encryption for one group's batch planes.
+
+    Group constants (g table, g in Montgomery form, q limbs) are closure
+    constants; the election key table, seed row, and hash prefix are
+    runtime arguments, so compiled programs survive election turnover.
+    """
+
+    def __init__(self, ops: JaxGroupOps, eops: JaxExponentOps, mesh=None):
+        self.ops = ops
+        self.eops = eops
+        self.mesh = mesh
+        g = ops.group
+        self.qctx = eops.ctx
+        self._q_limbs = jnp.asarray(bn.int_to_limbs(g.q, eops.ne))
+        self._hdr = jnp.asarray(_P_HDR)
+        self._g_mont = jnp.asarray(
+            bn.int_to_limbs(g.g * ops._R % g.p, ops.n))
+        if mesh is None:
+            self.ndp = 1
+            self._sel_j = jax.jit(self._sel_impl)
+            self._con_j = jax.jit(self._con_impl)
+        else:
+            from electionguard_tpu.parallel.mesh import DP_AXIS
+            from electionguard_tpu.verify.fused import shard_rows
+            self.ndp = mesh.shape[DP_AXIS]
+            self._sel_j = jax.jit(
+                shard_rows(self._sel_impl, mesh, 3, 3, n_out=7))
+            self._con_j = jax.jit(
+                shard_rows(self._con_impl, mesh, 4, 3, n_out=4))
+
+    # -- shared helpers (device) ---------------------------------------
+    def _fixed_pow_mont(self, table, exp):
+        return fixed_pow_mont(self.ops, table, exp)
+
+    def _challenge(self, prefix_row, elem_bytes):
+        return challenge_rows(self._hdr, self._q_limbs, prefix_row,
+                              elem_bytes)
+
+    def _nonce_mod_q(self, seed_row, tags, bids, ords):
+        """Device twin of encryptor._nonce_rows + digest mod q:
+        seed(32) || tag(1) || bid-digest(32) || ordinal(4 BE)."""
+        t = bids.shape[0]
+        ordb = jnp.stack([(ords >> 24) & 0xFF, (ords >> 16) & 0xFF,
+                          (ords >> 8) & 0xFF, ords & 0xFF],
+                         axis=1).astype(jnp.uint8)
+        msgs = jnp.concatenate(
+            [jnp.broadcast_to(seed_row, (t, 32)),
+             tags[:, None].astype(jnp.uint8), bids, ordb], axis=1)
+        return sha256_jax._digest_mod_q(sha256_jax.sha256_rows(msgs),
+                                        self._q_limbs)
+
+    # -- selections ----------------------------------------------------
+    def _sel_impl(self, bids, ords, votes, seed_row, k_table, prefix_row):
+        """One dispatch for a tile of selections.
+
+        α = g^R, β = K^R g^v; real commitments a=g^U, b=K^U; fake branch
+        a_f = g^{V_F + R C_F}, b_f = g^{±C_F} K^{V_F + R C_F};
+        c = H(Q̄, α, β, a0, b0, a1, b1) with branch order by vote;
+        c_r = c - C_F, v_r = U - c_r R   (all mod q).
+        Returns (α, β, R, c_r, v_r, C_F, V_F) — α/β canonical limbs,
+        scalars as Z_q limbs.
+        """
+        ops, qc = self.ops, self.qctx
+        mm = ops._mm
+        t = bids.shape[0]
+        tags = jnp.repeat(jnp.arange(4, dtype=jnp.uint32), t)
+        d = self._nonce_mod_q(seed_row, tags, jnp.tile(bids, (4, 1)),
+                              jnp.tile(ords, 4))
+        R, U, CF, VF = d[:t], d[t:2 * t], d[2 * t:3 * t], d[3 * t:]
+
+        W = bn.add_mod(VF, bn.mulmod(qc, R, CF), qc.p_limbs)
+        negCF = bn.sub_mod(jnp.zeros_like(CF), CF, qc.p_limbs)
+        v1 = (votes == 1)[:, None]
+        Sx = jnp.where(v1, CF, negCF)
+
+        gp = self._fixed_pow_mont(ops.g_table,
+                                  jnp.concatenate([R, U, W, Sx]))
+        kp = self._fixed_pow_mont(k_table, jnp.concatenate([R, U, W]))
+        alpha_m, a_real_m, a_fake_m, gS_m = (
+            gp[:t], gp[t:2 * t], gp[2 * t:3 * t], gp[3 * t:])
+        betak_m, b_real_m, kW_m = kp[:t], kp[t:2 * t], kp[2 * t:]
+        beta_m = jnp.where(
+            v1, mm(betak_m, jnp.broadcast_to(self._g_mont, betak_m.shape)),
+            betak_m)
+        b_fake_m = mm(gS_m, kW_m)
+
+        com = bn.from_mont_via(mm, jnp.concatenate(
+            [alpha_m, beta_m, a_real_m, b_real_m, a_fake_m, b_fake_m]))
+        cb = limbs_to_bytes_j(com)
+        arb, brb = cb[2 * t:3 * t], cb[3 * t:4 * t]
+        afb, bfb = cb[4 * t:5 * t], cb[5 * t:]
+        chal = self._challenge(
+            prefix_row,
+            [cb[:t], cb[t:2 * t],
+             jnp.where(v1, afb, arb), jnp.where(v1, bfb, brb),
+             jnp.where(v1, arb, afb), jnp.where(v1, brb, bfb)])
+        CR = bn.sub_mod(chal, CF, qc.p_limbs)
+        VR = bn.sub_mod(U, bn.mulmod(qc, CR, R), qc.p_limbs)
+        return com[:t], com[t:2 * t], R, CR, VR, CF, VF
+
+    def encrypt_selections(self, seed_row: np.ndarray, bids: np.ndarray,
+                           ords: np.ndarray, votes: np.ndarray,
+                           k_table, prefix: bytes):
+        """Host entry: (S,32) identity digests + ordinals + votes ->
+        [α, β, R, c_real, v_real, c_fake, v_fake] np arrays via the
+        shared tiling policy."""
+        from electionguard_tpu.verify.fused import pad_to_dp
+        prefix_row = jnp.asarray(np.frombuffer(prefix, np.uint8))
+        seed_j = jnp.asarray(seed_row)
+        arrays, n = pad_to_dp(
+            [bids, ords.astype(np.uint32), votes.astype(np.int32)],
+            self.ndp)
+        outs = run_tiled_multi(
+            lambda b, o, v: self._sel_j(b, o, v, seed_j, k_table,
+                                        prefix_row),
+            arrays, [False, False, False])
+        return [np.asarray(o)[:n] for o in outs]
+
+    # -- contests ------------------------------------------------------
+    def _con_impl(self, bids, ords, RS, VS, seed_row, k_table, prefix_row):
+        """One dispatch for a tile of contests sharing one vote limit:
+        A = g^ΣR, B = g^ΣV K^ΣR, a = g^{U₂}, b = K^{U₂};
+        c₂ = H(Q̄, L, A, B, a, b); v₂ = U₂ - c₂ ΣR.
+        Returns (A, B, c₂, v₂)."""
+        ops, qc = self.ops, self.qctx
+        mm = ops._mm
+        t = bids.shape[0]
+        U2 = self._nonce_mod_q(seed_row,
+                               jnp.full((t,), 4, jnp.uint32), bids, ords)
+        gp = self._fixed_pow_mont(ops.g_table,
+                                  jnp.concatenate([RS, U2, VS]))
+        kp = self._fixed_pow_mont(k_table, jnp.concatenate([RS, U2]))
+        A_m, a_m, gV_m = gp[:t], gp[t:2 * t], gp[2 * t:]
+        B_m = mm(gV_m, kp[:t])
+        b_m = kp[t:2 * t]
+        com = bn.from_mont_via(mm, jnp.concatenate([A_m, B_m, a_m, b_m]))
+        cb = limbs_to_bytes_j(com)
+        C2 = self._challenge(
+            prefix_row, [cb[:t], cb[t:2 * t], cb[2 * t:3 * t], cb[3 * t:]])
+        V2 = bn.sub_mod(U2, bn.mulmod(qc, C2, RS), qc.p_limbs)
+        return com[:t], com[t:2 * t], C2, V2
+
+    def encrypt_contests(self, seed_row: np.ndarray, bids: np.ndarray,
+                         ords: np.ndarray, RS_l: np.ndarray,
+                         VS_l: np.ndarray, k_table, prefix: bytes):
+        """Host entry for one vote-limit group (the limit is encoded in
+        ``prefix``): -> [A, B, c₂, v₂] np arrays."""
+        from electionguard_tpu.verify.fused import pad_to_dp
+        prefix_row = jnp.asarray(np.frombuffer(prefix, np.uint8))
+        seed_j = jnp.asarray(seed_row)
+        arrays, n = pad_to_dp(
+            [bids, ords.astype(np.uint32), RS_l, VS_l], self.ndp)
+        outs = run_tiled_multi(
+            lambda b, o, rs, vs: self._con_j(b, o, rs, vs, seed_j,
+                                             k_table, prefix_row),
+            arrays, [False, False, False, False])
+        return [np.asarray(o)[:n] for o in outs]

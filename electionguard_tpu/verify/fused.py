@@ -45,16 +45,81 @@ def limbs_to_bytes_j(x: jax.Array) -> jax.Array:
                                                 2 * x.shape[-1])
 
 
-def get_fused(ops: JaxGroupOps) -> "FusedVerifier":
-    """One FusedVerifier per batch plane, stored ON the plane so the
-    jitted programs and g/g^-1 tables are reused across Verifier
+def fixed_pow_mont(ops: JaxGroupOps, table, exp) -> jax.Array:
+    """PowRadix fixed-base power over 8-bit windows, Montgomery-domain
+    output — the shared device walk for every fused program (verify AND
+    encrypt; one definition so the window layout can never diverge)."""
+    acc = None
+    for w in range(ops.nwin8):
+        limb = exp[..., w // 2]
+        digit = ((limb >> ((w % 2) * 8))
+                 & jnp.uint32(0xFF)).astype(jnp.int32)
+        sel = table[w][digit]
+        acc = sel if acc is None else ops._mm(acc, sel)
+    return acc
+
+
+def challenge_rows(hdr, q_limbs, prefix_row, elem_bytes) -> jax.Array:
+    """Device Fiat–Shamir challenge rows: prefix || (hdr || elem)* —
+    the one definition of the hash framing shared by every fused
+    program (byte-twin of sha256_jax.batch_challenge_p)."""
+    nb = elem_bytes[0].shape[0]
+    parts = [jnp.broadcast_to(prefix_row, (nb, prefix_row.shape[0]))]
+    for e in elem_bytes:
+        parts.append(jnp.broadcast_to(hdr, (nb, 5)))
+        parts.append(e)
+    msgs = jnp.concatenate(parts, axis=1)
+    return sha256_jax._digest_mod_q(sha256_jax.sha256_rows(msgs), q_limbs)
+
+
+def get_fused(ops: JaxGroupOps, mesh=None) -> "FusedVerifier":
+    """One FusedVerifier per (batch plane, mesh), stored ON the plane so
+    the jitted programs and g/g^-1 tables are reused across Verifier
     instances and the pairing can never dangle (an id()-keyed side table
-    could alias a GC'd plane to a different group's tables)."""
-    fv = getattr(ops, "_fused_verifier", None)
+    could alias a GC'd plane to a different group's tables).  The cached
+    FusedVerifier holds its mesh, so a live cache entry's key can't be
+    recycled either."""
+    cache = getattr(ops, "_fused_verifiers", None)
+    if cache is None:
+        cache = ops._fused_verifiers = {}
+    key = None if mesh is None else id(mesh)
+    fv = cache.get(key)
     if fv is None:
-        fv = FusedVerifier(ops)
-        ops._fused_verifier = fv
+        fv = FusedVerifier(ops, mesh)
+        cache[key] = fv
     return fv
+
+
+def shard_rows(fn, mesh, n_rows: int, n_reps: int, n_out: int = 1):
+    """shard_map an elementwise-over-rows fused program over the mesh's
+    dp axis: the first ``n_rows`` args shard their leading axis, the
+    last ``n_reps`` (tables, prefix rows) replicate; all ``n_out``
+    outputs are row-sharded.  The program bodies are per-row (no
+    cross-row math), so dp sharding needs zero communication — this is
+    the flag-flip multi-chip path."""
+    from electionguard_tpu.parallel.mesh import DP_AXIS
+    from electionguard_tpu.parallel.sharded import shard_map
+    from jax.sharding import PartitionSpec as P
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=tuple([P(DP_AXIS)] * n_rows + [P()] * n_reps),
+        out_specs=(P(DP_AXIS) if n_out == 1
+                   else tuple([P(DP_AXIS)] * n_out)))
+
+
+def pad_to_dp(arrays, ndp: int):
+    """Pad row arrays so every dispatch bucket (a power of two ≥ 16) is
+    divisible by the dp degree; requires power-of-two ndp."""
+    if ndp & (ndp - 1):
+        raise ValueError(f"dp degree must be a power of two, got {ndp}")
+    n = arrays[0].shape[0]
+    if n >= ndp:
+        return arrays, n
+    out = []
+    for a in arrays:
+        pad = np.zeros((ndp - n,) + a.shape[1:], dtype=np.asarray(a).dtype)
+        out.append(np.concatenate([np.asarray(a), pad], axis=0))
+    return out, n
 
 
 class FusedVerifier:
@@ -63,40 +128,34 @@ class FusedVerifier:
     Group-constant tables (g, g^-1) are closure constants — stable across
     elections, so compiled programs and the persistent cache survive
     election turnover; the election key table and hash prefix are runtime
-    arguments.
-    """
+    arguments.  With ``mesh``, both programs shard their row axis over
+    the mesh's dp axis (bit-identical results; tested on the virtual
+    CPU mesh)."""
 
-    def __init__(self, ops: JaxGroupOps):
+    def __init__(self, ops: JaxGroupOps, mesh=None):
         self.ops = ops
+        self.mesh = mesh
         g = ops.group
         self._q_limbs = jnp.asarray(bn.int_to_limbs(g.q, 16))
         self._hdr = jnp.asarray(_P_HDR)
         self._ginv_table = ops.fixed_table(g.GINV_MOD_P.value)
-        self._v4_j = jax.jit(self._v4_impl)
-        self._v5_j = jax.jit(self._v5_impl)
+        if mesh is None:
+            self.ndp = 1
+            self._v4_j = jax.jit(self._v4_impl)
+            self._v5_j = jax.jit(self._v5_impl)
+        else:
+            from electionguard_tpu.parallel.mesh import DP_AXIS
+            self.ndp = mesh.shape[DP_AXIS]
+            self._v4_j = jax.jit(shard_rows(self._v4_impl, mesh, 6, 2))
+            self._v5_j = jax.jit(shard_rows(self._v5_impl, mesh, 5, 2))
 
     # -- shared helpers (device) ---------------------------------------
     def _fixed_pow_mont(self, table, exp):
-        """PowRadix fixed-base power, Montgomery-domain output."""
-        ops = self.ops
-        acc = None
-        for w in range(ops.nwin8):
-            limb = exp[..., w // 2]
-            digit = ((limb >> ((w % 2) * 8))
-                     & jnp.uint32(0xFF)).astype(jnp.int32)
-            sel = table[w][digit]
-            acc = sel if acc is None else ops._mm(acc, sel)
-        return acc
+        return fixed_pow_mont(self.ops, table, exp)
 
     def _challenge(self, prefix_row, elem_bytes):
-        nb = elem_bytes[0].shape[0]
-        parts = [jnp.broadcast_to(prefix_row, (nb, prefix_row.shape[0]))]
-        for e in elem_bytes:
-            parts.append(jnp.broadcast_to(self._hdr, (nb, 5)))
-            parts.append(e)
-        msgs = jnp.concatenate(parts, axis=1)
-        return sha256_jax._digest_mod_q(sha256_jax.sha256_rows(msgs),
-                                        self._q_limbs)
+        return challenge_rows(self._hdr, self._q_limbs, prefix_row,
+                              elem_bytes)
 
     # -- V4: disjunctive selection proofs ------------------------------
     def _v4_impl(self, A, B, c0, v0, c1, v1, k_table, prefix_row):
@@ -143,11 +202,12 @@ class FusedVerifier:
                       prefix: bytes) -> np.ndarray:
         """Host entry: (S, 2) bool via the shared tiling policy."""
         prefix_row = jnp.asarray(np.frombuffer(prefix, np.uint8))
+        arrays, n = pad_to_dp([A_l, B_l, c0, v0, c1, v1], self.ndp)
         return np.asarray(run_tiled(
             lambda a, b, x0, y0, x1, y1: self._v4_j(
                 a, b, x0, y0, x1, y1, k_table, prefix_row),
-            [A_l, B_l, c0, v0, c1, v1],
-            [True, True, False, False, False, False]))
+            arrays,
+            [True, True, False, False, False, False]))[:n]
 
     # -- V5: contest limit (constant CP) proofs ------------------------
     def _v5_impl(self, CA, CB, Lq, cc, cv, k_table, prefix_row):
@@ -177,8 +237,9 @@ class FusedVerifier:
     def v5_contests(self, CA_l, CB_l, Lq, cc, cv, k_table,
                     prefix: bytes) -> np.ndarray:
         prefix_row = jnp.asarray(np.frombuffer(prefix, np.uint8))
+        arrays, n = pad_to_dp([CA_l, CB_l, Lq, cc, cv], self.ndp)
         return np.asarray(run_tiled(
             lambda a, b, lq, x, y: self._v5_j(a, b, lq, x, y, k_table,
                                               prefix_row),
-            [CA_l, CB_l, Lq, cc, cv],
-            [True, True, False, False, False]))
+            arrays,
+            [True, True, False, False, False]))[:n]

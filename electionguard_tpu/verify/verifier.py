@@ -88,7 +88,13 @@ class Verifier:
 
     def __init__(self, record: ElectionRecord,
                  group: Optional[GroupContext] = None,
-                 chunk_size: int = 4096):
+                 chunk_size: int = 4096, mesh=None):
+        """``mesh``: an ``electionguard_tpu.parallel.mesh`` device mesh —
+        when given (and the group supports the fused path), the V4/V5
+        device programs shard the selection/contest batch axis over the
+        mesh's dp axis, scaling verification across chips the way the
+        reference scales it across 11 CPU threads
+        (RunRemoteWorkflowTest.java:180)."""
         self.record = record
         self.group = group if group is not None else \
             record.election_init.joint_public_key.group
@@ -96,13 +102,14 @@ class Verifier:
         self.eops = jax_exp_ops(self.group)
         self.init = record.election_init
         self.chunk_size = chunk_size
+        self.mesh = mesh
 
     def _fused(self):
         """The fused on-device V4/V5 checker for this verifier's batch
-        plane (verify/fused.py) — shared process-wide per plane, so its
-        jitted programs compile once per group."""
+        plane (verify/fused.py) — shared process-wide per (plane, mesh),
+        so its jitted programs compile once per group."""
         from electionguard_tpu.verify.fused import get_fused
-        return get_fused(self.ops)
+        return get_fused(self.ops, self.mesh)
 
     # ==================================================================
     def verify(self) -> VerificationResult:

@@ -37,3 +37,43 @@ def tgroup():
 def pgroup():
     from electionguard_tpu.core.group import production_group
     return production_group()
+
+
+@pytest.fixture(scope="session")
+def pelection(pgroup):
+    """Small full-workflow record on the PRODUCTION group (1 guardian,
+    quorum 1, 3 ballots, 1 contest x 2 selections), shared by every
+    slow-marked production-path test: encryption runs through the fused
+    device pipeline, decryption through the direct path."""
+    from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+    from electionguard_tpu.core.dlog import DLog
+    from electionguard_tpu.decrypt.decryption import Decryption
+    from electionguard_tpu.decrypt.trustee import DecryptingTrustee
+    from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+    from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+    from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+    from electionguard_tpu.publish.election_record import (DecryptionResult,
+                                                           ElectionConfig)
+    from electionguard_tpu.tally.accumulate import accumulate_ballots
+    from electionguard_tpu.workflow.e2e import sample_manifest
+
+    g = pgroup
+    manifest = sample_manifest(ncontests=1, nselections=2)
+    trustees = [KeyCeremonyTrustee(g, "guardian-0", 1, 1)]
+    init = key_ceremony_exchange(trustees, g).make_election_initialized(
+        ElectionConfig(manifest, 1, 1), {"created_by": "test"})
+    ballots = list(RandomBallotProvider(manifest, 3, seed=5).ballots())
+    enc = BatchEncryptor(init, g)
+    encrypted, invalid = enc.encrypt_ballots(ballots, seed=g.int_to_q(11))
+    assert not invalid
+    tally_result = accumulate_ballots(init, encrypted)
+    dec = Decryption(
+        g, init,
+        [DecryptingTrustee.from_state(g, trustees[0]
+                                      .decrypting_trustee_state())],
+        [], DLog(g, max_exponent=16))
+    decrypted = dec.decrypt(tally_result.encrypted_tally)
+    dr = DecryptionResult(tally_result, decrypted,
+                          tuple(dec.get_available_guardians()))
+    return dict(group=g, init=init, ballots=ballots, encrypted=encrypted,
+                tally_result=tally_result, decryption_result=dr)

@@ -20,49 +20,11 @@ import dataclasses
 
 import pytest
 
-from electionguard_tpu.ballot.plaintext import RandomBallotProvider
 from electionguard_tpu.core import sha256_jax
-from electionguard_tpu.core.dlog import DLog
-from electionguard_tpu.decrypt.decryption import Decryption
-from electionguard_tpu.decrypt.trustee import DecryptingTrustee
-from electionguard_tpu.encrypt.encryptor import BatchEncryptor
-from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
-from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
-from electionguard_tpu.publish.election_record import (DecryptionResult,
-                                                       ElectionConfig,
-                                                       ElectionRecord)
-from electionguard_tpu.tally.accumulate import accumulate_ballots
+from electionguard_tpu.publish.election_record import ElectionRecord
 from electionguard_tpu.verify.verifier import Verifier
-from electionguard_tpu.workflow.e2e import sample_manifest
 
 pytestmark = pytest.mark.slow
-
-
-@pytest.fixture(scope="module")
-def pelection(pgroup):
-    """Small full-workflow record on the PRODUCTION group: 1 guardian,
-    quorum 1, 3 ballots, 1 contest x 2 selections."""
-    g = pgroup
-    assert sha256_jax.supports(g)
-    manifest = sample_manifest(ncontests=1, nselections=2)
-    trustees = [KeyCeremonyTrustee(g, "guardian-0", 1, 1)]
-    init = key_ceremony_exchange(trustees, g).make_election_initialized(
-        ElectionConfig(manifest, 1, 1), {"created_by": "test"})
-    ballots = list(RandomBallotProvider(manifest, 3, seed=5).ballots())
-    enc = BatchEncryptor(init, g)
-    encrypted, invalid = enc.encrypt_ballots(ballots, seed=g.int_to_q(11))
-    assert not invalid
-    tally_result = accumulate_ballots(init, encrypted)
-    dec = Decryption(
-        g, init,
-        [DecryptingTrustee.from_state(g, trustees[0]
-                                      .decrypting_trustee_state())],
-        [], DLog(g, max_exponent=16))
-    decrypted = dec.decrypt(tally_result.encrypted_tally)
-    dr = DecryptionResult(tally_result, decrypted,
-                          tuple(dec.get_available_guardians()))
-    return dict(group=g, init=init, encrypted=encrypted,
-                tally_result=tally_result, decryption_result=dr)
 
 
 def _record(e, **overrides):
@@ -75,6 +37,7 @@ def _record(e, **overrides):
 
 
 def test_production_record_verifies_fused(pelection):
+    assert sha256_jax.supports(pelection["group"])
     res = Verifier(_record(pelection), pelection["group"]).verify()
     assert res.ok, res.summary()
     assert res.checks["V4.selection_proofs"]
