@@ -331,7 +331,8 @@ def powmod(ctx: MontCtx, base: jax.Array, exp: jax.Array,
 
 def mont_multi_pow_shared(ctx: MontCtx, base_mont: jax.Array,
                           exps: jax.Array, exp_bits: int,
-                          montmul_fn=None, montsqr_fn=None) -> jax.Array:
+                          montmul_fn=None, montsqr_fn=None,
+                          montmul_shared_fn=None) -> jax.Array:
     """k exponents on ONE shared base, Montgomery domain, batched.
 
     base_mont: (B, n) Montgomery-domain bases; exps: (B, k, ne) 16-bit
@@ -358,6 +359,11 @@ def mont_multi_pow_shared(ctx: MontCtx, base_mont: jax.Array,
         return mul(a.reshape(B * k, n), b.reshape(B * k, n)).reshape(
             B, k, n)
 
+    if montmul_shared_fn is None:  # generic: broadcast the shared base
+        def montmul_shared_fn(sel, base):
+            return mul_bk(sel, jnp.broadcast_to(base[:, None, :],
+                                                (B, k, n)))
+
     # window digits, LSB-first: (nwin, B, k)
     widx = jnp.arange(nwin)
     limb = exps[..., widx // 4]                    # (B, k, nwin)
@@ -370,8 +376,7 @@ def mont_multi_pow_shared(ctx: MontCtx, base_mont: jax.Array,
         base_cur, buckets = carry                  # (B,n), (B,k,16,n)
         sel = jnp.take_along_axis(
             buckets, d[..., None, None], axis=2)[..., 0, :]  # (B,k,n)
-        prod = mul_bk(sel, jnp.broadcast_to(base_cur[:, None, :],
-                                            (B, k, n)))
+        prod = montmul_shared_fn(sel, base_cur)
         onehot = jnp.arange(16)[None, None, :] == d[..., None]  # (B,k,16)
         buckets = jnp.where(onehot[..., None], prod[:, :, None, :], buckets)
         for _ in range(4):
@@ -393,7 +398,7 @@ def mont_multi_pow_shared(ctx: MontCtx, base_mont: jax.Array,
 
 def multi_powmod_shared(ctx: MontCtx, base: jax.Array, exps: jax.Array,
                         exp_bits: int, montmul_fn=None,
-                        montsqr_fn=None) -> jax.Array:
+                        montsqr_fn=None, montmul_shared_fn=None) -> jax.Array:
     """Canonical-domain base^exps for k exponents per shared base:
     base (B, n), exps (B, k, ne) -> (B, k, n)."""
     mul = montmul_fn if montmul_fn is not None else \
@@ -401,7 +406,8 @@ def multi_powmod_shared(ctx: MontCtx, base: jax.Array, exps: jax.Array,
     base_mont = mul(base, jnp.broadcast_to(ctx.r2_mod_p, base.shape))
     acc = mont_multi_pow_shared(ctx, base_mont, exps, exp_bits,
                                 montmul_fn=montmul_fn,
-                                montsqr_fn=montsqr_fn)
+                                montsqr_fn=montsqr_fn,
+                                montmul_shared_fn=montmul_shared_fn)
     return from_mont_via(
         lambda a, b: mul(a.reshape(-1, base.shape[-1]),
                          b.reshape(-1, base.shape[-1])).reshape(a.shape),

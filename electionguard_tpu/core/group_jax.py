@@ -152,9 +152,13 @@ class JaxGroupOps:
             nctx = ntt_mxu.make_ntt_ctx(p)
             self._mm = functools.partial(ntt_mxu.montmul, nctx)
             self._ms = functools.partial(ntt_mxu.montsqr, nctx)
+            # bucket multiplies share their base operand's forward NTT
+            self._mm_shared = functools.partial(ntt_mxu.montmul_shared,
+                                                nctx)
         else:
             self._mm = functools.partial(bn.montmul, self.ctx)
             self._ms = None
+            self._mm_shared = None
         R = 1 << (16 * self.n)
         self._R = R
 
@@ -236,7 +240,8 @@ class JaxGroupOps:
                            exps: jax.Array) -> jax.Array:
         return bn.multi_powmod_shared(self.ctx, base, exps, self.exp_bits,
                                       montmul_fn=self._mm,
-                                      montsqr_fn=self._ms)
+                                      montsqr_fn=self._ms,
+                                      montmul_shared_fn=self._mm_shared)
 
     def _prod_reduce_impl(self, x: jax.Array) -> jax.Array:
         """Product over axis 0 of (M, B, n) canonical values -> (B, n),

@@ -243,10 +243,15 @@ def _mredc16(x: jax.Array, m: int, mprime: int) -> jax.Array:
 def _digits_to_limbs(d: jax.Array) -> jax.Array:
     """Nonneg redundant base-256 coeffs (..., L) u32 (< 2^25) -> canonical
     16-bit limbs (..., L/2).  Carries beyond limb L/2 are dropped (callers
-    either prove them zero or want mod 2^(8L))."""
-    # four ripple passes: < 2^25 -> <= 255+2^17 -> <= 768 -> <= 258 -> <= 256
-    for _ in range(4):
-        d = (d & U32(0xFF)) + bn._shift_up(d >> 8)
+    either prove them zero or want mod 2^(8L)).
+
+    One ripple pass bounds digits by 255 + 2^17; the pair-combine then
+    stays below 2^17.01 + 256·2^17.01 < 2^25.2, inside ``normalize``'s
+    < 2^32 input domain — the carry/CRT glue between matmuls is the
+    measured hot path, so every avoided (B, 1028) elementwise pass counts
+    (three of the four ripple passes this replaces were redundant with
+    normalize's own carry resolution)."""
+    d = (d & U32(0xFF)) + bn._shift_up(d >> 8)   # < 255 + 2^17
     z = d[..., 0::2] + (d[..., 1::2] << 8)       # redundant base 2^16
     return bn.normalize(z)
 
@@ -347,6 +352,24 @@ def montmul(ctx: NttCtx, a: jax.Array, b: jax.Array) -> jax.Array:
     that = [_mredc16(ah[t] * bh[t], ctx.m[t], ctx.mprime[t])
             for t in range(2)]
     return _mont_reduce(ctx, _interp_crt(ctx, that)).reshape(shape)
+
+
+def montmul_shared(ctx: NttCtx, sel: jax.Array, base: jax.Array) -> jax.Array:
+    """(B, k, NL) × (B, NL) Montgomery products sel[:, j]·base.
+
+    The shared operand is forward-NTT'd ONCE and its evaluations
+    broadcast across k — the bucket multiply of the Yao multi-exp ladder
+    (bignum_jax.mont_multi_pow_shared) multiplies all k buckets by the
+    same running base, so this saves a full forward NTT (4 MXU matmuls +
+    the digit glue) on (B·(k-1)) rows per window."""
+    B, k, n = sel.shape
+    sh = _eval(ctx, _limbs_to_e(sel.reshape(B * k, n), NC))
+    bh = _eval(ctx, _limbs_to_e(base, NC))
+    that = [_mredc16(
+        sh[t] * jnp.broadcast_to(bh[t][:, None, :],
+                                 (B, k, NC)).reshape(B * k, NC),
+        ctx.m[t], ctx.mprime[t]) for t in range(2)]
+    return _mont_reduce(ctx, _interp_crt(ctx, that)).reshape(B, k, n)
 
 
 def montsqr(ctx: NttCtx, a: jax.Array) -> jax.Array:

@@ -172,10 +172,13 @@ class FusedVerifier:
         r2 = jnp.broadcast_to(ctx.r2_mod_p, A.shape)
         exps = jnp.stack([jnp.broadcast_to(self._q_limbs, c0.shape),
                           c0, c1], axis=1)
+        mm_sh = ops._mm_shared
         pa = bn.mont_multi_pow_shared(ctx, mm(A, r2), exps, ops.exp_bits,
-                                      montmul_fn=mm, montsqr_fn=ms)
+                                      montmul_fn=mm, montsqr_fn=ms,
+                                      montmul_shared_fn=mm_sh)
         pb = bn.mont_multi_pow_shared(ctx, mm(B, r2), exps, ops.exp_bits,
-                                      montmul_fn=mm, montsqr_fn=ms)
+                                      montmul_fn=mm, montsqr_fn=ms,
+                                      montmul_shared_fn=mm_sh)
         one_m = jnp.broadcast_to(ctx.r_mod_p, A.shape)
         ok_sub = (jnp.all(pa[:, 0] == one_m, axis=-1)
                   & jnp.all(pb[:, 0] == one_m, axis=-1))

@@ -130,3 +130,40 @@ def test_noncanonical_input_canonicalized(pgroup, nctx):
     got = bn.limbs_to_ints(
         np.asarray(nt.montmul(nctx, A, nctx.mctx.r2_mod_p)))
     assert got == [x * R % g.p for x in xs]
+
+
+def test_montmul_shared_matches_montmul(pgroup, nctx):
+    """The shared-base bucket multiply (one forward NTT for the base,
+    evaluations broadcast across k) must equal k independent montmuls."""
+    g = pgroup
+    elems = _rand_elems(g, 8, seed=3)
+    sel = jnp.asarray(bn.ints_to_limbs(elems[:6], nt.NL)).reshape(2, 3,
+                                                                  nt.NL)
+    base = jnp.asarray(bn.ints_to_limbs(elems[6:], nt.NL))
+    got = np.asarray(nt.montmul_shared(nctx, sel, base))
+    for b in range(2):
+        for j in range(3):
+            want = np.asarray(nt.montmul(nctx, sel[b, j][None],
+                                         base[b][None]))[0]
+            np.testing.assert_array_equal(got[b, j], want)
+
+
+def test_multi_powmod_shared_ntt_backend(pgroup):
+    """multi_powmod_shared through the NTT backend (with the shared-base
+    NTT hook) must match host pow; reduced exp width keeps CPU time sane."""
+    g = pgroup
+    ops = JaxGroupOps(g, backend="ntt")
+    rng = np.random.default_rng(5)
+    bases = [pow(g.g, int.from_bytes(rng.bytes(32), "big") % g.q, g.p)
+             for _ in range(2)]
+    exps = [[int.from_bytes(rng.bytes(2), "big") for _ in range(3)]
+            for _ in range(2)]
+    B = jnp.asarray(ops.to_limbs_p(bases))
+    E = jnp.asarray(np.stack([ops.to_limbs_q(row) for row in exps]))
+    got = bn.multi_powmod_shared(ops.ctx, B, E, 16, montmul_fn=ops._mm,
+                                 montsqr_fn=ops._ms,
+                                 montmul_shared_fn=ops._mm_shared)
+    got_ints = np.asarray(got).reshape(6, ops.n)
+    for i, want in enumerate(pow(b, e, g.p) for b, row in zip(bases, exps)
+                             for e in row):
+        assert bn.limbs_to_int(got_ints[i]) == want
