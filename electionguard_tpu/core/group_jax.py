@@ -150,21 +150,27 @@ class JaxGroupOps:
             self.backend = "cios"
         if self.backend == "ntt":
             nctx = ntt_mxu.make_ntt_ctx(p)
+            self._nctx = nctx
             self._mm = functools.partial(ntt_mxu.montmul, nctx)
             self._ms = functools.partial(ntt_mxu.montsqr, nctx)
             # bucket multiplies share their base operand's forward NTT
             self._mm_shared = functools.partial(ntt_mxu.montmul_shared,
                                                 nctx)
+            # fixed-base ladders multiply by pre-evaluated table rows
+            self._mm_hat = functools.partial(ntt_mxu.montmul_hat, nctx)
         else:
+            self._nctx = None
             self._mm = functools.partial(bn.montmul, self.ctx)
             self._ms = None
             self._mm_shared = None
+            self._mm_hat = None
         R = 1 << (16 * self.n)
         self._R = R
 
         # fixed-base tables for g and (lazily) other bases: 8-bit windows
         self.nwin8 = (self.exp_bits + 7) // 8
         self._fixed_tables: dict[int, jax.Array] = {}
+        self._fixed_tables_hat: dict[int, jax.Array] = {}
         self.g_table = self.fixed_table(group.g)  # registered: base g
         # cache hits for later fixed_table(g.g) callers
 
@@ -213,6 +219,30 @@ class JaxGroupOps:
         if t is None:
             t = self._make_fixed_table(base)
             self._fixed_tables[base] = t
+        return t
+
+    _HAT_CACHE_MAX = 4  # g, g^-1, K + one spare; ~64 MiB of HBM each
+
+    def fixed_table_hat(self, base: int):
+        """NTT-evaluated twin of ``fixed_table``: (nwin8, 256, 2, NC)
+        uint32 forward evaluations of every table row (ntt backend only;
+        None otherwise).  8x the plain table's memory — lets the
+        fixed-base ladder skip the table operand's forward NTT in every
+        window (ntt_mxu.montmul_hat).  Cache is FIFO-bounded: a
+        long-lived process serving many elections (many keys K) must not
+        accrete 64 MiB of HBM per key; evicted tables rebuild in one
+        device pass."""
+        if self._nctx is None:
+            return None
+        t = self._fixed_tables_hat.get(base)
+        if t is None:
+            plain = self.fixed_table(base)
+            hat = ntt_mxu.nttfwd(self._nctx, plain.reshape(-1, self.n))
+            t = hat.reshape(self.nwin8, 256, 2, ntt_mxu.NC)
+            while len(self._fixed_tables_hat) >= self._HAT_CACHE_MAX:
+                self._fixed_tables_hat.pop(
+                    next(iter(self._fixed_tables_hat)))
+            self._fixed_tables_hat[base] = t
         return t
 
     def _fixed_pow_impl(self, table: jax.Array, exp: jax.Array) -> jax.Array:

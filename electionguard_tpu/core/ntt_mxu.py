@@ -372,6 +372,27 @@ def montmul_shared(ctx: NttCtx, sel: jax.Array, base: jax.Array) -> jax.Array:
     return _mont_reduce(ctx, _interp_crt(ctx, that)).reshape(B, k, n)
 
 
+def nttfwd(ctx: NttCtx, a: jax.Array) -> jax.Array:
+    """(B, NL) canonical limbs -> (B, 2, NC) uint32 forward-NTT
+    evaluations (one row per prime) — the precomputable half of a
+    montmul, used to store PowRadix tables in the evaluated domain."""
+    ah = _eval(ctx, _limbs_to_e(a, NC))
+    return jnp.stack(ah, axis=1)
+
+
+def montmul_hat(ctx: NttCtx, a: jax.Array, bh: jax.Array) -> jax.Array:
+    """Montgomery product of a (B, NL) canonical limbs with a
+    PRE-EVALUATED operand bh (B, 2, NC) (from ``nttfwd``).  Skips the
+    second operand's forward NTT entirely — 4 of a montmul's 16 MXU
+    matmuls plus its digit glue — which is what makes NTT-domain
+    fixed-base tables pay: the table row's evaluation is computed once
+    at table build, not once per ladder step."""
+    ah = _eval(ctx, _limbs_to_e(a, NC))
+    that = [_mredc16(ah[t] * bh[..., t, :], ctx.m[t], ctx.mprime[t])
+            for t in range(2)]
+    return _mont_reduce(ctx, _interp_crt(ctx, that))
+
+
 def montsqr(ctx: NttCtx, a: jax.Array) -> jax.Array:
     """Batched Montgomery square (one forward NTT instead of two)."""
     shape = a.shape

@@ -235,13 +235,12 @@ class BatchEncryptor:
             from electionguard_tpu.encrypt.fused import get_fused_encryptor
             fe = get_fused_encryptor(eo, ee, self.mesh)
             seed_row = np.frombuffer(seed.to_bytes(), np.uint8)
-            k_table = eo.fixed_table(self.K.value)
             alpha, beta, R_l, CR_l, VR_l, CF_l, VF_l = \
                 fe.encrypt_selections(
                     seed_row,
                     bid_digests[np.asarray(flat.ballot_idx, np.int64)],
                     np.asarray(sel_ord, np.uint32), votes,
-                    k_table, _encode(self.qbar))
+                    self.K.value, _encode(self.qbar))
             # per-contest ΣR mod q from the nonce limbs: unsorted-safe
             # segment sum (a contest with zero selection rows — possible
             # only for an unvalidated votes_allowed=0 manifest — still
@@ -262,7 +261,7 @@ class BatchEncryptor:
                 ix = np.asarray(idxs)
                 a_g, b_g, c2_g, v2_g = fe.encrypt_contests(
                     seed_row, bids_con[ix], ords_con[ix],
-                    RS_l[ix], VS_l[ix], k_table,
+                    RS_l[ix], VS_l[ix], self.K.value,
                     _encode(self.qbar) + _encode(limit))
                 A_c[ix], B_c[ix] = a_g, b_g
                 C2_l[ix], V2_l[ix] = c2_g, v2_g

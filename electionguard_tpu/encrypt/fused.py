@@ -39,7 +39,7 @@ from electionguard_tpu.core import sha256_jax
 from electionguard_tpu.core.group_jax import (JaxExponentOps, JaxGroupOps,
                                               run_tiled_multi)
 from electionguard_tpu.verify.fused import (challenge_rows, fixed_pow_mont,
-                                            limbs_to_bytes_j)
+                                            k_tables, limbs_to_bytes_j)
 
 _P_HDR = np.frombuffer(sha256_jax._TAG_P_HDR, np.uint8)
 
@@ -77,6 +77,8 @@ class FusedEncryptor:
         self._hdr = jnp.asarray(_P_HDR)
         self._g_mont = jnp.asarray(
             bn.int_to_limbs(g.g * ops._R % g.p, ops.n))
+        # NTT-evaluated table twins (None on the cios backend)
+        self._g_hat = ops.fixed_table_hat(g.g)
         if mesh is None:
             self.ndp = 1
             self._sel_j = jax.jit(self._sel_impl)
@@ -86,14 +88,12 @@ class FusedEncryptor:
             from electionguard_tpu.verify.fused import shard_rows
             self.ndp = mesh.shape[DP_AXIS]
             self._sel_j = jax.jit(
-                shard_rows(self._sel_impl, mesh, 3, 3, n_out=7))
+                shard_rows(self._sel_impl, mesh, 3, 4, n_out=7))
             self._con_j = jax.jit(
-                shard_rows(self._con_impl, mesh, 4, 3, n_out=4))
+                shard_rows(self._con_impl, mesh, 4, 4, n_out=4))
+
 
     # -- shared helpers (device) ---------------------------------------
-    def _fixed_pow_mont(self, table, exp):
-        return fixed_pow_mont(self.ops, table, exp)
-
     def _challenge(self, prefix_row, elem_bytes):
         return challenge_rows(self._hdr, self._q_limbs, prefix_row,
                               elem_bytes)
@@ -112,7 +112,8 @@ class FusedEncryptor:
                                         self._q_limbs)
 
     # -- selections ----------------------------------------------------
-    def _sel_impl(self, bids, ords, votes, seed_row, k_table, prefix_row):
+    def _sel_impl(self, bids, ords, votes, seed_row, k_table, k_hat,
+                  prefix_row):
         """One dispatch for a tile of selections.
 
         α = g^R, β = K^R g^v; real commitments a=g^U, b=K^U; fake branch
@@ -135,9 +136,10 @@ class FusedEncryptor:
         v1 = (votes == 1)[:, None]
         Sx = jnp.where(v1, CF, negCF)
 
-        gp = self._fixed_pow_mont(ops.g_table,
-                                  jnp.concatenate([R, U, W, Sx]))
-        kp = self._fixed_pow_mont(k_table, jnp.concatenate([R, U, W]))
+        gp = fixed_pow_mont(ops, ops.g_table,
+                            jnp.concatenate([R, U, W, Sx]), self._g_hat)
+        kp = fixed_pow_mont(ops, k_table, jnp.concatenate([R, U, W]),
+                            k_hat)
         alpha_m, a_real_m, a_fake_m, gS_m = (
             gp[:t], gp[t:2 * t], gp[2 * t:3 * t], gp[3 * t:])
         betak_m, b_real_m, kW_m = kp[:t], kp[t:2 * t], kp[2 * t:]
@@ -162,24 +164,26 @@ class FusedEncryptor:
 
     def encrypt_selections(self, seed_row: np.ndarray, bids: np.ndarray,
                            ords: np.ndarray, votes: np.ndarray,
-                           k_table, prefix: bytes):
+                           K: int, prefix: bytes):
         """Host entry: (S,32) identity digests + ordinals + votes ->
         [α, β, R, c_real, v_real, c_fake, v_fake] np arrays via the
-        shared tiling policy."""
+        shared tiling policy.  ``K`` is the election public key."""
         from electionguard_tpu.verify.fused import pad_to_dp
+        k_table, k_hat = k_tables(self.ops, K)
         prefix_row = jnp.asarray(np.frombuffer(prefix, np.uint8))
         seed_j = jnp.asarray(seed_row)
         arrays, n = pad_to_dp(
             [bids, ords.astype(np.uint32), votes.astype(np.int32)],
             self.ndp)
         outs = run_tiled_multi(
-            lambda b, o, v: self._sel_j(b, o, v, seed_j, k_table,
+            lambda b, o, v: self._sel_j(b, o, v, seed_j, k_table, k_hat,
                                         prefix_row),
             arrays, [False, False, False])
         return [np.asarray(o)[:n] for o in outs]
 
     # -- contests ------------------------------------------------------
-    def _con_impl(self, bids, ords, RS, VS, seed_row, k_table, prefix_row):
+    def _con_impl(self, bids, ords, RS, VS, seed_row, k_table, k_hat,
+                  prefix_row):
         """One dispatch for a tile of contests sharing one vote limit:
         A = g^ΣR, B = g^ΣV K^ΣR, a = g^{U₂}, b = K^{U₂};
         c₂ = H(Q̄, L, A, B, a, b); v₂ = U₂ - c₂ ΣR.
@@ -189,9 +193,10 @@ class FusedEncryptor:
         t = bids.shape[0]
         U2 = self._nonce_mod_q(seed_row,
                                jnp.full((t,), 4, jnp.uint32), bids, ords)
-        gp = self._fixed_pow_mont(ops.g_table,
-                                  jnp.concatenate([RS, U2, VS]))
-        kp = self._fixed_pow_mont(k_table, jnp.concatenate([RS, U2]))
+        gp = fixed_pow_mont(ops, ops.g_table,
+                            jnp.concatenate([RS, U2, VS]), self._g_hat)
+        kp = fixed_pow_mont(ops, k_table, jnp.concatenate([RS, U2]),
+                            k_hat)
         A_m, a_m, gV_m = gp[:t], gp[t:2 * t], gp[2 * t:]
         B_m = mm(gV_m, kp[:t])
         b_m = kp[t:2 * t]
@@ -204,16 +209,17 @@ class FusedEncryptor:
 
     def encrypt_contests(self, seed_row: np.ndarray, bids: np.ndarray,
                          ords: np.ndarray, RS_l: np.ndarray,
-                         VS_l: np.ndarray, k_table, prefix: bytes):
+                         VS_l: np.ndarray, K: int, prefix: bytes):
         """Host entry for one vote-limit group (the limit is encoded in
         ``prefix``): -> [A, B, c₂, v₂] np arrays."""
         from electionguard_tpu.verify.fused import pad_to_dp
+        k_table, k_hat = k_tables(self.ops, K)
         prefix_row = jnp.asarray(np.frombuffer(prefix, np.uint8))
         seed_j = jnp.asarray(seed_row)
         arrays, n = pad_to_dp(
             [bids, ords.astype(np.uint32), RS_l, VS_l], self.ndp)
         outs = run_tiled_multi(
             lambda b, o, rs, vs: self._con_j(b, o, rs, vs, seed_j,
-                                             k_table, prefix_row),
+                                             k_table, k_hat, prefix_row),
             arrays, [False, False, False, False])
         return [np.asarray(o)[:n] for o in outs]

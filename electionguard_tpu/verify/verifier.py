@@ -310,7 +310,7 @@ class Verifier:
             # (S, 2) boolean array comes back, nothing element-sized.
             ok2 = self._fused().v4_selections(
                 A_l, B_l, c0_l, v0_l, c1_l, v1_l,
-                eo.fixed_table(K), _encode(qbar))
+                K, _encode(qbar))
             for i in np.nonzero(~(ok2[:, 0] & in_range))[0]:
                 res.record("V4.selection_proofs", False,
                            f"ciphertext element {sel_refs[int(i)]} not in "
@@ -416,12 +416,11 @@ class Verifier:
             for i, const in enumerate(contest_consts):
                 by_const.setdefault(const, []).append(i)
             fused = self._fused()
-            k_table = eo.fixed_table(K)
             for const, idxs in by_const.items():
                 ix = np.asarray(idxs)
                 ok5 = fused.v5_contests(
                     CA_l[ix], CB_l[ix], Lq_l[ix], cc_l[ix], cv_l[ix],
-                    k_table, _encode(qbar) + _encode(const))
+                    K, _encode(qbar) + _encode(const))
                 for j in np.nonzero(~ok5)[0]:
                     res.record(
                         "V5.contest_limits", False,

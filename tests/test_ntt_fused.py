@@ -1,0 +1,61 @@
+"""NTT-backend fused pipeline differential (the TPU-default engine).
+
+The CI suite runs the fused encrypt/verify programs on the CIOS backend
+(CPU default); the real chip runs them on the MXU NTT engine with
+hat-table fixed-base walks (ntt_mxu.montmul_hat) and the shared-base
+multi-exp (ntt_mxu.montmul_shared).  These tests pin the NTT-backed
+fused programs bit-identical to the CIOS-backed ones, so the engine the
+bench measures is the engine CI verified.
+"""
+
+import numpy as np
+import pytest
+
+from electionguard_tpu.core.group_jax import JaxGroupOps, jax_exp_ops
+from electionguard_tpu.core.hash import _encode
+from electionguard_tpu.encrypt.fused import FusedEncryptor
+from electionguard_tpu.verify.fused import FusedVerifier
+
+pytestmark = pytest.mark.slow
+
+
+def test_ntt_fused_encrypt_verify_matches_cios(pgroup):
+    g = pgroup
+    ee = jax_exp_ops(g)
+    ops_ntt = JaxGroupOps(g, backend="ntt")
+    ops_cios = JaxGroupOps(g, backend="cios")
+    assert ops_ntt.backend == "ntt" and ops_ntt._mm_hat is not None
+    fe_n = FusedEncryptor(ops_ntt, ee)
+    fe_c = FusedEncryptor(ops_cios, ee)
+    rng = np.random.default_rng(9)
+    S = 4
+    seed_row = rng.integers(0, 256, 32, dtype=np.uint8)
+    bids = rng.integers(0, 256, (S, 32), dtype=np.uint8)
+    ords = np.arange(S, dtype=np.uint32)
+    votes = np.array([0, 1, 0, 1], dtype=np.int64)
+    K = pow(g.g, 12345, g.p)
+    prefix = _encode(7)  # stands in for enc(qbar), same on both engines
+
+    out_n = fe_n.encrypt_selections(seed_row, bids, ords, votes, K, prefix)
+    out_c = fe_c.encrypt_selections(seed_row, bids, ords, votes, K, prefix)
+    for a, b in zip(out_n, out_c):
+        np.testing.assert_array_equal(a, b)
+
+    # the NTT-backed fused verifier (hat tables + shared-base multi-exp)
+    # must accept what the NTT-backed fused encryptor produced
+    alpha, beta, _, CR, VR, CF, VF = out_n
+    v1m = (votes == 1)[:, None]
+    ok = FusedVerifier(ops_ntt).v4_selections(
+        alpha, beta,
+        np.where(v1m, CF, CR), np.where(v1m, VF, VR),
+        np.where(v1m, CR, CF), np.where(v1m, VR, VF), K, prefix)
+    assert np.asarray(ok).all()
+
+    con_n = fe_n.encrypt_contests(seed_row, bids[:1], ords[:1],
+                                  ee.to_limbs([5]), ee.to_limbs([1]),
+                                  K, prefix)
+    con_c = fe_c.encrypt_contests(seed_row, bids[:1], ords[:1],
+                                  ee.to_limbs([5]), ee.to_limbs([1]),
+                                  K, prefix)
+    for a, b in zip(con_n, con_c):
+        np.testing.assert_array_equal(a, b)
