@@ -214,10 +214,14 @@ class JaxGroupOps:
             step = acc  # after 256 iters acc = step^256 = base^(2^(8(w+1)))
         return jnp.asarray(rows)
 
+    _TABLE_CACHE_MAX = 16  # 8 MiB each; FIFO like the hat cache
+
     def fixed_table(self, base: int) -> jax.Array:
         t = self._fixed_tables.get(base)
         if t is None:
             t = self._make_fixed_table(base)
+            while len(self._fixed_tables) >= self._TABLE_CACHE_MAX:
+                self._fixed_tables.pop(next(iter(self._fixed_tables)))
             self._fixed_tables[base] = t
         return t
 

@@ -43,3 +43,63 @@ def make_schnorr_proof(group: GroupContext, secret: ElementModQ,
     c = hash_elems(group, public_key, h)
     v = group.sub_q(nonce, group.mult_q(c, secret))
     return SchnorrProof(public_key, c, v)
+
+
+def batch_schnorr_verify(group: GroupContext, proofs,
+                         check_subgroup: bool = False):
+    """Verify B Schnorr proofs in a few device dispatches.
+
+    ``proofs``: sequence of SchnorrProof.  Returns a (B,) bool mask,
+    semantically identical to per-proof ``is_valid``: the key carries
+    exponents {c, q} through ONE shared-base multi-exp (K^c for the
+    commitment recompute; K^q for the subgroup check when
+    ``check_subgroup`` — then the return is a pair of masks
+    ``(proof_ok, subgroup_ok)``), plus one fixed-base pass (g^v), one
+    product, and one batched Fiat–Shamir (device SHA on the production
+    group, host hash_elems otherwise).  The reference verifies these one
+    at a time inside each trustee [ext] (SURVEY.md §3.1 🔥); the
+    verifier's V2 runs the whole ceremony's proofs as one batch.
+    """
+    import numpy as np
+
+    from electionguard_tpu.core import bignum_jax as bn
+    from electionguard_tpu.core import sha256_jax
+    from electionguard_tpu.core.group_jax import (jax_exp_ops, jax_ops,
+                                                  limbs_to_bytes_be)
+
+    B = len(proofs)
+    if B == 0:
+        empty = np.zeros(0, dtype=bool)
+        return (empty, empty) if check_subgroup else empty
+    eo, ee = jax_ops(group), jax_exp_ops(group)
+    k_l = np.asarray(eo.to_limbs_p([p.public_key.value for p in proofs]))
+    c_l = np.asarray(ee.to_limbs([p.challenge.value for p in proofs]))
+    v_l = np.asarray(ee.to_limbs([p.response.value for p in proofs]))
+    if check_subgroup:
+        q_rep = np.broadcast_to(bn.int_to_limbs(group.q, ee.ne),
+                                c_l.shape)
+        pows = np.asarray(eo.multi_powmod(
+            k_l, np.stack([c_l, q_rep], axis=1)))
+        kc, kq = pows[:, 0], pows[:, 1]
+        one = np.zeros_like(kq)
+        one[:, 0] = 1
+        in_range = np.fromiter(
+            (0 < p.public_key.value < group.p for p in proofs),
+            dtype=bool, count=B)
+        sub_ok = in_range & (kq == one).all(axis=1)
+    else:
+        kc = np.asarray(eo.powmod(k_l, c_l))
+    gv = np.asarray(eo.g_pow(v_l))
+    com = np.asarray(eo.mulmod(gv, kc))
+    if sha256_jax.supports(group):
+        chal = np.asarray(sha256_jax.batch_challenge_p(
+            group, b"", [limbs_to_bytes_be(k_l), limbs_to_bytes_be(com)]))
+        ok = (chal == c_l).all(axis=1)
+    else:
+        com_b = limbs_to_bytes_be(com)
+        ok = np.zeros(B, dtype=bool)
+        for i, p in enumerate(proofs):
+            c = hash_elems(group, p.public_key,
+                           group.bytes_to_p(bytes(com_b[i])))
+            ok[i] = (c == p.challenge)
+    return (ok, sub_ok) if check_subgroup else ok

@@ -152,7 +152,13 @@ class Verifier:
                    "guardian count mismatch")
 
     def _v2_guardian_keys(self, res):
+        """Structure host-side; all Schnorr proofs + subgroup checks of
+        the whole ceremony as ONE device batch (batch_schnorr_verify) —
+        the reference verifies them one at a time inside each trustee
+        [ext] (SURVEY.md §3.1)."""
+        from electionguard_tpu.crypto.schnorr import batch_schnorr_verify
         quorum = self.init.config.quorum
+        proofs, refs = [], []
         for gr in self.init.guardians:
             if (len(gr.coefficient_commitments) != quorum
                     or len(gr.coefficient_proofs) != quorum):
@@ -166,13 +172,20 @@ class Verifier:
                 if pr.public_key != k:
                     res.record("V2.guardian_keys", False,
                                f"{gr.guardian_id} proof {j} wrong key")
-                elif not pr.is_valid():
-                    res.record("V2.guardian_keys", False,
-                               f"{gr.guardian_id} Schnorr {j} invalid")
-                elif not k.is_valid_residue():
-                    res.record("V2.guardian_keys", False,
-                               f"{gr.guardian_id} commitment {j} not in "
-                               f"subgroup")
+                    continue
+                proofs.append(pr)
+                refs.append((gr.guardian_id, j))
+        if proofs:
+            ok, sub = batch_schnorr_verify(self.group, proofs,
+                                           check_subgroup=True)
+            for i in np.nonzero(~ok)[0]:
+                gid, j = refs[int(i)]
+                res.record("V2.guardian_keys", False,
+                           f"{gid} Schnorr {j} invalid")
+            for i in np.nonzero(~sub)[0]:
+                gid, j = refs[int(i)]
+                res.record("V2.guardian_keys", False,
+                           f"{gid} commitment {j} not in subgroup")
         res.record("V2.guardian_keys", True)
 
     def _v3_joint_key(self, res):

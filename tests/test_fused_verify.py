@@ -108,3 +108,20 @@ def test_fused_matches_unfused(pelection, monkeypatch):
     f, u = both(record)
     assert f.checks == u.checks
     assert not f.checks["V4.selection_proofs"]
+
+
+def test_batched_schnorr_rejects_tamper_production(pelection):
+    """V2's batched Schnorr verification (device Fiat-Shamir on the
+    production group) must reject a tampered challenge."""
+    g = pelection["group"]
+    init = pelection["init"]
+    gr = init.guardians[0]
+    pr = gr.coefficient_proofs[0]
+    bad_pr = dataclasses.replace(
+        pr, challenge=g.add_q(pr.challenge, g.ONE_MOD_Q))
+    bad_gr = dataclasses.replace(
+        gr, coefficient_proofs=(bad_pr,) + gr.coefficient_proofs[1:])
+    bad_init = dataclasses.replace(
+        init, guardians=(bad_gr,) + init.guardians[1:])
+    res = Verifier(_record(pelection, election_init=bad_init), g).verify()
+    assert not res.checks["V2.guardian_keys"]

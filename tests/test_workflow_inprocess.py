@@ -413,3 +413,25 @@ def test_spoiled_tally_forgery_detected(election):
     res = Verifier(record, g).verify()
     assert not res.ok
     assert not res.checks["V13.spoiled"]
+
+
+def test_verifier_catches_bad_guardian_proof(election):
+    """A tampered guardian Schnorr response must fail V2 through the
+    batched verification path."""
+    import dataclasses
+    g = election["group"]
+    init = election["init"]
+    gr = init.guardians[0]
+    pr = gr.coefficient_proofs[0]
+    bad_pr = dataclasses.replace(
+        pr, response=g.add_q(pr.response, g.ONE_MOD_Q))
+    bad_gr = dataclasses.replace(
+        gr, coefficient_proofs=(bad_pr,) + gr.coefficient_proofs[1:])
+    bad_init = dataclasses.replace(
+        init, guardians=(bad_gr,) + init.guardians[1:])
+    record = ElectionRecord(
+        election_init=bad_init,
+        encrypted_ballots=election["encrypted"],
+        tally_result=election["tally_result"])
+    res = Verifier(record, g).verify()
+    assert not res.checks["V2.guardian_keys"]
