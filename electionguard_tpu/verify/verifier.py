@@ -111,6 +111,28 @@ class Verifier:
         from electionguard_tpu.verify.fused import get_fused
         return get_fused(self.ops, self.mesh)
 
+    def _masked_prod(self, arrays, row_groups):
+        """Π over row groups of (S, n) limb arrays in ONE device
+        product-reduce: gather each group's rows (identity-padded to the
+        widest group), stack every input array's groups, reduce the
+        group axis.  The shared primitive behind V5's contest ciphertext
+        accumulation and V7's tally products.  Returns one
+        (len(row_groups), n) array per input."""
+        nk = len(row_groups)
+        maxm = max(len(ix) for ix in row_groups)
+        gather = np.zeros((nk, maxm), dtype=np.int64)
+        mask = np.zeros((nk, maxm), dtype=bool)
+        for j, ix in enumerate(row_groups):
+            gather[j, :len(ix)] = ix
+            mask[j, :len(ix)] = True
+        one_row = np.zeros((self.ops.n,), np.uint32)
+        one_row[0] = 1
+        gathered = [np.where(mask[..., None], np.asarray(a)[gather],
+                             one_row) for a in arrays]
+        stacked = np.concatenate(gathered).transpose(1, 0, 2)
+        prod = np.asarray(self.ops.prod_reduce(stacked))
+        return [prod[i * nk:(i + 1) * nk] for i in range(len(arrays))]
+
     # ==================================================================
     def verify(self) -> VerificationResult:
         res = VerificationResult()
@@ -215,6 +237,7 @@ class Verifier:
         alphas, betas = [], []
         c0s, v0s, c1s, v1s = [], [], [], []
         sel_refs = []
+        key_rows: dict[tuple, list[int]] = {}  # V7: cast rows per key
         manifest_sels = {(c.object_id, s.object_id)
                          for c in self.init.config.manifest.contests
                          for s in c.selections}
@@ -287,6 +310,12 @@ class Verifier:
                             "V4.selection_proofs", False,
                             f"{b.ballot_id}: selection {s.selection_id} "
                             f"not in manifest contest {c.contest_id}")
+                    if not s.is_placeholder and b.state == BallotState.CAST:
+                        # V7 gathers this row's limbs straight from the
+                        # V4 arrays — no second int->limb conversion
+                        key_rows.setdefault(
+                            (c.contest_id, s.selection_id),
+                            []).append(len(alphas))
                     alphas.append(s.ciphertext.pad.value)
                     betas.append(s.ciphertext.data.value)
                     p = s.proof
@@ -299,7 +328,7 @@ class Verifier:
         S = len(alphas)
         if S == 0:
             res.record("V4.selection_proofs", True)
-            self._chunk_bookkeeping(res, ballots, agg)
+            self._chunk_bookkeeping(res, ballots, agg, None, None, {})
             return
         eo, ee = self.ops, self.eops
         A_l = eo.to_limbs_p(alphas)
@@ -399,23 +428,14 @@ class Verifier:
                                f"constant {c.proof.constant} != "
                                f"{desc.votes_allowed}")
         C = len(contest_refs)
-        # contest ciphertext accumulation Π(α,β) on DEVICE: gather each
-        # contest's selection rows (identity-padded to the widest contest)
-        # and product-reduce — the per-selection host BigInteger loop this
-        # replaces was the verifier's last O(S) host math
-        span = max(cnt for _, cnt in contest_spans)
-        gather = np.zeros((C, span), dtype=np.int64)
-        mask = np.zeros((C, span), dtype=bool)
-        for j, (start, cnt) in enumerate(contest_spans):
-            gather[j, :cnt] = np.arange(start, start + cnt)
-            mask[j, :cnt] = True
-        one_row = np.zeros((eo.n,), np.uint32)
-        one_row[0] = 1
+        # contest ciphertext accumulation Π(α,β) on DEVICE: one masked
+        # gather + product-reduce over the V4 limb arrays — no
+        # per-selection host BigInteger math
         A_np, B_np = np.asarray(A_l), np.asarray(B_l)
-        GA = np.where(mask[..., None], A_np[gather], one_row)
-        GB = np.where(mask[..., None], B_np[gather], one_row)
-        CA_l = np.asarray(eo.prod_reduce(GA.transpose(1, 0, 2)))
-        CB_l = np.asarray(eo.prod_reduce(GB.transpose(1, 0, 2)))
+        CA_l, CB_l = self._masked_prod(
+            [A_np, B_np],
+            [list(range(start, start + cnt))
+             for start, cnt in contest_spans])
         cc_l = np.asarray(ee.to_limbs(contest_cs))
         cv_l = np.asarray(ee.to_limbs(contest_vs))
         if sha256_jax.supports(g):
@@ -465,12 +485,17 @@ class Verifier:
         res.record("V5.contest_limits", True)
 
         # ---- V6 chain + V7/V13 bookkeeping -------------------------------
-        self._chunk_bookkeeping(res, ballots, agg)
+        self._chunk_bookkeeping(res, ballots, agg, A_np, B_np, key_rows)
 
-    def _chunk_bookkeeping(self, res, ballots, agg: _BallotAggregates):
+    def _chunk_bookkeeping(self, res, ballots, agg: _BallotAggregates,
+                           A_np, B_np, key_rows):
         """V6 chaining (continuity carried across chunks via ``agg``) plus
-        V7 product accumulation (one device prod-reduce per chunk) and
-        cast/spoiled counting."""
+        V7 product accumulation and cast/spoiled counting.  ``A_np``/
+        ``B_np`` are the chunk's V4 selection limb arrays and
+        ``key_rows`` maps (contest, selection) -> their cast
+        non-placeholder row indices: V7 gathers straight from the arrays
+        already on hand (one device product-reduce, no per-selection
+        int->limb rebuild)."""
         g = self.group
         from electionguard_tpu.ballot.code_batch import batch_codes
         codes = batch_codes(ballots)   # recomputed hash tree, batched
@@ -498,31 +523,17 @@ class Verifier:
         agg.total_count += len(ballots)
         agg.spoiled_ids.update(b.ballot_id for b in ballots
                                if b.state == BallotState.SPOILED)
-        cast = [b for b in ballots if b.state == BallotState.CAST]
-        agg.cast_count += len(cast)
-        if not cast:
+        agg.cast_count += sum(b.state == BallotState.CAST for b in ballots)
+        if not key_rows:
             return
-        keys = sorted({(c.contest_id, s.selection_id)
-                       for b in cast for c in b.contests
-                       for s in c.selections if not s.is_placeholder})
-        key_idx = {k: i for i, k in enumerate(keys)}
-        nk = len(keys)
-        rows = np.empty((len(cast), 2 * nk), dtype=object)
-        rows[:] = 1
-        for bi, b in enumerate(cast):
-            for c in b.contests:
-                for s in c.selections:
-                    if s.is_placeholder:
-                        continue
-                    i = key_idx[(c.contest_id, s.selection_id)]
-                    rows[bi, i] = s.ciphertext.pad.value
-                    rows[bi, nk + i] = s.ciphertext.data.value
-        arr = np.stack([self.ops.to_limbs_p(list(rows[bi]))
-                        for bi in range(len(cast))])
-        prod = self.ops.from_limbs(np.asarray(self.ops.prod_reduce(arr)))
+        keys = sorted(key_rows)
+        pa_l, pb_l = self._masked_prod([A_np, B_np],
+                                       [key_rows[k] for k in keys])
+        pa_i = self.ops.from_limbs(pa_l)
+        pb_i = self.ops.from_limbs(pb_l)
         for i, k in enumerate(keys):
             pa, pd = agg.prods.get(k, (1, 1))
-            agg.prods[k] = (pa * prod[i] % g.p, pd * prod[nk + i] % g.p)
+            agg.prods[k] = (pa * pa_i[i] % g.p, pd * pb_i[i] % g.p)
 
     # ==================================================================
     def _v7_aggregation(self, res, agg: _BallotAggregates):
