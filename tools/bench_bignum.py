@@ -37,6 +37,8 @@ def main() -> int:
     args = ap.parse_args()
     B = args.batch
     which = set(args.ops.split(","))
+    from electionguard_tpu.utils import enable_compile_cache
+    enable_compile_cache()
 
     from electionguard_tpu.core import bignum_jax as bn
     from electionguard_tpu.core.group import production_group
@@ -73,6 +75,42 @@ def main() -> int:
         dt = _timeit(ops._verify_residue_j, A, q_exp)
         print(f"residue: {dt*1e3:8.2f} ms  "
               f"{B/dt:12.0f} el/s  {dt/B*1e6:8.1f} us/el")
+    if "fused" in which:
+        # the production pipelines: fused selection encryption and fused
+        # V4 verification, rows/s at this batch shape (selection rows;
+        # /3 for ballots at 2 selections + 1 placeholder)
+        from electionguard_tpu.core.group_jax import jax_exp_ops
+        from electionguard_tpu.core.hash import _encode
+        from electionguard_tpu.encrypt.fused import get_fused_encryptor
+        from electionguard_tpu.verify.fused import get_fused
+
+        fe = get_fused_encryptor(ops, jax_exp_ops(g))
+        fv = get_fused(ops)
+        K = bases[0]
+        prefix = _encode(7)
+        seed_row = np.zeros(32, np.uint8)
+        bids = rng.integers(0, 256, (B, 32), dtype=np.uint8)
+        ords = np.arange(B, dtype=np.uint32)
+        votes = (np.arange(B) % 2).astype(np.int64)
+        alpha, beta, _, CR, VR, CF, VF = fe.encrypt_selections(
+            seed_row, bids, ords, votes, K, prefix)  # warm-up + outputs
+        dt = _timeit(lambda: fe.encrypt_selections(
+            seed_row, bids, ords, votes, K, prefix))
+        print(f"enc-sel: {dt*1e3:8.2f} ms  "
+              f"{B/dt:12.0f} row/s  {dt/B*1e6:8.1f} us/row")
+        v1m = (votes == 1)[:, None]
+        c0 = np.where(v1m, CF, CR)
+        v0 = np.where(v1m, VF, VR)
+        c1 = np.where(v1m, CR, CF)
+        v1_ = np.where(v1m, VR, VF)
+        ok = np.asarray(fv.v4_selections(
+            alpha, beta, c0, v0, c1, v1_, K, prefix))
+        assert ok.all(), "fused V4 rejected fused-encrypted rows — " \
+            "refusing to time a broken pipeline"
+        dt = _timeit(lambda: fv.v4_selections(
+            alpha, beta, c0, v0, c1, v1_, K, prefix))
+        print(f"ver-v4 : {dt*1e3:8.2f} ms  "
+              f"{B/dt:12.0f} row/s  {dt/B*1e6:8.1f} us/row")
     return 0
 
 
