@@ -54,11 +54,16 @@ def _write_frame(f, data: bytes):
     f.write(data)
 
 
-def _read_frames(path: str) -> Iterator[bytes]:
+def _read_frames_slice(path: str, offset: int = 0,
+                       count: int | None = None) -> Iterator[bytes]:
+    """Decode frames from ``offset``: exactly ``count`` of them, or to
+    EOF when ``count`` is None — the ONE definition of the framing."""
     with open(path, "rb") as f:
-        while True:
+        f.seek(offset)
+        remaining = count
+        while remaining is None or remaining > 0:
             hdr = f.read(4)
-            if not hdr:
+            if not hdr and remaining is None:
                 return
             if len(hdr) != 4:
                 raise IOError(f"truncated frame header in {path}")
@@ -67,6 +72,45 @@ def _read_frames(path: str) -> Iterator[bytes]:
             if len(data) != n:
                 raise IOError(f"truncated frame in {path}")
             yield data
+            if remaining is not None:
+                remaining -= 1
+
+
+def _read_frames(path: str) -> Iterator[bytes]:
+    return _read_frames_slice(path)
+
+
+def scan_frame_shards(path: str,
+                      n_shards: int) -> list[tuple[int, int, int]]:
+    """Split a framed stream into ≤ n_shards contiguous ``(byte_offset,
+    frame_count, last_frame_offset)`` slices by reading only the 4-byte
+    length headers — file-offset slicing, no payload decode (README
+    §Scaling model: the election record is a framed stream, so sharding
+    it across feeder processes is offset arithmetic).  The last-frame
+    offset lets a coordinator decode exactly ONE boundary ballot per
+    shard (its confirmation code seeds the next feeder's V6 chain)."""
+    offsets: list[int] = []
+    with open(path, "rb") as f:
+        pos = 0
+        while True:
+            hdr = f.read(4)
+            if not hdr:
+                break
+            if len(hdr) != 4:
+                raise IOError(f"truncated frame header in {path}")
+            (n,) = struct.unpack(">I", hdr)
+            offsets.append(pos)
+            pos += 4 + n
+            f.seek(pos)
+    total = len(offsets)
+    if total == 0:
+        return []
+    per = -(-total // n_shards)  # ceil
+    return [(offsets[i], min(per, total - i),
+             offsets[min(i + per, total) - 1])
+            for i in range(0, total, per)]
+
+
 
 
 class Publisher:
@@ -173,8 +217,25 @@ class Consumer:
     def iterate_encrypted_ballots(self) -> Iterator[EncryptedBallot]:
         path = self._path(_BALLOTS)
         if not os.path.exists(path):
-            return
-        for frame in _read_frames(path):
+            return iter(())
+        return self.iterate_encrypted_ballots_slice(0, None)
+
+    def ballot_shards(self, n_shards: int) -> list[tuple[int, int, int]]:
+        """Contiguous (byte_offset, count, last_frame_offset) slices of
+        the encrypted-ballot stream for ≤ n_shards feeder processes
+        (header scan only)."""
+        path = self._path(_BALLOTS)
+        if not os.path.exists(path):
+            return []
+        return scan_frame_shards(path, n_shards)
+
+    def iterate_encrypted_ballots_slice(
+            self, offset: int,
+            count: int | None) -> Iterator[EncryptedBallot]:
+        """Decode one feeder's slice (from ``ballot_shards``); count=None
+        reads to EOF."""
+        for frame in _read_frames_slice(self._path(_BALLOTS), offset,
+                                        count):
             m = pb.EncryptedBallot()
             m.ParseFromString(frame)
             yield serialize.import_encrypted_ballot(self.group, m)

@@ -136,16 +136,66 @@ class Verifier:
     # ==================================================================
     def verify(self) -> VerificationResult:
         res = VerificationResult()
-        self._v1_parameters(res)
-        self._v2_guardian_keys(res)
-        self._v3_joint_key(res)
         agg = _BallotAggregates()
-        it = iter(self.record.encrypted_ballots)
+        self.verify_ballots_partial(self.record.encrypted_ballots,
+                                    res, agg)
+        return self.finalize(res, agg)
+
+    # -- the three phases of a (possibly multi-feeder) verification ----
+    def verify_ballots_partial(self, ballots, res: VerificationResult,
+                               agg: _BallotAggregates,
+                               prev_code: Optional[bytes] = None) -> None:
+        """Run the per-ballot checks (V4/V5/V6 + V7/V13 bookkeeping)
+        over ``ballots`` — one contiguous slice of the record stream.
+        ``prev_code`` seeds the V6 chain for a feeder starting mid-record
+        (the preceding ballot's confirmation code); None means this slice
+        starts the chain and must anchor to the manifest.  Feeders run
+        this independently over disjoint slices; ``merge_partials`` then
+        recombines their (res, agg) pairs."""
+        if prev_code is not None:
+            agg.prev_code = prev_code
+        it = iter(ballots)
         while True:
             chunk = list(itertools.islice(it, self.chunk_size))
             if not chunk:
                 break
             self._verify_ballot_chunk(res, chunk, agg)
+
+    @staticmethod
+    def merge_partials(parts) -> tuple[VerificationResult,
+                                       _BallotAggregates]:
+        """Combine feeders' (res, agg) pairs: checks AND together, V7
+        products multiply (the tally product tree is associative), counts
+        and spoiled sets add.  Feeders must cover disjoint contiguous
+        slices in record order, each seeded with its predecessor's
+        boundary code."""
+        res = VerificationResult()
+        agg = _BallotAggregates()
+        for r, a in parts:
+            for k, v in r.checks.items():
+                res.checks[k] = res.checks.get(k, True) and v
+            res.errors.extend(r.errors)
+            for k, (pa, pb) in a.prods.items():
+                x, y = agg.prods.get(k, (1, 1))
+                agg.prods[k] = (x * pa, y * pb)
+            agg.cast_count += a.cast_count
+            agg.total_count += a.total_count
+            agg.spoiled_ids |= a.spoiled_ids
+            agg.prev_code = a.prev_code
+        return res, agg
+
+    def finalize(self, res: VerificationResult,
+                 agg: _BallotAggregates) -> VerificationResult:
+        """The record-level checks that need the WHOLE record's
+        aggregates: group/key/guardian checks, V7 against the tally,
+        decryption share checks, spoiled tallies, coherence."""
+        # reduce merged products mod p (merge_partials multiplies raw)
+        g = self.group
+        agg.prods = {k: (pa % g.p, pb % g.p)
+                     for k, (pa, pb) in agg.prods.items()}
+        self._v1_parameters(res)
+        self._v2_guardian_keys(res)
+        self._v3_joint_key(res)
         if self.record.tally_result is not None:
             self._v7_aggregation(res, agg)
         if self.record.decryption_result is not None:

@@ -40,6 +40,51 @@ def pgroup():
 
 
 @pytest.fixture(scope="session")
+def election():
+    """Full workflow artifacts on the tiny group, 3 guardians quorum 2
+    (shared; tests must not mutate — use dataclasses.replace copies)."""
+    from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+    from electionguard_tpu.core.dlog import DLog
+    from electionguard_tpu.core.group import tiny_group
+    from electionguard_tpu.decrypt.decryption import Decryption
+    from electionguard_tpu.decrypt.trustee import DecryptingTrustee
+    from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+    from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+    from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+    from electionguard_tpu.publish.election_record import (DecryptionResult,
+                                                           ElectionConfig)
+    from electionguard_tpu.tally.accumulate import accumulate_ballots
+    from tests.test_keyceremony import tiny_manifest
+
+    g = tiny_group()
+    manifest = tiny_manifest()
+    trustees = [KeyCeremonyTrustee(g, f"guardian-{i}", i + 1, 2)
+                for i in range(3)]
+    results = key_ceremony_exchange(trustees, g)
+    init = results.make_election_initialized(
+        ElectionConfig(manifest, 3, 2), {"created_by": "test"})
+
+    ballots = list(RandomBallotProvider(manifest, 20, seed=7).ballots())
+    enc = BatchEncryptor(init, g)
+    encrypted, invalid = enc.encrypt_ballots(ballots, seed=g.int_to_q(99))
+    assert not invalid
+
+    tally_result = accumulate_ballots(init, encrypted)
+
+    dec_trustees = [DecryptingTrustee.from_state(
+        g, t.decrypting_trustee_state()) for t in trustees]
+    decryption = Decryption(g, init, dec_trustees[:2],
+                            [dec_trustees[2].id], DLog(g, max_exponent=100))
+    decrypted = decryption.decrypt(tally_result.encrypted_tally)
+    dr = DecryptionResult(
+        tally_result, decrypted,
+        tuple(decryption.get_available_guardians()))
+    return dict(group=g, manifest=manifest, init=init, ballots=ballots,
+                encrypted=encrypted, tally_result=tally_result,
+                decryption_result=dr, trustees=trustees)
+
+
+@pytest.fixture(scope="session")
 def pelection(pgroup):
     """Small full-workflow record on the PRODUCTION group (1 guardian,
     quorum 1, 3 ballots, 1 contest x 2 selections), shared by every

@@ -1,0 +1,140 @@
+"""Multi-feeder record verification: N processes over file-offset
+slices of the framed ballot stream (README §Scaling model — the
+process-parallel replacement for the reference's 11-thread
+``Verifier(record, nthreads)``, RunRemoteWorkflowTest.java:180).
+
+Pins: header-only shard scanning, slice iteration, partial/merge/
+finalize equivalence with the single-pass verifier, V6 chain continuity
+across a shard boundary (seeded by the boundary ballot's code), and the
+``run_verifier -feeders N`` CLI end-to-end including tamper rejection.
+"""
+
+import os
+import struct
+import subprocess
+import sys
+
+import pytest
+
+from electionguard_tpu.publish.election_record import ElectionRecord
+from electionguard_tpu.publish.publisher import (Consumer, Publisher,
+                                                 scan_frame_shards)
+from electionguard_tpu.verify.verifier import (Verifier,
+                                               VerificationResult,
+                                               _BallotAggregates)
+
+
+@pytest.fixture()
+def record_dir(election, tmp_path):
+    out = str(tmp_path / "record")
+    pub = Publisher(out)
+    pub.write_election_initialized(election["init"])
+    pub.write_encrypted_ballots(election["encrypted"])
+    pub.write_tally_result(election["tally_result"])
+    pub.write_decryption_result(election["decryption_result"])
+    return out
+
+
+def test_shard_scan_covers_stream(record_dir, election):
+    g = election["group"]
+    consumer = Consumer(record_dir, g)
+    shards = consumer.ballot_shards(3)
+    assert sum(cnt for _, cnt, _ in shards) == 20
+    seen = []
+    for off, cnt, last_off in shards:
+        blk = list(consumer.iterate_encrypted_ballots_slice(off, cnt))
+        assert len(blk) == cnt
+        # last_frame_offset decodes exactly the slice's final ballot
+        tail = next(consumer.iterate_encrypted_ballots_slice(last_off, 1))
+        assert tail.ballot_id == blk[-1].ballot_id
+        seen.extend(b.ballot_id for b in blk)
+    assert seen == [b.ballot_id for b in election["encrypted"]]
+
+
+def test_feeder_partials_match_single_pass(record_dir, election):
+    g = election["group"]
+    consumer = Consumer(record_dir, g)
+    record = ElectionRecord(
+        election_init=election["init"],
+        encrypted_ballots=election["encrypted"],
+        tally_result=election["tally_result"],
+        decryption_result=election["decryption_result"])
+    single = Verifier(record, g).verify()
+
+    shards = consumer.ballot_shards(3)
+    prev_codes = [None]
+    for _, _, last_off in shards[:-1]:
+        prev_codes.append(next(
+            consumer.iterate_encrypted_ballots_slice(last_off, 1)).code)
+    parts = []
+    for (off, cnt, _), pc in zip(shards, prev_codes):
+        res, agg = VerificationResult(), _BallotAggregates()
+        Verifier(record, g).verify_ballots_partial(
+            consumer.iterate_encrypted_ballots_slice(off, cnt),
+            res, agg, prev_code=pc)
+        parts.append((res, agg))
+    res, agg = Verifier.merge_partials(parts)
+    merged = Verifier(record, g).finalize(res, agg)
+    assert merged.ok, merged.summary()
+    assert merged.checks == single.checks
+
+
+def test_feeder_boundary_chain_break_detected(record_dir, election):
+    """A broken chain exactly AT a shard boundary must fail V6: the
+    second feeder's first ballot is checked against the handed-over
+    boundary code, not blindly accepted."""
+    g = election["group"]
+    consumer = Consumer(record_dir, g)
+    record = ElectionRecord(
+        election_init=election["init"],
+        encrypted_ballots=election["encrypted"],
+        tally_result=election["tally_result"])
+    shards = consumer.ballot_shards(2)
+    assert len(shards) == 2
+    (off0, cnt0, _), (off1, cnt1, _) = shards
+    wrong_code = b"\x00" * 32
+    res, agg = VerificationResult(), _BallotAggregates()
+    Verifier(record, g).verify_ballots_partial(
+        consumer.iterate_encrypted_ballots_slice(off1, cnt1),
+        res, agg, prev_code=wrong_code)
+    assert not res.checks["V6.ballot_chaining"]
+
+
+def _run_cli(record_dir, feeders):
+    env = {k: v for k, v in os.environ.items()
+           if "AXON" not in k and "PALLAS" not in k
+           and not k.startswith("TPU")}
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "electionguard_tpu.cli.run_verifier",
+         "-in", record_dir, "-group", "tiny", "-feeders", str(feeders)],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+def test_cli_feeders_pass_and_reject_tamper(record_dir, election):
+    proc = _run_cli(record_dir, 2)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "merged 2 feeder partials" in proc.stdout + proc.stderr
+
+    # swap the two ballots straddling the shard boundary in the FILE:
+    # both feeders' slices still verify internally ballot-by-ballot, but
+    # the chain across the boundary breaks
+    path = os.path.join(record_dir, "encrypted_ballots.pb")
+    frames = []
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(4)
+            if not hdr:
+                break
+            (n,) = struct.unpack(">I", hdr)
+            frames.append(f.read(n))
+    frames[9], frames[10] = frames[10], frames[9]
+    with open(path, "wb") as f:
+        for fr in frames:
+            f.write(struct.pack(">I", len(fr)))
+            f.write(fr)
+    proc = _run_cli(record_dir, 2)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "V6" in proc.stdout

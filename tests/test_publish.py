@@ -5,7 +5,7 @@ import pytest
 from electionguard_tpu.publish import pb, serialize
 from electionguard_tpu.publish.publisher import (Consumer, Publisher,
                                                  election_record_from_consumer)
-from tests.test_workflow_inprocess import election  # noqa: F401  (fixture)
+# the `election` fixture is session-scoped in tests/conftest.py
 
 
 def test_primitive_roundtrips(tgroup):
