@@ -112,7 +112,11 @@ def _start_watchdog() -> None:
     """Force-emit a partial artifact and exit if the run wedges (a hung
     device call can't be interrupted; the driver's kill would lose the
     JSON line entirely)."""
-    seconds = float(os.environ.get("BENCH_WATCHDOG", "3000"))
+    # default sized for a COLD compile cache: the fused cap-shape
+    # programs are the largest this repo compiles, and the r4 run showed
+    # ~1100 s of remote compiles for a smaller program set — give the
+    # first fused TPU run room before force-emitting a partial artifact
+    seconds = float(os.environ.get("BENCH_WATCHDOG", "5400"))
     if seconds <= 0:
         return
 
