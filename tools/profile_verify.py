@@ -1,11 +1,11 @@
-"""Per-op on-chip timing at the verify pass's real dispatch shapes.
+"""Per-program on-chip timing at the verify/encrypt real dispatch shapes.
 
-Times each device op the V4/V5 chunk path issues (residue, powmod,
-fixed-base pows, mulmod, device SHA challenges) at the tile shapes a
-2048-ballot chunk produces, plus the host<->device transfer cost, so
-optimization effort follows measured time, not guesses.  Compiles are
-expected to be warm (run ``python bench.py`` first); every dispatch is
-still wrapped in a small retry for tunnel flakes.
+Times each FUSED device program the production pipelines issue — V4
+selection check, V5 contest check, selection encryption, contest
+encryption, the V5/V7 product-reduce — at the shapes a 2048-ballot
+chunk produces, plus the host<->device transfer cost, so optimization
+effort follows measured time, not guesses.  Compiles are expected to be
+warm (run ``python bench.py`` first).
 
 Usage: python tools/profile_verify.py [nballots]
 """
@@ -65,38 +65,66 @@ def main() -> int:
     def rows_q(k):
         return np.asarray((ee.to_limbs(exps) * (k // 64 + 1))[:k])
 
-    A = rows_p(S)
-    E = rows_q(S)
     K = pow(g.g, 0x1234567890ABCDEF, g.p)
     eo.fixed_table(K)
+    qbar = _encode(123456789)
 
+    from electionguard_tpu.encrypt.fused import get_fused_encryptor
+    from electionguard_tpu.verify.fused import get_fused
+    fe = get_fused_encryptor(eo, ee)
+    fv = get_fused(eo)
+
+    # fused encryption at chunk shape (nonces derived in-program);
+    # warm-up output doubles as the verification input — every timed
+    # lambda closes over prebuilt arrays so host conversion stays out
+    # of the measured region
+    seed_row = rng.integers(0, 256, 32, dtype=np.uint8)
+    bids = rng.integers(0, 256, (S, 32), dtype=np.uint8)
+    ords = np.arange(S, dtype=np.uint32)
+    votes = (np.arange(S) % 2).astype(np.int64)
+    alpha, beta, _, CR, VR, CF, VF = fe.encrypt_selections(
+        seed_row, bids, ords, votes, K, qbar)  # warm-up + outputs
     total = 0.0
-    total += timed("residue 2S", lambda: eo.is_valid_residue(rows_p(2 * S)))
-    total += timed("powmod 4S (var_pows)",
-                   lambda: eo.powmod(rows_p(4 * S), rows_q(4 * S)))
-    total += timed("g_pow 2S", lambda: eo.g_pow(rows_q(2 * S)))
-    total += timed("base_pow K 2S", lambda: eo.base_pow(K, rows_q(2 * S)))
-    total += timed("mulmod 5S", lambda: eo.mulmod(rows_p(5 * S),
-                                                  rows_p(5 * S)))
-    total += timed("powmod 2C (V5)",
-                   lambda: eo.powmod(rows_p(2 * C), rows_q(2 * C)))
-    total += timed("g_pow+K_pow 2C", lambda: (eo.g_pow(rows_q(C)),
-                                              eo.base_pow(K, rows_q(C))))
+    t_enc = timed("fused enc-selections S", lambda: fe.encrypt_selections(
+        seed_row, bids, ords, votes, K, qbar))
+    total += t_enc
+    rs_c, vs_c = rows_q(C), rows_q(C)
+    total += timed("fused enc-contests C", lambda: fe.encrypt_contests(
+        seed_row, bids[:C], ords[:C], rs_c, vs_c, K, qbar + _encode(1)))
+
+    # fused verification of what encryption just produced
+    v1m = (votes == 1)[:, None]
+    c0 = np.where(v1m, CF, CR)
+    v0 = np.where(v1m, VF, VR)
+    c1 = np.where(v1m, CR, CF)
+    v1_ = np.where(v1m, VR, VF)
+    ok = np.asarray(fv.v4_selections(alpha, beta, c0, v0, c1, v1_,
+                                     K, qbar))
+    assert ok.all(), "fused V4 rejected fused-encrypted rows — " \
+        "refusing to profile a broken pipeline"
+    t_v4 = timed("fused v4-selections S", lambda: fv.v4_selections(
+        alpha, beta, c0, v0, c1, v1_, K, qbar))
+    total += t_v4
+    ca_c, cb_c = rows_p(C), rows_p(C)
+    lq_c, cc_c, cv_c = rows_q(C), rows_q(C), rows_q(C)
+    total += timed("fused v5-contests C", lambda: fv.v5_contests(
+        ca_c, cb_c, lq_c, cc_c, cv_c, K, qbar + _encode(1)))
+    prod_in = np.broadcast_to(rows_p(S)[:, None, :], (S, 2, eo.n))
+    total += timed("prod-reduce V7", lambda: eo.prod_reduce(prod_in))
     elem_b = np.zeros((S, g.spec.p_bytes), np.uint8)
     elem_b[:, -1] = 7
-    qbar = _encode(123456789)
-    total += timed("sha challenge S (V4)",
-                   lambda: sha256_jax.batch_challenge_p(
-                       g, qbar, [elem_b] * 6))
-    total += timed("zq add S", lambda: ee.add(rows_q(S), rows_q(S)))
+    timed("sha challenge S (unfused)",
+          lambda: sha256_jax.batch_challenge_p(g, qbar, [elem_b] * 6))
 
-    # host<->device transfer at a var_pows-sized result
-    dev = jnp.asarray(rows_p(4 * S))
+    # host<->device transfer at a chunk-sized limb block
+    dev = jnp.asarray(rows_p(2 * S))
     jax.block_until_ready(dev)
-    timed("transfer d2h 4S rows", lambda: np.asarray(dev) + 0)
+    timed("transfer d2h 2S rows", lambda: np.asarray(dev) + 0)
 
     print(f"{'device total (one chunk)':<28s} {total * 1e3:9.1f} ms  "
-          f"({nballots / total:.1f} ballots/s ex-host)")
+          f"({nballots / total:.1f} ballots/s ex-host; "
+          f"v4 alone {nballots / t_v4:.1f}/s, "
+          f"enc alone {nballots / t_enc:.1f}/s)")
     return 0
 
 
