@@ -1,0 +1,90 @@
+"""Online ballot-encryption service binary (the serving-plane analogue of
+``run_batch_encryption.py``'s offline phase 2).
+
+Reads ``election_initialized.pb`` from ``-in``, then serves
+``BallotEncryptionService`` (serve/service.py) until SIGTERM/SIGINT:
+plaintext ballots arrive over gRPC, the dynamic batcher aggregates them
+into bucket shapes, the device-owner worker encrypts, and every
+submitted ballot is appended to the growing record under ``-out``.
+
+Graceful drain on SIGTERM: stop admitting (new requests get UNAVAILABLE,
+queue-full requests were already getting RESOURCE_EXHAUSTED), flush
+every admitted request through the device, close the framed ballot
+stream so the partial record under ``-out`` is a valid, verifiable
+election record, log the final metrics, exit 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from electionguard_tpu.cli.common import (Stopwatch, add_group_flag,
+                                          resolve_group, setup_logging)
+from electionguard_tpu.publish.publisher import Consumer
+from electionguard_tpu.utils import maybe_profile
+
+
+def main(argv=None) -> int:
+    log = setup_logging("RunEncryptionService")
+    ap = argparse.ArgumentParser("RunEncryptionService")
+    ap.add_argument("-in", dest="input", required=True,
+                    help="record dir with election_initialized.pb")
+    ap.add_argument("-out", dest="output", required=True,
+                    help="record dir the growing ballot stream is "
+                         "published to")
+    ap.add_argument("-port", type=int, default=17711,
+                    help="gRPC port (0 = pick a free one)")
+    ap.add_argument("-maxBatch", dest="max_batch", type=int, default=64,
+                    help="flush when this many requests are pending")
+    ap.add_argument("-maxWaitMs", dest="max_wait_ms", type=float,
+                    default=25.0,
+                    help="flush when the oldest pending request is this "
+                         "old")
+    ap.add_argument("-maxQueue", dest="max_queue", type=int, default=256,
+                    help="admission queue bound; beyond it requests are "
+                         "rejected with RESOURCE_EXHAUSTED")
+    ap.add_argument("-fixedNonces", dest="fixed_nonces",
+                    action="store_true",
+                    help="derive nonces deterministically from a fixed "
+                         "seed (tests only)")
+    ap.add_argument("-noPrewarm", dest="no_prewarm", action="store_true",
+                    help="skip the per-bucket compile prewarm at startup")
+    add_group_flag(ap)
+    args = ap.parse_args(argv)
+
+    group = resolve_group(args)
+    init = Consumer(args.input, group).read_election_initialized()
+
+    from electionguard_tpu.serve.service import EncryptionService
+    seed = group.int_to_q(42) if args.fixed_nonces else None
+    sw = Stopwatch()
+    with maybe_profile("serve"):
+        service = EncryptionService(
+            init, group, port=args.port, out_dir=args.output,
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            max_queue=args.max_queue, seed=seed,
+            prewarm=not args.no_prewarm)
+        log.info("serving on port %d (startup took %.2fs)", service.port,
+                 sw.elapsed())
+
+        stop = threading.Event()
+
+        def _on_signal(signum, frame):
+            log.info("signal %d: draining", signum)
+            stop.set()
+
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+        stop.wait()
+        service.drain()
+    n = service.metrics.get("ballots_encrypted")
+    log.info("%s; record published to %s",
+             sw.took("serving", max(n, 1)), args.output)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

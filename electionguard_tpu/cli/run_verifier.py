@@ -32,13 +32,14 @@ def _feeder_worker(wargs):
     (VerificationResult, _BallotAggregates) partial pair.
 
     Feeders run their device math on the HOST platform (CPU) by default:
-    N spawned processes must not contend for one accelerator.  On a
-    machine with per-process device assignment configured externally
-    (e.g. one chip per feeder via TPU_VISIBLE_DEVICES), set
-    EGTPU_FEEDER_PLATFORM to override."""
+    N spawned processes must not contend for one accelerator.  The
+    platform is pinned in the environment the spawn Pool's children
+    INHERIT (see _verify_with_feeders) — setting it here would come too
+    late, because the import chain (and on some machines a site hook)
+    pulls jax in before this body runs.  On a machine with per-process
+    device assignment configured externally (e.g. one chip per feeder
+    via TPU_VISIBLE_DEVICES), set EGTPU_FEEDER_PLATFORM to override."""
     (record_dir, group_name, offset, count, prev_code, chunk_size) = wargs
-    os.environ["JAX_PLATFORMS"] = os.environ.get(
-        "EGTPU_FEEDER_PLATFORM", "cpu")
     import argparse as _ap
     ns = _ap.Namespace(group=group_name)
     group = resolve_group(ns)
@@ -73,9 +74,16 @@ def _verify_with_feeders(args, group, consumer, record, log):
     wargs = [(args.input, args.group, off, cnt, prev_codes[i],
               args.chunk_size)
              for i, (off, cnt, _) in enumerate(shards)]
+    # pin the feeder platform (and scrub tunnel env for the CPU default)
+    # in the PARENT env before the spawn Pool exists, so children inherit
+    # it at interpreter startup — an assignment inside the worker body is
+    # too late, jax is already imported there (ADVICE r5)
+    from electionguard_tpu.utils.platform import pinned_child_platform
     ctx = mp.get_context("spawn")
-    with ctx.Pool(processes=len(wargs)) as pool:
-        parts = pool.map(_feeder_worker, wargs)
+    with pinned_child_platform(
+            os.environ.get("EGTPU_FEEDER_PLATFORM", "cpu")):
+        with ctx.Pool(processes=len(wargs)) as pool:
+            parts = pool.map(_feeder_worker, wargs)
     res, agg = Verifier.merge_partials(parts)
     log.info("merged %d feeder partials (%d ballots)", len(parts),
              n_ballots)

@@ -75,6 +75,13 @@ def batch_schnorr_verify(group: GroupContext, proofs,
     k_l = np.asarray(eo.to_limbs_p([p.public_key.value for p in proofs]))
     c_l = np.asarray(ee.to_limbs([p.challenge.value for p in proofs]))
     v_l = np.asarray(ee.to_limbs([p.response.value for p in proofs]))
+    # the 0 < K < p range mask is part of the per-proof semantics, so it
+    # is computed UNCONDITIONALLY and ANDed into the returned proof mask
+    # — with check_subgroup=False it was previously skipped entirely
+    # (ADVICE r5): an out-of-range key could pass
+    in_range = np.fromiter(
+        (0 < p.public_key.value < group.p for p in proofs),
+        dtype=bool, count=B)
     if check_subgroup:
         q_rep = np.broadcast_to(bn.int_to_limbs(group.q, ee.ne),
                                 c_l.shape)
@@ -83,9 +90,6 @@ def batch_schnorr_verify(group: GroupContext, proofs,
         kc, kq = pows[:, 0], pows[:, 1]
         one = np.zeros_like(kq)
         one[:, 0] = 1
-        in_range = np.fromiter(
-            (0 < p.public_key.value < group.p for p in proofs),
-            dtype=bool, count=B)
         sub_ok = in_range & (kq == one).all(axis=1)
     else:
         kc = np.asarray(eo.powmod(k_l, c_l))
@@ -102,4 +106,5 @@ def batch_schnorr_verify(group: GroupContext, proofs,
             c = hash_elems(group, p.public_key,
                            group.bytes_to_p(bytes(com_b[i])))
             ok[i] = (c == p.challenge)
+    ok = ok & in_range
     return (ok, sub_ok) if check_subgroup else ok

@@ -89,6 +89,7 @@ class BatchEncryptor:
             code_seed: Optional[bytes] = None,
             ballot_index_base: int = 0,
             spoiled_ids: Optional[set] = None,
+            timestamp: Optional[int] = None,
     ) -> tuple[list[EncryptedBallot], list[tuple[PlaintextBallot, str]]]:
         """Encrypt a batch.  Returns (encrypted, invalid) where invalid is
         [(ballot, reason)] — mirroring batchEncryption's invalidDir.
@@ -105,6 +106,9 @@ class BatchEncryptor:
         stay in the code chain but are excluded from the tally and become
         eligible for spoiled-ballot decryption (reference:
         RunRemoteDecryptor.java:264-269).
+        ``timestamp``: ballot timestamp (defaults to now); the
+        confirmation code commits to it, so a caller replaying a stream
+        for bit-identical codes (serve differential tests) must pin it.
         """
         g = self.group
         seed = seed if seed is not None else g.rand_q()
@@ -271,8 +275,14 @@ class BatchEncryptor:
             CF = np.empty(S, dtype=object)
             VF = np.empty(S, dtype=object)
             for i in range(S):
+                # keyed by (identity, per-ballot contest ordinal,
+                # selection id) — like the fused path, invariant to how
+                # the stream is chunked into encrypt_ballots calls, so
+                # online batching and offline runs produce identical
+                # ciphertexts for the same seed
                 h = hash_elems(g, seed, valid[flat.ballot_idx[i]].ballot_id,
-                               flat.contest_idx[i], flat.selection_ids[i])
+                               contest_rows[flat.contest_idx[i]][1],
+                               flat.selection_ids[i])
                 R[i] = h.value
                 U[i] = hash_elems(g, h, "u").value
                 CF[i] = hash_elems(g, h, "cf").value
@@ -337,9 +347,9 @@ class BatchEncryptor:
             for i in range(S):
                 R_sum[flat.contest_idx[i]] = \
                     (R_sum[flat.contest_idx[i]] + R[i]) % q
-            U2 = [hash_elems(g, seed, "contest-u", ci,
+            U2 = [hash_elems(g, seed, "contest-u", row[1],
                              valid[row[0]].ballot_id).value
-                  for ci, row in enumerate(contest_rows)]
+                  for row in contest_rows]
             RS_l = ee.to_limbs(R_sum)
             U2_l = ee.to_limbs(U2)
             VS_l = ee.to_limbs(V_sum)
@@ -405,7 +415,7 @@ class BatchEncryptor:
 
         out: list[EncryptedBallot] = []
         prev_code = code_seed
-        timestamp = int(time.time())
+        timestamp = int(time.time()) if timestamp is None else int(timestamp)
         # the ballot crypto hash is chain-independent, so the whole batch
         # hashes in a few device dispatches; only the (cheap) code chain
         # itself is sequential

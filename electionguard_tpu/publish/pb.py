@@ -23,16 +23,33 @@ _DESC_PATH = os.path.join(_PROTO_DIR, "descriptors.pb")
 _PROTO_FILES = ["common.proto", "election_record.proto", "remote_rpc.proto"]
 
 
+def _compile_descriptors() -> None:
+    try:
+        subprocess.run(
+            ["protoc", f"--descriptor_set_out={_DESC_PATH}",
+             "--include_imports", "-I", _PROTO_DIR] + _PROTO_FILES,
+            check=True, cwd=_PROTO_DIR)
+    except FileNotFoundError:
+        # no protoc on PATH: compile with the in-tree pure-Python
+        # fallback (publish/protoc_mini.py) — same descriptors, same
+        # wire bytes, covers exactly the grammar these files use
+        from electionguard_tpu.publish import protoc_mini
+        texts = []
+        for name in _PROTO_FILES:
+            with open(os.path.join(_PROTO_DIR, name)) as f:
+                texts.append((name, f.read()))
+        fds = protoc_mini.compile_files(texts)
+        with open(_DESC_PATH, "wb") as f:
+            f.write(fds.SerializeToString())
+
+
 def _ensure_descriptors() -> bytes:
     protos = [os.path.join(_PROTO_DIR, f) for f in _PROTO_FILES]
     stale = (not os.path.exists(_DESC_PATH) or
              any(os.path.getmtime(p) > os.path.getmtime(_DESC_PATH)
                  for p in protos))
     if stale:
-        subprocess.run(
-            ["protoc", f"--descriptor_set_out={_DESC_PATH}",
-             "--include_imports", "-I", _PROTO_DIR] + _PROTO_FILES,
-            check=True, cwd=_PROTO_DIR)
+        _compile_descriptors()
     with open(_DESC_PATH, "rb") as f:
         return f.read()
 

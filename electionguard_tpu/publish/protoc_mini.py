@@ -1,0 +1,367 @@
+"""Pure-Python .proto → FileDescriptorSet compiler (protoc fallback).
+
+``pb.py`` regenerates ``descriptors.pb`` whenever a .proto changes.  The
+original path shells out to ``protoc``; some environments (including the
+one this repo grows in) ship the protobuf *runtime* but no compiler at
+all.  This module compiles the repo's protos to
+``descriptor_pb2.FileDescriptorSet`` directly, covering exactly the
+grammar the three contract files use:
+
+    proto3 syntax, package, imports, messages (scalar / message /
+    repeated / map fields, nested enums and messages, reserved ranges),
+    top-level enums, and services with unary rpcs.
+
+It is NOT a general protoc replacement — unsupported constructs raise
+``ProtoParseError`` loudly so a future .proto edit that outgrows the
+subset fails at build time, not with silently wrong descriptors.  Wire
+bytes are produced by the protobuf runtime from these descriptors, so
+byte compatibility is unaffected by which compiler built them.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from google.protobuf import descriptor_pb2
+
+_SCALARS = {
+    "double": descriptor_pb2.FieldDescriptorProto.TYPE_DOUBLE,
+    "float": descriptor_pb2.FieldDescriptorProto.TYPE_FLOAT,
+    "int64": descriptor_pb2.FieldDescriptorProto.TYPE_INT64,
+    "uint64": descriptor_pb2.FieldDescriptorProto.TYPE_UINT64,
+    "int32": descriptor_pb2.FieldDescriptorProto.TYPE_INT32,
+    "fixed64": descriptor_pb2.FieldDescriptorProto.TYPE_FIXED64,
+    "fixed32": descriptor_pb2.FieldDescriptorProto.TYPE_FIXED32,
+    "bool": descriptor_pb2.FieldDescriptorProto.TYPE_BOOL,
+    "string": descriptor_pb2.FieldDescriptorProto.TYPE_STRING,
+    "bytes": descriptor_pb2.FieldDescriptorProto.TYPE_BYTES,
+    "uint32": descriptor_pb2.FieldDescriptorProto.TYPE_UINT32,
+    "sint32": descriptor_pb2.FieldDescriptorProto.TYPE_SINT32,
+    "sint64": descriptor_pb2.FieldDescriptorProto.TYPE_SINT64,
+}
+
+_LABEL_OPTIONAL = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+_LABEL_REPEATED = descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED
+_TYPE_MESSAGE = descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE
+_TYPE_ENUM = descriptor_pb2.FieldDescriptorProto.TYPE_ENUM
+
+_TOKEN = re.compile(r"""
+    "(?:[^"\\]|\\.)*"      |   # string literal
+    [A-Za-z_][\w.]*        |   # identifier (possibly dotted)
+    \d+                    |   # integer
+    [{}=;<>,()\[\]]            # punctuation
+""", re.VERBOSE)
+
+
+class ProtoParseError(Exception):
+    pass
+
+
+def _tokenize(text: str) -> list[str]:
+    # strip // line and /* block */ comments before tokenizing
+    text = re.sub(r"//[^\n]*", "", text)
+    text = re.sub(r"/\*.*?\*/", "", text, flags=re.DOTALL)
+    pos, tokens = 0, []
+    for m in _TOKEN.finditer(text):
+        between = text[pos:m.start()]
+        if between.strip():
+            raise ProtoParseError(f"unrecognized input: {between.strip()!r}")
+        tokens.append(m.group(0))
+        pos = m.end()
+    if text[pos:].strip():
+        raise ProtoParseError(f"trailing input: {text[pos:].strip()!r}")
+    return tokens
+
+
+class _Cursor:
+    def __init__(self, tokens: list[str]):
+        self.tokens = tokens
+        self.i = 0
+
+    def peek(self) -> str | None:
+        return self.tokens[self.i] if self.i < len(self.tokens) else None
+
+    def next(self) -> str:
+        tok = self.peek()
+        if tok is None:
+            raise ProtoParseError("unexpected end of file")
+        self.i += 1
+        return tok
+
+    def expect(self, want: str) -> str:
+        tok = self.next()
+        if tok != want:
+            raise ProtoParseError(f"expected {want!r}, got {tok!r}")
+        return tok
+
+
+@dataclass
+class _Scope:
+    """Symbol table entry: fully-qualified name -> is_enum."""
+
+    names: dict[str, bool] = field(default_factory=dict)
+
+    def add(self, fq: str, is_enum: bool):
+        self.names[fq] = is_enum
+
+
+def _parse_enum(cur: _Cursor, enum_proto) -> None:
+    enum_proto.name = cur.next()
+    cur.expect("{")
+    while cur.peek() != "}":
+        name = cur.next()
+        if name == "option":  # e.g. allow_alias — skip to ';'
+            while cur.next() != ";":
+                pass
+            continue
+        cur.expect("=")
+        number = int(cur.next())
+        cur.expect(";")
+        enum_proto.value.add(name=name, number=number)
+    cur.expect("}")
+
+
+def _parse_reserved(cur: _Cursor, msg) -> None:
+    # `reserved 1, 2;` / `reserved 1 to 5;` (names unsupported — unused)
+    while True:
+        start = cur.next()
+        if not start.isdigit():
+            raise ProtoParseError(f"reserved names unsupported: {start!r}")
+        start = int(start)
+        end = start
+        if cur.peek() == "to":
+            cur.next()
+            end = int(cur.next())
+        msg.reserved_range.add(start=start, end=end + 1)  # end exclusive
+        tok = cur.next()
+        if tok == ";":
+            return
+        if tok != ",":
+            raise ProtoParseError(f"expected , or ; in reserved, got {tok!r}")
+
+
+def _parse_field(cur: _Cursor, first: str, msg, scope_prefix: str) -> None:
+    label = _LABEL_OPTIONAL
+    proto3_optional = False
+    type_name = first
+    if first in ("repeated", "optional"):
+        if first == "repeated":
+            label = _LABEL_REPEATED
+        else:
+            proto3_optional = True
+        type_name = cur.next()
+    if type_name == "map":
+        _parse_map_field(cur, msg, scope_prefix)
+        return
+    name = cur.next()
+    cur.expect("=")
+    number = int(cur.next())
+    if cur.peek() == "[":  # field options — skip to ']'
+        while cur.next() != "]":
+            pass
+    cur.expect(";")
+    f = msg.field.add(name=name, number=number, label=label)
+    if proto3_optional:
+        f.proto3_optional = True
+        # proto3 optional needs a synthetic oneof
+        f.oneof_index = len(msg.oneof_decl)
+        msg.oneof_decl.add(name=f"_{name}")
+    if type_name in _SCALARS:
+        f.type = _SCALARS[type_name]
+    else:
+        f.type_name = type_name  # resolved in a second pass
+
+
+def _snake_to_camel(s: str) -> str:
+    return "".join(p.capitalize() for p in s.split("_"))
+
+
+def _parse_map_field(cur: _Cursor, msg, scope_prefix: str) -> None:
+    cur.expect("<")
+    key_type = cur.next()
+    cur.expect(",")
+    val_type = cur.next()
+    cur.expect(">")
+    name = cur.next()
+    cur.expect("=")
+    number = int(cur.next())
+    cur.expect(";")
+    if key_type not in _SCALARS or key_type in ("double", "float", "bytes"):
+        raise ProtoParseError(f"invalid map key type {key_type!r}")
+    entry = msg.nested_type.add(name=f"{_snake_to_camel(name)}Entry")
+    entry.options.map_entry = True
+    entry.field.add(name="key", number=1, label=_LABEL_OPTIONAL,
+                    type=_SCALARS[key_type])
+    v = entry.field.add(name="value", number=2, label=_LABEL_OPTIONAL)
+    if val_type in _SCALARS:
+        v.type = _SCALARS[val_type]
+    else:
+        v.type_name = val_type
+    f = msg.field.add(name=name, number=number, label=_LABEL_REPEATED,
+                      type=_TYPE_MESSAGE)
+    f.type_name = f"{scope_prefix}.{entry.name}"
+
+
+def _parse_message(cur: _Cursor, msg, scope_prefix: str) -> None:
+    msg.name = cur.next()
+    fq = f"{scope_prefix}.{msg.name}"
+    cur.expect("{")
+    while True:
+        tok = cur.next()
+        if tok == "}":
+            return
+        if tok == "enum":
+            _parse_enum(cur, msg.enum_type.add())
+        elif tok == "message":
+            _parse_message(cur, msg.nested_type.add(), fq)
+        elif tok == "reserved":
+            _parse_reserved(cur, msg)
+        elif tok == "option":
+            while cur.next() != ";":
+                pass
+        elif tok == "oneof":
+            raise ProtoParseError("oneof unsupported by protoc_mini")
+        else:
+            _parse_field(cur, tok, msg, fq)
+
+
+def _parse_service(cur: _Cursor, svc) -> None:
+    svc.name = cur.next()
+    cur.expect("{")
+    while True:
+        tok = cur.next()
+        if tok == "}":
+            return
+        if tok != "rpc":
+            raise ProtoParseError(f"expected rpc in service, got {tok!r}")
+        m = svc.method.add(name=cur.next())
+        cur.expect("(")
+        if cur.peek() == "stream":
+            raise ProtoParseError("streaming rpcs unsupported")
+        m.input_type = cur.next()
+        cur.expect(")")
+        cur.expect("returns")
+        cur.expect("(")
+        if cur.peek() == "stream":
+            raise ProtoParseError("streaming rpcs unsupported")
+        m.output_type = cur.next()
+        cur.expect(")")
+        tok = cur.next()
+        if tok == "{":
+            cur.expect("}")
+            if cur.peek() == ";":
+                cur.next()
+        elif tok != ";":
+            raise ProtoParseError(f"expected {{}} or ; after rpc, got {tok!r}")
+
+
+def parse_file(name: str, text: str) -> descriptor_pb2.FileDescriptorProto:
+    f = descriptor_pb2.FileDescriptorProto(name=name)
+    cur = _Cursor(_tokenize(text))
+    while cur.peek() is not None:
+        tok = cur.next()
+        if tok == "syntax":
+            cur.expect("=")
+            syntax = cur.next().strip('"')
+            if syntax != "proto3":
+                raise ProtoParseError(f"only proto3 supported: {syntax}")
+            f.syntax = syntax
+            cur.expect(";")
+        elif tok == "package":
+            f.package = cur.next()
+            cur.expect(";")
+        elif tok == "import":
+            dep = cur.next()
+            if dep in ("public", "weak"):
+                dep = cur.next()
+            f.dependency.append(dep.strip('"'))
+            cur.expect(";")
+        elif tok == "option":
+            while cur.next() != ";":
+                pass
+        elif tok == "message":
+            _parse_message(cur, f.message_type.add(), f".{f.package}"
+                           if f.package else "")
+        elif tok == "enum":
+            _parse_enum(cur, f.enum_type.add())
+        elif tok == "service":
+            _parse_service(cur, f.service.add())
+        else:
+            raise ProtoParseError(f"unexpected top-level token {tok!r}")
+    return f
+
+
+# ---------------------------------------------------------------------------
+# type resolution
+# ---------------------------------------------------------------------------
+
+
+def _collect_symbols(files) -> dict[str, bool]:
+    """{fully-qualified name: is_enum} across the whole file set."""
+    symbols: dict[str, bool] = {}
+
+    def walk_msg(prefix: str, msg):
+        fq = f"{prefix}.{msg.name}"
+        symbols[fq] = False
+        for e in msg.enum_type:
+            symbols[f"{fq}.{e.name}"] = True
+        for n in msg.nested_type:
+            walk_msg(fq, n)
+
+    for f in files:
+        prefix = f".{f.package}" if f.package else ""
+        for m in f.message_type:
+            walk_msg(prefix, m)
+        for e in f.enum_type:
+            symbols[f"{prefix}.{e.name}"] = True
+    return symbols
+
+
+def _resolve_name(name: str, scope: str, symbols: dict[str, bool]) -> str:
+    """protoc's scoping rule, simplified: try the innermost enclosing
+    scope outward, then the bare package-qualified name."""
+    if name.startswith("."):
+        if name not in symbols:
+            raise ProtoParseError(f"unknown type {name}")
+        return name
+    parts = scope.split(".") if scope else []
+    while parts:
+        candidate = ".".join(parts) + f".{name}"
+        if candidate in symbols:
+            return candidate
+        parts.pop()
+    candidate = f".{name}"
+    if candidate in symbols:
+        return candidate
+    raise ProtoParseError(f"cannot resolve type {name!r} in scope {scope!r}")
+
+
+def _resolve_fields(msg, scope: str, symbols: dict[str, bool]) -> None:
+    fq = f"{scope}.{msg.name}"
+    for f in msg.field:
+        if f.type_name and not f.type_name.startswith("."):
+            f.type_name = _resolve_name(f.type_name, fq, symbols)
+        if f.type_name and f.type == 0:
+            f.type = _TYPE_ENUM if symbols[f.type_name] else _TYPE_MESSAGE
+    for n in msg.nested_type:
+        _resolve_fields(n, fq, symbols)
+
+
+def compile_files(named_texts: list[tuple[str, str]]
+                  ) -> descriptor_pb2.FileDescriptorSet:
+    """[(file_name, proto_text)] -> FileDescriptorSet, dependency-ordered
+    as given (imports must precede importers, like protoc's -I output)."""
+    fds = descriptor_pb2.FileDescriptorSet()
+    files = [parse_file(name, text) for name, text in named_texts]
+    symbols = _collect_symbols(files)
+    for f in files:
+        prefix = f".{f.package}" if f.package else ""
+        for m in f.message_type:
+            _resolve_fields(m, prefix, symbols)
+        for s in f.service:
+            for m in s.method:
+                m.input_type = _resolve_name(m.input_type, prefix, symbols)
+                m.output_type = _resolve_name(m.output_type, prefix, symbols)
+        fds.file.append(f)
+    return fds

@@ -111,22 +111,29 @@ def import_constant_proof(g: GroupContext, m) -> ConstantChaumPedersenProof:
 
 def publish_hashed_ciphertext(h: HashedElGamalCiphertext):
     return pb.HashedElGamalCiphertext(
-        c0=publish_p(h.c0), c1=h.c1, c2=h.c2, num_bytes=h.num_bytes)
+        c0=publish_p(h.c0), c1=h.c1, c2=publish_u256(h.c2),
+        num_bytes=h.num_bytes)
 
 
 def import_hashed_ciphertext(g: GroupContext, m) -> HashedElGamalCiphertext:
     return HashedElGamalCiphertext(
-        import_p(g, m.c0), bytes(m.c1), bytes(m.c2), int(m.num_bytes))
+        import_p(g, m.c0), bytes(m.c1), import_u256(m.c2),
+        int(m.num_bytes))
 
 
 def publish_schnorr(p: SchnorrProof):
-    return pb.SchnorrProof(public_key=publish_p(p.public_key),
-                           challenge=publish_q(p.challenge),
+    # the proof travels as (challenge, response) only — the key rides in
+    # the parallel coefficient_commitments list (reference contract,
+    # common.proto:37-41)
+    return pb.SchnorrProof(challenge=publish_q(p.challenge),
                            response=publish_q(p.response))
 
 
-def import_schnorr(g: GroupContext, m) -> SchnorrProof:
-    return SchnorrProof(import_p(g, m.public_key),
+def import_schnorr(g: GroupContext, m, public_key) -> SchnorrProof:
+    """``public_key``: the ElementModP from the parallel commitments list
+    this proof attests to (not on the wire — the reference reserves its
+    field)."""
+    return SchnorrProof(public_key,
                         import_q(g, m.challenge), import_q(g, m.response))
 
 
@@ -157,12 +164,18 @@ def publish_guardian_record(r: GuardianRecord):
 
 
 def import_guardian_record(g: GroupContext, m) -> GuardianRecord:
+    if len(m.coefficient_commitments) != len(m.coefficient_proofs):
+        raise ValueError(
+            f"guardian {m.guardian_id}: {len(m.coefficient_commitments)} "
+            f"commitments vs {len(m.coefficient_proofs)} proofs — each "
+            f"proof needs its parallel commitment as public key")
+    commitments = tuple(import_p(g, k) for k in m.coefficient_commitments)
     return GuardianRecord(
         guardian_id=m.guardian_id, x_coordinate=int(m.x_coordinate),
-        coefficient_commitments=tuple(
-            import_p(g, k) for k in m.coefficient_commitments),
+        coefficient_commitments=commitments,
         coefficient_proofs=tuple(
-            import_schnorr(g, p) for p in m.coefficient_proofs))
+            import_schnorr(g, p, k)
+            for p, k in zip(m.coefficient_proofs, commitments)))
 
 
 def publish_election_initialized(e: ElectionInitialized):
@@ -350,3 +363,35 @@ def import_decryption_result(g: GroupContext, m) -> DecryptionResult:
             lagrange_coefficient=import_q(g, a.lagrange_coefficient))
             for a in m.decrypting_guardians),
         metadata=dict(m.metadata))
+
+
+# ---------------------------------------------------------------------------
+# serving plane (plaintext ballots over the wire — serve/service.py)
+# ---------------------------------------------------------------------------
+
+
+def publish_plaintext_ballot(b):
+    """PlaintextBallot dataclass -> wire message (the serving rpc's
+    request payload; distinct from Publisher.write_plaintext_ballot's
+    JSON staging form)."""
+    return pb.msg("PlaintextBallot")(
+        ballot_id=b.ballot_id, ballot_style_id=b.ballot_style_id,
+        contests=[pb.msg("PlaintextContest")(
+            contest_id=c.contest_id,
+            selections=[pb.msg("PlaintextSelection")(
+                selection_id=s.selection_id, vote=s.vote)
+                for s in c.selections])
+            for c in b.contests])
+
+
+def import_plaintext_ballot(m):
+    from electionguard_tpu.ballot.plaintext import (PlaintextBallot,
+                                                    PlaintextBallotContest,
+                                                    PlaintextBallotSelection)
+    return PlaintextBallot(
+        ballot_id=m.ballot_id, ballot_style_id=m.ballot_style_id,
+        contests=tuple(PlaintextBallotContest(
+            contest_id=c.contest_id,
+            selections=tuple(PlaintextBallotSelection(
+                s.selection_id, int(s.vote)) for s in c.selections))
+            for c in m.contests))

@@ -79,12 +79,13 @@ class RemoteTrusteeProxy(KeyCeremonyTrusteeIF):
             return resp
         if resp.error:
             return Result.Err(resp.error)
+        commitments = tuple(serialize.import_p(self.group, k)
+                            for k in resp.coefficient_commitments)
         return PublicKeys(
             resp.guardian_id, int(resp.x_coordinate),
-            tuple(serialize.import_p(self.group, k)
-                  for k in resp.coefficient_commitments),
-            tuple(serialize.import_schnorr(self.group, p)
-                  for p in resp.coefficient_proofs))
+            commitments,
+            tuple(serialize.import_schnorr(self.group, p, k)
+                  for p, k in zip(resp.coefficient_proofs, commitments)))
 
     def receive_public_keys(self, keys: PublicKeys) -> Result:
         m = pb.msg("PublicKeySet")(
@@ -369,12 +370,14 @@ class KeyCeremonyTrusteeServer:
     def _receive_public_keys(self, request, context):
         Resp = pb.msg("BoolResponse")
         try:
+            commitments = tuple(serialize.import_p(self.group, k)
+                                for k in request.coefficient_commitments)
             keys = PublicKeys(
                 request.guardian_id, int(request.x_coordinate),
-                tuple(serialize.import_p(self.group, k)
-                      for k in request.coefficient_commitments),
-                tuple(serialize.import_schnorr(self.group, p)
-                      for p in request.coefficient_proofs))
+                commitments,
+                tuple(serialize.import_schnorr(self.group, p, k)
+                      for p, k in zip(request.coefficient_proofs,
+                                      commitments)))
         except ValueError as e:
             return Resp(ok=False, error=f"malformed keys: {e}")
         trustee = self._delegate()

@@ -1,0 +1,30 @@
+"""Online ballot-encryption serving layer.
+
+The inference-server-shaped front end for the fused TPU encryptor: a gRPC
+``BallotEncryptionService`` (service.py) admits plaintext ballots into a
+bounded queue with explicit backpressure, a dynamic micro-batcher
+(batcher.py) aggregates them into a small fixed set of bucket shapes, and
+one device-owner worker thread (worker.py) drains batches through the
+existing ``encrypt.encryptor.BatchEncryptor`` / ``encrypt.fused``
+pipeline, keeping host↔device transfer off the request threads.
+Counters and histograms (metrics.py) travel over a ``getMetrics`` rpc.
+
+Every prior entry point was offline (ballots staged in a record dir
+before the encryptor runs); this subsystem is the host-side glue that the
+ROADMAP's "heavy traffic from millions of users" requires — aggregation
+into large fixed-shape batches is what makes the accelerator pay off for
+online traffic (PAPERS.md: SZKP, if-ZKP make the same point for
+accelerator ZKP provers).
+"""
+
+from electionguard_tpu.serve.batcher import (DrainingError, DynamicBatcher,
+                                             QueueFullError)
+from electionguard_tpu.serve.metrics import ServiceMetrics
+from electionguard_tpu.serve.service import EncryptionClient, EncryptionService
+from electionguard_tpu.serve.worker import EncryptionWorker
+
+__all__ = [
+    "DrainingError", "DynamicBatcher", "EncryptionClient",
+    "EncryptionService", "EncryptionWorker", "QueueFullError",
+    "ServiceMetrics",
+]

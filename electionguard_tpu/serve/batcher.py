@@ -1,0 +1,163 @@
+"""Dynamic micro-batcher: the admission queue between request threads and
+the single device-owner worker.
+
+Inference-server semantics rather than offline-loop semantics:
+
+* **Bounded admission with explicit backpressure.**  ``submit`` either
+  enqueues and returns a future, or raises ``QueueFullError`` — the
+  service maps that to gRPC RESOURCE_EXHAUSTED so clients see load
+  instead of unbounded latency.
+* **Flush on size OR age.**  A batch leaves the queue the moment it
+  reaches ``max_batch`` pending requests, or when the OLDEST pending
+  request has waited ``max_wait_ms`` — the classic dynamic-batching
+  latency/occupancy trade.
+* **Bucketed shapes.**  ``bucket_for`` rounds a flush up to the next
+  power-of-two bucket ≤ ``max_batch``; the worker pads with filler
+  ballots to exactly that size, so the device program compiles once per
+  bucket and never again under load.  Power-of-two buckets bound padding
+  waste: a bucket is always < 2× the real batch, so per-batch occupancy
+  is structurally > 50%.
+* **Graceful drain.**  ``close`` stops admission (``submit`` raises
+  ``DrainingError``); everything already admitted is still handed out —
+  promptly, ignoring ``max_wait_ms`` — and ``next_batch`` returns None
+  only once the queue is empty, so every admitted request is delivered
+  exactly once.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from electionguard_tpu.ballot.plaintext import PlaintextBallot
+
+
+class QueueFullError(Exception):
+    """Admission queue at capacity — shed load (RESOURCE_EXHAUSTED)."""
+
+
+class DrainingError(Exception):
+    """The batcher is draining/closed — no new admissions."""
+
+
+@dataclass
+class PendingRequest:
+    """One admitted request: the ballot, its completion future, and the
+    admission time (t_enqueue) the latency histogram measures from."""
+
+    ballot: PlaintextBallot
+    spoil: bool = False
+    future: Future = field(default_factory=Future)
+    t_enqueue: float = field(default_factory=time.monotonic)
+
+
+def _default_buckets(max_batch: int) -> tuple[int, ...]:
+    """Powers of two up to (and including) max_batch — the "small fixed
+    set of batch shapes"."""
+    buckets = []
+    b = 1
+    while b < max_batch:
+        buckets.append(b)
+        b <<= 1
+    buckets.append(max_batch)
+    return tuple(buckets)
+
+
+class DynamicBatcher:
+    def __init__(self, max_batch: int = 64, max_wait_ms: float = 25.0,
+                 max_queue: int = 256,
+                 buckets: Optional[Sequence[int]] = None):
+        if max_batch < 1 or max_queue < 1:
+            raise ValueError("max_batch and max_queue must be >= 1")
+        self.max_batch = max_batch
+        self.max_wait = max_wait_ms / 1000.0
+        self.max_queue = max_queue
+        self.buckets = tuple(sorted(set(buckets))) if buckets else \
+            _default_buckets(max_batch)
+        if self.buckets[-1] < max_batch:
+            raise ValueError(
+                f"largest bucket {self.buckets[-1]} < max_batch {max_batch}")
+        self._q: deque[PendingRequest] = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+
+    # ---- request side ------------------------------------------------
+    def submit(self, ballot: PlaintextBallot,
+               spoil: bool = False) -> Future:
+        """Admit one ballot; returns the future its EncryptedBallot will
+        land on.  Raises QueueFullError (backpressure) or DrainingError
+        (shutdown) instead of blocking the request thread."""
+        req = PendingRequest(ballot, spoil)
+        with self._cv:
+            if self._closed:
+                raise DrainingError("service is draining")
+            if len(self._q) >= self.max_queue:
+                raise QueueFullError(
+                    f"admission queue full ({self.max_queue})")
+            self._q.append(req)
+            self._cv.notify_all()
+        return req.future
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    # ---- worker side -------------------------------------------------
+    def next_batch(self,
+                   timeout: Optional[float] = None
+                   ) -> Optional[list[PendingRequest]]:
+        """Block until a batch is due, then pop it (≤ max_batch, FIFO).
+
+        A batch is due when ``max_batch`` requests are pending, when the
+        oldest pending request is ``max_wait_ms`` old, or immediately
+        once ``close`` was called.  Returns None when closed AND empty
+        (the worker's exit signal); an idle ``timeout`` (seconds) returns
+        [] so callers can interleave housekeeping.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                if self._q:
+                    if (len(self._q) >= self.max_batch or self._closed):
+                        break
+                    due = self._q[0].t_enqueue + self.max_wait
+                    wait = due - time.monotonic()
+                    if wait <= 0:
+                        break
+                else:
+                    if self._closed:
+                        return None
+                    if deadline is not None and time.monotonic() >= deadline:
+                        return []
+                    wait = None if deadline is None else \
+                        deadline - time.monotonic()
+                self._cv.wait(wait)
+            n = min(self.max_batch, len(self._q))
+            batch = [self._q.popleft() for _ in range(n)]
+            self._cv.notify_all()
+            return batch
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest configured bucket ≥ n (n ≤ max_batch always holds
+        for batches this batcher produced)."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"batch of {n} exceeds largest bucket "
+                         f"{self.buckets[-1]}")
+
+    # ---- lifecycle ---------------------------------------------------
+    def close(self) -> None:
+        """Stop admitting; wake the worker so it drains what remains."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        with self._cv:
+            return self._closed

@@ -1,0 +1,240 @@
+"""gRPC ``BallotEncryptionService``: the online encryption front end.
+
+Built on the same runtime-descriptor plumbing as the trustee planes
+(``remote/rpc_util.py``): no generated stubs, the .proto stays the
+contract.  Request threads only parse, submit to the batcher, and block
+on futures — all device work happens on the one ``EncryptionWorker``.
+
+Backpressure is explicit: a full admission queue aborts the rpc with
+RESOURCE_EXHAUSTED, a draining service with UNAVAILABLE.  Invalid
+ballots (unknown contest, overvote, duplicate id, ...) travel in-band as
+``error`` strings, like every other response in the rpc plane.
+
+Graceful drain (``drain()``, wired to SIGTERM in
+``cli/run_encryption_service.py``): stop admitting, flush every admitted
+request through the device, close the record stream so the partial
+record is publishable, then stop the server.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Sequence
+
+import grpc
+
+from electionguard_tpu.ballot.plaintext import PlaintextBallot
+from electionguard_tpu.core.group import ElementModQ, GroupContext
+from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+from electionguard_tpu.publish import pb, serialize
+from electionguard_tpu.publish.election_record import ElectionInitialized
+from electionguard_tpu.publish.publisher import Publisher
+from electionguard_tpu.remote import rpc_util
+from electionguard_tpu.serve.batcher import (DrainingError, DynamicBatcher,
+                                             QueueFullError)
+from electionguard_tpu.serve.metrics import ServiceMetrics
+from electionguard_tpu.serve.worker import EncryptionWorker, InvalidBallotError
+
+log = logging.getLogger("serve.service")
+
+_SERVICE = "BallotEncryptionService"
+#: request-thread wait on the worker: generous — the batcher bounds the
+#: queue, so a healthy worker clears any admitted request in
+#: queue/throughput time; this only fires if the device owner died.
+_RESULT_TIMEOUT = 300.0
+
+
+class EncryptionService:
+    """One serving process: gRPC server + batcher + device-owner worker,
+    optionally publishing the growing record to ``out_dir``."""
+
+    def __init__(self, init: ElectionInitialized,
+                 group: Optional[GroupContext] = None,
+                 port: int = 0,
+                 out_dir: Optional[str] = None,
+                 max_batch: int = 64,
+                 max_wait_ms: float = 25.0,
+                 max_queue: int = 256,
+                 buckets: Optional[Sequence[int]] = None,
+                 seed: Optional[ElementModQ] = None,
+                 timestamp: Optional[int] = None,
+                 prewarm: bool = True,
+                 mesh=None,
+                 max_workers: int = 16,
+                 hold: Optional[threading.Event] = None):
+        self.init = init
+        self.group = group if group is not None else \
+            init.joint_public_key.group
+        self.publisher = Publisher(out_dir) if out_dir else None
+        self._stream = None
+        if self.publisher is not None:
+            # the record dir is self-contained from the first ballot on:
+            # init lands before serving starts, ballots append as batches
+            # drain, so a SIGTERM drain only has to close the stream
+            self.publisher.write_election_initialized(init)
+            self._stream = self.publisher.open_encrypted_ballots()
+        self.batcher = DynamicBatcher(max_batch=max_batch,
+                                      max_wait_ms=max_wait_ms,
+                                      max_queue=max_queue, buckets=buckets)
+        self.metrics = ServiceMetrics(queue_depth=self.batcher.depth)
+        self.worker = EncryptionWorker(
+            self.batcher, BatchEncryptor(init, self.group, mesh=mesh),
+            self.metrics, seed=seed, timestamp=timestamp,
+            stream=self._stream, hold=hold)
+        if prewarm:
+            # compile every (program, bucket) pair before the first
+            # request: under load the compile counter stays flat
+            self.worker.prewarm()
+        self.worker.start()
+        self.server, self.port = rpc_util.make_server(
+            port, max_workers=max_workers)
+        self.server.add_generic_rpc_handlers((rpc_util.generic_service(
+            _SERVICE,
+            {"encryptBallot": self._encrypt_ballot,
+             "encryptBallotBatch": self._encrypt_ballot_batch,
+             "getMetrics": self._get_metrics}),))
+        self.server.start()
+        self._drained = threading.Event()
+        log.info("encryption service on port %d (max_batch=%d "
+                 "max_wait=%.0fms max_queue=%d buckets=%s)", self.port,
+                 max_batch, max_wait_ms, max_queue,
+                 list(self.batcher.buckets))
+
+    # ---- rpc impls ---------------------------------------------------
+    def _submit(self, ballot_msg, spoil: bool, context):
+        """Parse + admit one request; returns the future or aborts."""
+        ballot = serialize.import_plaintext_ballot(ballot_msg)
+        if ballot.ballot_id.startswith("__pad-"):
+            # the filler namespace is the worker's, not the client's
+            return None, "ballot id prefix '__pad-' is reserved"
+        try:
+            self.metrics.inc("requests_admitted")
+            return self.batcher.submit(ballot, spoil=spoil), None
+        except QueueFullError as e:
+            self.metrics.inc("requests_admitted", -1)
+            self.metrics.inc("requests_rejected_queue_full")
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        except DrainingError as e:
+            self.metrics.inc("requests_admitted", -1)
+            self.metrics.inc("requests_rejected_draining")
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+
+    def _resolve(self, future, error):
+        Resp = pb.msg("EncryptBallotResponse")
+        if future is None:
+            return Resp(error=error)
+        try:
+            b = future.result(timeout=_RESULT_TIMEOUT)
+        except InvalidBallotError as e:
+            return Resp(error=f"invalid ballot: {e}")
+        except Exception as e:  # noqa: BLE001 — in-band, like the planes
+            self.metrics.inc("requests_failed")
+            return Resp(error=f"encryption failed: {type(e).__name__}: {e}")
+        return Resp(
+            encrypted_ballot=serialize.publish_encrypted_ballot(b),
+            confirmation_code=b.code)
+
+    def _encrypt_ballot(self, request, context):
+        future, err = self._submit(request.ballot, request.spoil, context)
+        return self._resolve(future, err)
+
+    def _encrypt_ballot_batch(self, request, context):
+        # admit everything first (one flush can take the whole batch),
+        # then gather; admission failures for a batch rpc go in-band so
+        # the accepted prefix still completes exactly once
+        pending = []
+        for bm in request.ballots:
+            ballot = serialize.import_plaintext_ballot(bm)
+            if ballot.ballot_id.startswith("__pad-"):
+                pending.append((None, "ballot id prefix '__pad-' is "
+                                      "reserved"))
+                continue
+            try:
+                self.metrics.inc("requests_admitted")
+                pending.append((self.batcher.submit(ballot), None))
+            except QueueFullError as e:
+                self.metrics.inc("requests_admitted", -1)
+                self.metrics.inc("requests_rejected_queue_full")
+                pending.append((None, f"RESOURCE_EXHAUSTED: {e}"))
+            except DrainingError as e:
+                self.metrics.inc("requests_admitted", -1)
+                self.metrics.inc("requests_rejected_draining")
+                pending.append((None, f"UNAVAILABLE: {e}"))
+        return pb.msg("EncryptBallotBatchResponse")(
+            results=[self._resolve(f, err) for f, err in pending])
+
+    def _get_metrics(self, request, context):
+        return self.metrics.to_proto()
+
+    # ---- lifecycle ---------------------------------------------------
+    def drain(self, grace: float = 5.0) -> None:
+        """Graceful shutdown: stop admitting, flush in-flight batches,
+        publish the partial record, stop the server.  Idempotent."""
+        if self._drained.is_set():
+            return
+        self._drained.set()
+        log.info("draining: %d requests queued", self.batcher.depth())
+        self.batcher.close()
+        self.worker.join(timeout=_RESULT_TIMEOUT)
+        if self._stream is not None:
+            self._stream.close()
+            self._stream = None
+        # request threads blocked in _resolve still hold completed
+        # futures; give them `grace` to serialize their responses
+        self.server.stop(grace=grace).wait(grace)
+        log.info("drained: %s", self.metrics.summary())
+
+    def shutdown(self) -> None:
+        self.drain(grace=1.0)
+
+
+class EncryptionClient:
+    """Client stub: ``encrypt`` one ballot, ``encrypt_batch`` many,
+    ``metrics`` for the live counters/histograms.  Raises grpc.RpcError
+    with RESOURCE_EXHAUSTED on backpressure (callers decide whether to
+    retry) and ValueError on in-band invalid-ballot errors."""
+
+    def __init__(self, url: str, group: GroupContext):
+        self.group = group
+        self._channel = rpc_util.make_channel(url)
+        self._stub = rpc_util.Stub(self._channel, _SERVICE)
+
+    def encrypt(self, ballot: PlaintextBallot, spoil: bool = False,
+                timeout: float = 120.0):
+        resp = self._stub.call(
+            "encryptBallot",
+            pb.msg("EncryptBallotRequest")(
+                ballot=serialize.publish_plaintext_ballot(ballot),
+                spoil=spoil),
+            timeout=timeout)
+        if resp.error:
+            raise ValueError(resp.error)
+        return serialize.import_encrypted_ballot(self.group,
+                                                 resp.encrypted_ballot)
+
+    def encrypt_batch(self, ballots: Sequence[PlaintextBallot],
+                      timeout: float = 300.0):
+        """Returns [(EncryptedBallot | None, error_str | None)] aligned
+        with the request."""
+        resp = self._stub.call(
+            "encryptBallotBatch",
+            pb.msg("EncryptBallotBatchRequest")(
+                ballots=[serialize.publish_plaintext_ballot(b)
+                         for b in ballots]),
+            timeout=timeout)
+        out = []
+        for r in resp.results:
+            if r.error:
+                out.append((None, r.error))
+            else:
+                out.append((serialize.import_encrypted_ballot(
+                    self.group, r.encrypted_ballot), None))
+        return out
+
+    def metrics(self, timeout: float = 30.0):
+        return self._stub.call("getMetrics", pb.msg("MetricsRequest")(),
+                               timeout=timeout)
+
+    def close(self) -> None:
+        self._channel.close()

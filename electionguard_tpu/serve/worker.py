@@ -1,0 +1,183 @@
+"""The device-owner loop: drains batches into the batch encryptor.
+
+Exactly ONE worker thread talks to the device, so request threads never
+touch host↔device transfer — they block on futures while the worker runs
+the fused pipeline (``encrypt/fused.py`` on the production group, the
+batched host-hash fallback elsewhere) over padded, bucket-shaped batches.
+
+Padding and the code chain
+--------------------------
+Each flush is padded to its bucket with filler ballots appended AFTER the
+real requests.  Because nonces are keyed by ballot identity, fillers
+change nothing about the real ballots' ciphertexts; and because the
+confirmation-code chain runs through the batch in order, the real
+ballots' codes form a contiguous chain prefix.  The worker advances its
+cross-batch ``code_seed`` to the LAST REAL ballot's code and discards the
+filler tail, so the published stream is bit-for-bit what the offline
+``BatchEncryptor`` would produce for the same ballots in the same order
+(given the same seed and timestamp) — the serving layer adds batching,
+not a second crypto path.
+
+``prewarm()`` encrypts one all-filler batch per bucket at startup, so
+every device program is compiled before the first request arrives and the
+``device_compiles`` metric stays flat under load.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from electionguard_tpu.ballot.plaintext import (PlaintextBallot,
+                                                PlaintextBallotContest,
+                                                PlaintextBallotSelection)
+from electionguard_tpu.core.group import ElementModQ
+from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+from electionguard_tpu.serve.batcher import DynamicBatcher, PendingRequest
+from electionguard_tpu.serve.metrics import ServiceMetrics
+
+log = logging.getLogger("serve.worker")
+
+
+class InvalidBallotError(Exception):
+    """The ballot failed admission validation inside the encryptor
+    (unknown contest/selection, overvote, duplicate id, ...)."""
+
+
+class EncryptionWorker(threading.Thread):
+    def __init__(self, batcher: DynamicBatcher, encryptor: BatchEncryptor,
+                 metrics: ServiceMetrics,
+                 seed: Optional[ElementModQ] = None,
+                 timestamp: Optional[int] = None,
+                 stream=None,
+                 hold: Optional[threading.Event] = None):
+        """``stream``: optional ``EncryptedBallotStream`` every real
+        encrypted ballot is appended to (the growing record).
+        ``timestamp``: pin the ballot timestamp (tests/differential runs);
+        None stamps each batch with encryption time.
+        ``hold``: when given, the worker waits on it before each pull —
+        a test hook to force queue buildup deterministically."""
+        super().__init__(name="encryption-worker", daemon=True)
+        self.batcher = batcher
+        self.enc = encryptor
+        self.metrics = metrics
+        self.seed = seed if seed is not None else encryptor.group.rand_q()
+        self.timestamp = timestamp
+        self.stream = stream
+        self.hold = hold
+        self._code_seed: Optional[bytes] = None
+        self._pad_counter = 0
+        self._filler_proto = self._make_filler_proto()
+        self.error: Optional[BaseException] = None
+
+    # ---- filler ballots ---------------------------------------------
+    def _make_filler_proto(self):
+        """Contests of the manifest's first ballot style, all votes 0 —
+        a structurally valid undervote the encryptor pads internally."""
+        manifest = self.enc.manifest
+        style = manifest.ballot_styles[0]
+        contests = tuple(
+            PlaintextBallotContest(
+                contest_id=c.object_id,
+                selections=tuple(PlaintextBallotSelection(s.object_id, 0)
+                                 for s in c.selections))
+            for c in manifest.contests_for_style(style.object_id))
+        return style.object_id, contests
+
+    def _filler(self) -> PlaintextBallot:
+        self._pad_counter += 1
+        style_id, contests = self._filler_proto
+        return PlaintextBallot(f"__pad-{self._pad_counter:09d}",
+                               style_id, contests)
+
+    # ---- lifecycle ---------------------------------------------------
+    def prewarm(self) -> None:
+        """Encrypt one all-filler batch per bucket: compiles every
+        (program, bucket shape) pair up front.  Filler-only batches have
+        no real ballots, so neither the code chain nor the record stream
+        moves."""
+        for bucket in self.batcher.buckets:
+            self._encrypt([], bucket)
+
+    def run(self) -> None:
+        import time as _time
+        while True:
+            if self.hold is not None:
+                self.hold.wait()
+            batch = self.batcher.next_batch()
+            if batch is None:
+                return
+            try:
+                self._process(batch, _time.monotonic)
+            except BaseException as e:  # noqa: BLE001 — keep serving
+                # _process already failed the batch's futures; a raise
+                # here would kill the one device owner and wedge every
+                # future request
+                self.error = e
+                log.exception("batch processing failed")
+
+    # ---- the hot path ------------------------------------------------
+    def _encrypt(self, real: list[PendingRequest], bucket: int):
+        ballots = [p.ballot for p in real]
+        fillers = [self._filler() for _ in range(bucket - len(ballots))]
+        spoiled = {p.ballot.ballot_id for p in real if p.spoil}
+        encrypted, invalid = self.enc.encrypt_ballots(
+            ballots + fillers, seed=self.seed, code_seed=self._code_seed,
+            spoiled_ids=spoiled, timestamp=self.timestamp)
+        filler_ids = {f.ballot_id for f in fillers}
+        # fillers sit at the tail of the valid list, so the real prefix
+        # is chain-contiguous; keep it, discard the filler tail
+        real_encrypted = []
+        for b in encrypted:
+            if b.ballot_id in filler_ids:
+                break
+            real_encrypted.append(b)
+        return real_encrypted, invalid, spoiled
+
+    def _process(self, batch: list[PendingRequest], clock) -> None:
+        bucket = self.batcher.bucket_for(len(batch))
+        depth = self.batcher.depth()
+        try:
+            real_encrypted, invalid, spoiled = self._encrypt(batch, bucket)
+        except BaseException as e:
+            for p in batch:
+                if not p.future.set_running_or_notify_cancel():
+                    continue
+                p.future.set_exception(e)
+            self.metrics.inc("requests_failed", len(batch))
+            raise
+        if real_encrypted:
+            self._code_seed = real_encrypted[-1].code
+            if self.stream is not None:
+                for b in real_encrypted:
+                    self.stream.write(b)
+        by_id = {b.ballot_id: b for b in real_encrypted}
+        inv_by_id = {b.ballot_id: reason for b, reason in invalid}
+        now = clock()
+        for p in batch:
+            self.metrics.latency_ms.observe((now - p.t_enqueue) * 1e3)
+            if not p.future.set_running_or_notify_cancel():
+                continue
+            # pop, not get: of two same-id requests in one batch, only
+            # the first owns the encrypted ballot; the second is the
+            # duplicate the encryptor rejected
+            b = by_id.pop(p.ballot.ballot_id, None)
+            if b is not None:
+                p.future.set_result(b)
+            else:
+                reason = inv_by_id.get(p.ballot.ballot_id,
+                                       "not returned by encryptor")
+                self.metrics.inc("ballots_invalid")
+                p.future.set_exception(InvalidBallotError(reason))
+        self.metrics.inc("ballots_encrypted", len(real_encrypted))
+        self.metrics.inc("ballots_spoiled",
+                         sum(1 for b in real_encrypted
+                             if b.ballot_id in spoiled))
+        self.metrics.observe_flush(len(batch), bucket, depth)
+
+    @property
+    def code_seed(self) -> Optional[bytes]:
+        """The last real ballot's confirmation code (the chain head the
+        next batch continues from); None before any real ballot."""
+        return self._code_seed

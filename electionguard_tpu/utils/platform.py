@@ -13,6 +13,7 @@ Pure stdlib: importing this module must never touch jax.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import subprocess
 import sys
@@ -108,3 +109,31 @@ def ensure_tpu_or_cpu(probe_timeout: float = 90.0,
     log("# falling back to CPU: axon tunnel unreachable", file=sys.stderr)
     detach_axon()
     return "cpu"
+
+
+@contextlib.contextmanager
+def pinned_child_platform(platform: str = "cpu"):
+    """Temporarily shape ``os.environ`` so SPAWNED children initialize
+    jax on ``platform`` — and restore it on exit.
+
+    Env assignment inside an already-running child comes TOO LATE: the
+    module import chain (and on some machines a site hook) imports jax
+    before any worker body runs, so ``JAX_PLATFORMS`` must be in the
+    environment the child INHERITS at interpreter startup.  For
+    ``platform="cpu"`` the tunnel vars are scrubbed too (detach_axon
+    semantics) so the axon plugin never dials the relay from a feeder;
+    for any other platform the tunnel env is left intact and only
+    ``JAX_PLATFORMS`` is pinned.  The PARENT's live jax config is never
+    touched — a TPU-resident parent keeps its backend.
+    """
+    snapshot = dict(os.environ)
+    try:
+        if platform == "cpu":
+            for k in list(os.environ):
+                if _is_tunnel_var(k):
+                    del os.environ[k]
+        os.environ["JAX_PLATFORMS"] = platform
+        yield
+    finally:
+        os.environ.clear()
+        os.environ.update(snapshot)

@@ -250,11 +250,13 @@ class Verifier:
         if proofs:
             ok, sub = batch_schnorr_verify(self.group, proofs,
                                            check_subgroup=True)
+            # one error per failing proof: a proof failing both masks
+            # reports the Schnorr failure, not a second subgroup line
             for i in np.nonzero(~ok)[0]:
                 gid, j = refs[int(i)]
                 res.record("V2.guardian_keys", False,
                            f"{gid} Schnorr {j} invalid")
-            for i in np.nonzero(~sub)[0]:
+            for i in np.nonzero(ok & ~sub)[0]:
                 gid, j = refs[int(i)]
                 res.record("V2.guardian_keys", False,
                            f"{gid} commitment {j} not in subgroup")

@@ -138,3 +138,47 @@ def test_cli_feeders_pass_and_reject_tamper(record_dir, election):
     proc = _run_cli(record_dir, 2)
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "V6" in proc.stdout
+
+
+def test_feeder_platform_pinned_in_parent_env_before_spawn():
+    """The platform pin must live in the PARENT env while the spawn Pool
+    is created (children inherit it at interpreter startup), honoring
+    EGTPU_FEEDER_PLATFORM, scrubbing tunnel vars for the CPU default,
+    and restoring everything afterwards (ADVICE r5 medium)."""
+    from electionguard_tpu.utils.platform import pinned_child_platform
+
+    os.environ["TPU_FAKE_TUNNEL"] = "1"
+    os.environ["AXON_FAKE"] = "relay"
+    prev_jax = os.environ.get("JAX_PLATFORMS")
+    try:
+        with pinned_child_platform("cpu"):
+            # inside: children would inherit CPU pinning, no tunnel vars
+            assert os.environ["JAX_PLATFORMS"] == "cpu"
+            assert "TPU_FAKE_TUNNEL" not in os.environ
+            assert "AXON_FAKE" not in os.environ
+        # restored exactly
+        assert os.environ["TPU_FAKE_TUNNEL"] == "1"
+        assert os.environ["AXON_FAKE"] == "relay"
+        assert os.environ.get("JAX_PLATFORMS") == prev_jax
+
+        # an explicit non-CPU override keeps the tunnel env intact
+        with pinned_child_platform("tpu"):
+            assert os.environ["JAX_PLATFORMS"] == "tpu"
+            assert os.environ["TPU_FAKE_TUNNEL"] == "1"
+        assert os.environ.get("JAX_PLATFORMS") == prev_jax
+    finally:
+        os.environ.pop("TPU_FAKE_TUNNEL", None)
+        os.environ.pop("AXON_FAKE", None)
+
+
+def test_feeder_worker_has_no_late_platform_assignment():
+    """The in-worker JAX_PLATFORMS assignment (too late: jax is already
+    imported in the child when the worker body runs) must stay gone —
+    the pin happens around the Pool in _verify_with_feeders."""
+    import inspect
+
+    from electionguard_tpu.cli import run_verifier
+    worker_src = inspect.getsource(run_verifier._feeder_worker)
+    assert "JAX_PLATFORMS" not in worker_src
+    fan_src = inspect.getsource(run_verifier._verify_with_feeders)
+    assert "pinned_child_platform" in fan_src

@@ -1,0 +1,399 @@
+"""Serving-plane tests: dynamic batcher semantics, the gRPC encryption
+service end to end (real localhost channels, N concurrent clients), and
+the loadgen smoke run the acceptance criteria require.
+
+The heavyweight invariants pinned here:
+
+* the record a draining service publishes passes the full verifier, and
+  its confirmation codes are BIT-FOR-BIT what the offline BatchEncryptor
+  produces for the same ballots in the same order (same seed/timestamp)
+  — serving adds batching, not a second crypto path;
+* bucket-shaped padding keeps the compiled-program count flat after
+  warmup (one compile per shape bucket, never again under load);
+* backpressure is explicit (RESOURCE_EXHAUSTED) and graceful drain
+  delivers every admitted request exactly once.
+"""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+from electionguard_tpu.publish.election_record import ElectionConfig
+from electionguard_tpu.serve.batcher import (DrainingError, DynamicBatcher,
+                                             QueueFullError)
+from tests.test_keyceremony import tiny_manifest
+
+
+def _ballot(i: int):
+    from electionguard_tpu.ballot.plaintext import (PlaintextBallot,
+                                                    PlaintextBallotContest,
+                                                    PlaintextBallotSelection)
+    return PlaintextBallot(
+        f"ballot-{i:05d}", "style-0",
+        (PlaintextBallotContest(
+            "contest-0", (PlaintextBallotSelection("sel-0", i % 2),
+                          PlaintextBallotSelection("sel-1", 0))),))
+
+
+# =====================================================================
+# batcher unit tests
+# =====================================================================
+
+
+def test_batcher_flush_on_full():
+    b = DynamicBatcher(max_batch=4, max_wait_ms=10_000, max_queue=16)
+    for i in range(4):
+        b.submit(_ballot(i))
+    t0 = time.monotonic()
+    batch = b.next_batch()
+    # full batch flushes immediately — nowhere near the 10 s age flush
+    assert len(batch) == 4 and time.monotonic() - t0 < 1.0
+    assert [p.ballot.ballot_id for p in batch] == \
+        [f"ballot-{i:05d}" for i in range(4)]  # FIFO
+
+
+def test_batcher_flush_on_timeout():
+    b = DynamicBatcher(max_batch=64, max_wait_ms=60, max_queue=16)
+    t0 = time.monotonic()
+    b.submit(_ballot(0))
+    batch = b.next_batch()
+    waited = time.monotonic() - t0
+    assert len(batch) == 1
+    assert waited >= 0.05, f"flushed before max_wait ({waited:.3f}s)"
+
+
+def test_batcher_backpressure_queue_full():
+    b = DynamicBatcher(max_batch=4, max_wait_ms=200, max_queue=3)
+    for i in range(3):
+        b.submit(_ballot(i))
+    with pytest.raises(QueueFullError):
+        b.submit(_ballot(99))
+    # popping a batch (age flush: 3 < max_batch) frees capacity again
+    assert len(b.next_batch()) == 3
+    b.submit(_ballot(100))
+
+
+def test_batcher_bucket_shapes():
+    b = DynamicBatcher(max_batch=64, max_queue=64)
+    assert b.buckets == (1, 2, 4, 8, 16, 32, 64)
+    assert b.bucket_for(1) == 1
+    assert b.bucket_for(3) == 4
+    assert b.bucket_for(33) == 64
+    # power-of-two buckets bound padding: occupancy structurally > 50%
+    for n in range(1, 65):
+        assert n / b.bucket_for(n) > 0.5
+    b2 = DynamicBatcher(max_batch=6, max_queue=8)
+    assert b2.buckets == (1, 2, 4, 6)
+    with pytest.raises(ValueError):
+        DynamicBatcher(max_batch=8, max_queue=8, buckets=[1, 2, 4])
+
+
+def test_batcher_drain_delivers_every_admitted_exactly_once():
+    b = DynamicBatcher(max_batch=4, max_wait_ms=10_000, max_queue=64)
+    futures = [b.submit(_ballot(i)) for i in range(10)]
+    b.close()
+    with pytest.raises(DrainingError):
+        b.submit(_ballot(999))
+    seen = []
+    while True:
+        batch = b.next_batch()
+        if batch is None:
+            break
+        seen.extend(p.ballot.ballot_id for p in batch)
+        for p in batch:  # the worker would resolve these
+            p.future.set_result(p.ballot.ballot_id)
+    assert seen == [f"ballot-{i:05d}" for i in range(10)]
+    assert len(seen) == len(set(seen)) == 10  # exactly once
+    assert [f.result(timeout=1) for f in futures] == seen
+    assert b.next_batch() is None  # stays drained
+
+
+def test_batcher_close_flushes_partial_immediately():
+    b = DynamicBatcher(max_batch=64, max_wait_ms=60_000, max_queue=8)
+    b.submit(_ballot(0))
+    box: dict[str, object] = {}
+
+    def worker():
+        box["batch"] = b.next_batch()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.1)  # worker is now waiting out max_wait
+    b.close()        # drain must cut the wait short
+    t.join(timeout=5)
+    assert not t.is_alive() and len(box["batch"]) == 1
+
+
+# =====================================================================
+# service fixtures
+# =====================================================================
+
+
+@pytest.fixture(scope="module")
+def serve_init(tgroup):
+    """ElectionInitialized for the serving tests (module-scoped: the key
+    ceremony is the slow part)."""
+    from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+    from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+    trustees = [KeyCeremonyTrustee(tgroup, f"guardian-{i}", i + 1, 2)
+                for i in range(3)]
+    return key_ceremony_exchange(trustees, tgroup).make_election_initialized(
+        ElectionConfig(tiny_manifest(), 3, 2), {"created_by": "serve-test"})
+
+
+def _make_service(init, group, tmp_path=None, **kw):
+    from electionguard_tpu.serve.service import EncryptionService
+    kw.setdefault("seed", group.int_to_q(42))
+    kw.setdefault("timestamp", 1754_000_000)
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_ms", 15)
+    return EncryptionService(
+        init, group,
+        out_dir=str(tmp_path / "record") if tmp_path is not None else None,
+        **kw)
+
+
+# =====================================================================
+# service end-to-end
+# =====================================================================
+
+
+def test_service_e2e_concurrent_clients_verify_and_bitmatch(
+        serve_init, tgroup, tmp_path):
+    """Acceptance: N≥4 concurrent gRPC clients; the published record
+    passes every verifier check; codes match the offline BatchEncryptor
+    bit-for-bit."""
+    from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+    from electionguard_tpu.publish.election_record import ElectionRecord
+    from electionguard_tpu.publish.publisher import Consumer
+    from electionguard_tpu.serve.service import EncryptionClient
+    from electionguard_tpu.verify.verifier import Verifier
+
+    # 8 ballots: the offline re-encryption below runs as ONE batch of 8,
+    # the same dispatch shape the bucket-8 prewarm already compiled — the
+    # test adds no fresh device-program compiles to the tier-1 budget
+    svc = _make_service(serve_init, tgroup, tmp_path)
+    ballots = list(RandomBallotProvider(tiny_manifest(), 8,
+                                        seed=11).ballots())
+    results: dict[str, object] = {}
+    errors: list[BaseException] = []
+
+    def client_run(idx):
+        client = EncryptionClient(f"localhost:{svc.port}", tgroup)
+        try:
+            for b in ballots[idx::4]:
+                enc = client.encrypt(b)
+                results[b.ballot_id] = enc
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=client_run, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert len(results) == 8
+    svc.drain()
+
+    # the published record passes the verifier
+    cons = Consumer(str(tmp_path / "record"), tgroup)
+    record = ElectionRecord(cons.read_election_initialized())
+    record.encrypted_ballots = list(cons.iterate_encrypted_ballots())
+    assert len(record.encrypted_ballots) == 8
+    res = Verifier(record, tgroup).verify()
+    assert res.ok, res.summary()
+    # no filler ballot ever reaches the published record
+    assert not any(b.ballot_id.startswith("__pad-")
+                   for b in record.encrypted_ballots)
+
+    # bit-for-bit: offline BatchEncryptor over the same ballots in the
+    # service's processing order reproduces ciphertexts AND codes
+    by_id = {b.ballot_id: b for b in ballots}
+    order = [b.ballot_id for b in record.encrypted_ballots]
+    offline_enc = BatchEncryptor(serve_init, tgroup)
+    offline, invalid = offline_enc.encrypt_ballots(
+        [by_id[i] for i in order], seed=tgroup.int_to_q(42),
+        timestamp=1754_000_000)
+    assert not invalid
+    assert offline == record.encrypted_ballots
+    # ... and the codes the clients saw are the offline codes
+    for off in offline:
+        assert results[off.ballot_id].code == off.code
+
+
+def test_service_invalid_ballot_in_band_error(serve_init, tgroup):
+    import dataclasses
+
+    from electionguard_tpu.serve.service import EncryptionClient
+    svc = _make_service(serve_init, tgroup)
+    try:
+        client = EncryptionClient(f"localhost:{svc.port}", tgroup)
+        good = _ballot(1)
+        bad_contest = dataclasses.replace(
+            good, ballot_id="bad-1",
+            contests=(dataclasses.replace(
+                good.contests[0], contest_id="no-such-contest"),))
+        with pytest.raises(ValueError, match="unknown contest"):
+            client.encrypt(bad_contest)
+        with pytest.raises(ValueError, match="reserved"):
+            client.encrypt(dataclasses.replace(good,
+                                               ballot_id="__pad-000000001"))
+        # a good ballot still flows after the failures
+        enc = client.encrypt(good)
+        assert enc.ballot_id == good.ballot_id
+        client.close()
+    finally:
+        svc.drain()
+
+
+def test_service_backpressure_resource_exhausted(serve_init, tgroup):
+    """Queue full -> RESOURCE_EXHAUSTED on the wire; after the worker is
+    released every admitted request completes."""
+    from electionguard_tpu.serve.service import EncryptionClient
+    hold = threading.Event()  # worker blocked until set
+    svc = _make_service(serve_init, tgroup, max_batch=2, max_queue=2,
+                        max_wait_ms=5, hold=hold)
+    try:
+        client = EncryptionClient(f"localhost:{svc.port}", tgroup)
+        results, codes = [], []
+
+        def submit(i):
+            try:
+                results.append(client.encrypt(_ballot(i), timeout=60))
+            except grpc.RpcError as e:
+                codes.append(e.code())
+
+        threads = [threading.Thread(target=submit, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+            time.sleep(0.15)  # deterministic queue buildup order
+        # 2 admitted (queued, worker held), 2 rejected with explicit
+        # backpressure; releasing the worker completes the admitted ones
+        hold.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert codes.count(grpc.StatusCode.RESOURCE_EXHAUSTED) == 2
+        assert len(results) == 2  # every admitted request completed
+        client.close()
+    finally:
+        hold.set()
+        svc.drain()
+
+
+def test_service_drain_rejects_new_completes_inflight(serve_init, tgroup):
+    from electionguard_tpu.serve.batcher import DrainingError
+    svc = _make_service(serve_init, tgroup, max_batch=4, max_wait_ms=200)
+    futures = [svc.batcher.submit(_ballot(i)) for i in range(3)]
+    svc.drain()
+    # every admitted request completed exactly once, despite the drain
+    # cutting the 200 ms age flush short
+    encs = [f.result(timeout=1) for f in futures]
+    assert [e.ballot_id for e in encs] == \
+        [f"ballot-{i:05d}" for i in range(3)]
+    with pytest.raises(DrainingError):
+        svc.batcher.submit(_ballot(99))
+    svc.drain()  # idempotent
+
+
+def test_service_spoiled_ballot(serve_init, tgroup):
+    from electionguard_tpu.ballot.ciphertext import BallotState
+    from electionguard_tpu.serve.service import EncryptionClient
+    svc = _make_service(serve_init, tgroup)
+    try:
+        client = EncryptionClient(f"localhost:{svc.port}", tgroup)
+        enc = client.encrypt(_ballot(7), spoil=True)
+        assert enc.state == BallotState.SPOILED
+        client.close()
+    finally:
+        svc.drain()
+
+
+# =====================================================================
+# compile stability + loadgen smoke
+# =====================================================================
+
+
+def test_bucket_shape_stability_no_recompile(serve_init, tgroup):
+    """Second batch of an already-seen bucket triggers ZERO new device
+    compiles — the prewarmed bucket set is the whole compiled-shape
+    universe."""
+    from electionguard_tpu.serve.metrics import device_compile_count
+    from electionguard_tpu.serve.worker import EncryptionWorker
+    from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+    from electionguard_tpu.serve.batcher import DynamicBatcher
+    from electionguard_tpu.serve.metrics import ServiceMetrics
+
+    batcher = DynamicBatcher(max_batch=4, max_wait_ms=5, max_queue=16)
+    metrics = ServiceMetrics(queue_depth=batcher.depth)
+    worker = EncryptionWorker(batcher, BatchEncryptor(serve_init, tgroup),
+                              metrics, seed=tgroup.int_to_q(9))
+    worker.prewarm()  # compiles every (program, bucket) pair
+
+    def run_batch(ids):
+        futs = [batcher.submit(_ballot(i)) for i in ids]
+        batch = batcher.next_batch()
+        worker._process(batch, time.monotonic)
+        return [f.result(timeout=1) for f in futs]
+
+    run_batch([100, 101, 102])       # bucket 4 (padded from 3)
+    warm = device_compile_count()
+    run_batch([110, 111, 112, 113])  # bucket 4 again, different fill
+    run_batch([120])                 # bucket 1 (prewarmed too)
+    assert device_compile_count() == warm, \
+        "recompile on an already-warm bucket shape"
+    assert metrics.get("padded_slots") == 1  # only the 3->4 pad
+    # prewarm batches are not traffic: occupancy saw the 3 real flushes
+    occ = metrics.batch_occupancy.snapshot()
+    assert occ["count"] == 3
+
+
+def test_loadgen_smoke_occupancy_and_compile_stability(
+        serve_init, tgroup, tmp_path):
+    """Acceptance: under the loadgen smoke run, compile count is stable
+    after warmup, mean batch occupancy ≥ 50% at saturation, and the
+    metrics rpc reports queue depth, occupancy, and latency histograms."""
+    import sys
+    sys.path.insert(0, "tools")
+    from loadgen_encrypt import run_loadgen
+    from electionguard_tpu.serve.metrics import device_compile_count
+
+    svc = _make_service(serve_init, tgroup, tmp_path, max_batch=8,
+                        max_wait_ms=30, max_queue=32)
+    try:
+        url = f"localhost:{svc.port}"
+        report = run_loadgen(url, tiny_manifest(), tgroup, nclients=4,
+                             nballots=4, seed=1)
+        assert report["errors"] == 0
+        assert report["completed"] == 16
+        assert report["ballots_per_s"] > 0
+        # occupancy ≥ 50% at saturation: structural with power-of-two
+        # buckets, and the metrics rpc must agree
+        assert report["batch_occupancy_mean"] >= 0.5
+        # warmup done: a second identical wave adds ZERO compiles
+        warm = device_compile_count()
+        report2 = run_loadgen(url, tiny_manifest(), tgroup, nclients=4,
+                              nballots=4, seed=2)
+        assert report2["errors"] == 0
+        assert device_compile_count() == warm, \
+            "compile-cache entries grew after warmup"
+        # the metrics rpc carries the full observability surface
+        c = report2["service_counters"]
+        for key in ("queue_depth", "ballots_encrypted", "batches_flushed",
+                    "device_compiles", "padded_slots"):
+            assert key in c, f"missing counter {key}"
+        from electionguard_tpu.serve.service import EncryptionClient
+        client = EncryptionClient(url, tgroup)
+        hists = {h.name for h in client.metrics().histograms}
+        client.close()
+        assert {"request_latency_ms", "batch_occupancy",
+                "queue_depth_at_flush"} <= hists
+    finally:
+        svc.drain()

@@ -1,0 +1,175 @@
+"""Multi-client load generator for the online encryption service.
+
+Drives N client threads (each with its own channel) of random ballots at
+a ``BallotEncryptionService``, then reports:
+
+* achieved ballots/s (wall clock over all completed requests),
+* client-observed p50/p99 latency,
+* mean batch occupancy + queue depth + compile counters from the
+  service's own ``getMetrics`` rpc.
+
+RESOURCE_EXHAUSTED responses (explicit backpressure) are counted and
+retried with a short backoff — a saturated service sheds load without
+losing any ballot the generator is determined to deliver.
+
+Usage::
+
+    python tools/loadgen_encrypt.py -url localhost:17711 -in <record_dir> \
+        -clients 8 -nballots 64 [-group tiny]
+
+``run_loadgen`` is importable — the serving smoke test
+(tests/test_serve.py) runs a tiny-group pass of exactly this harness.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+
+import grpc
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]
+
+
+def run_loadgen(url: str, manifest, group, nclients: int = 4,
+                nballots: int = 32, seed: int = 0,
+                retry_backoff_s: float = 0.05,
+                max_retries: int = 200) -> dict:
+    """Fire ``nclients`` threads × ``nballots`` single-ballot rpcs at
+    ``url``; returns the report dict (also printed by main)."""
+    from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+    from electionguard_tpu.serve.service import EncryptionClient
+
+    lock = threading.Lock()
+    latencies: list[float] = []
+    errors: list[str] = []
+    rejected = 0
+    codes: dict[str, bytes] = {}
+
+    def one_client(idx: int):
+        nonlocal rejected
+        client = EncryptionClient(url, group)
+        ballots = list(RandomBallotProvider(
+            manifest, nballots, seed=seed + idx).ballots())
+        try:
+            for b in ballots:
+                # distinct ids across clients AND across loadgen waves
+                # (ballot ids are unique election-wide)
+                b = dataclasses.replace(
+                    b, ballot_id=f"c{idx}s{seed}-{b.ballot_id}")
+                for attempt in range(max_retries):
+                    t0 = time.monotonic()
+                    try:
+                        enc = client.encrypt(b)
+                    except grpc.RpcError as e:
+                        if (e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+                                and attempt < max_retries - 1):
+                            with lock:
+                                rejected += 1
+                            time.sleep(retry_backoff_s * (1 + attempt % 5))
+                            continue
+                        with lock:
+                            errors.append(f"{b.ballot_id}: {e.code()}")
+                        break
+                    except ValueError as e:  # in-band invalid ballot
+                        with lock:
+                            errors.append(f"{b.ballot_id}: {e}")
+                        break
+                    with lock:
+                        latencies.append(time.monotonic() - t0)
+                        codes[b.ballot_id] = enc.code
+                    break
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=one_client, args=(i,), daemon=True)
+               for i in range(nclients)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t_start
+
+    # service-side view: occupancy / queue depth / compiles
+    from electionguard_tpu.serve.service import EncryptionClient as _C
+    client = _C(url, group)
+    try:
+        m = client.metrics()
+        counters = dict(m.counters)
+        hists = {h.name: h for h in m.histograms}
+        occ = hists.get("batch_occupancy")
+        occupancy_mean = (occ.sum / occ.count) if occ and occ.count else 0.0
+    finally:
+        client.close()
+
+    lat_sorted = sorted(latencies)
+    report = {
+        "clients": nclients,
+        "requested": nclients * nballots,
+        "completed": len(latencies),
+        "errors": len(errors),
+        "rejected_retries": rejected,
+        "wall_s": round(wall, 3),
+        "ballots_per_s": round(len(latencies) / wall, 2) if wall else 0.0,
+        "latency_p50_ms": round(_percentile(lat_sorted, 0.50) * 1e3, 1),
+        "latency_p99_ms": round(_percentile(lat_sorted, 0.99) * 1e3, 1),
+        "batch_occupancy_mean": round(occupancy_mean, 3),
+        "service_counters": counters,
+        "error_samples": errors[:5],
+    }
+    report["_codes"] = codes  # for callers that diff against offline
+    return report
+
+
+def main(argv=None) -> int:
+    from electionguard_tpu.cli.common import (add_group_flag, resolve_group,
+                                              setup_logging)
+    from electionguard_tpu.publish.publisher import Consumer
+
+    log = setup_logging("LoadgenEncrypt")
+    ap = argparse.ArgumentParser("loadgen_encrypt")
+    ap.add_argument("-url", required=True, help="service host:port")
+    ap.add_argument("-in", dest="input", required=True,
+                    help="record dir with election_initialized.pb "
+                         "(manifest source)")
+    ap.add_argument("-clients", type=int, default=4)
+    ap.add_argument("-nballots", type=int, default=32,
+                    help="ballots per client")
+    ap.add_argument("-seed", type=int, default=0)
+    ap.add_argument("-json", dest="json_out", default=None,
+                    help="also write the report to this path")
+    add_group_flag(ap)
+    args = ap.parse_args(argv)
+
+    group = resolve_group(args)
+    init = Consumer(args.input, group).read_election_initialized()
+    report = run_loadgen(args.url, init.config.manifest, group,
+                         nclients=args.clients, nballots=args.nballots,
+                         seed=args.seed)
+    report.pop("_codes", None)
+    print(json.dumps(report, indent=2, sort_keys=True))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    log.info("%d/%d ballots at %.1f/s (p50 %.0fms p99 %.0fms, "
+             "occupancy %.2f)", report["completed"], report["requested"],
+             report["ballots_per_s"], report["latency_p50_ms"],
+             report["latency_p99_ms"], report["batch_occupancy_mean"])
+    return 0 if report["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
