@@ -61,7 +61,25 @@ def emit() -> None:
     if RESULT.get("error") is None:
         RESULT.pop("error", None)
     sys.stderr.flush()
+    flush_partial()
     print(json.dumps(RESULT), flush=True)
+
+
+def flush_partial() -> None:
+    """Write the CURRENT artifact to disk (atomic replace).  Called after
+    every phase, so a driver SIGKILL — which skips atexit AND signal
+    handlers — still leaves partial data on disk (VERDICT r6 item 1).
+    BENCH_PARTIAL= path override; empty string disables."""
+    path = os.environ.get("BENCH_PARTIAL", "BENCH_partial.json")
+    if not path:
+        return
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(RESULT, f)
+        os.replace(tmp, path)
+    except OSError as e:
+        note(f"partial-artifact flush failed: {e}")
 
 
 def note(msg: str) -> None:
@@ -188,6 +206,54 @@ def _microbench(group) -> None:
     note(f"microbench batch={B}: " + "  ".join(lines))
 
 
+def _prewarm_fingerprint(g, mesh) -> dict:
+    """What the prewarmed program set depends on: group, tile cap,
+    bignum backend, sharding.  Same fingerprint + a populated persistent
+    cache ⇒ every tile-shaped program is a cache hit and prewarm is a
+    skippable no-op (VERDICT r6 item 1: the warm-cache fast path)."""
+    from electionguard_tpu.core.group_jax import jax_ops
+
+    ops = jax_ops(g)
+    return {"group": g.spec.name, "tile": int(ops.tile),
+            "backend": ops.backend, "sharded": mesh is not None}
+
+
+def _prewarm_stamp_path() -> str:
+    from electionguard_tpu.utils import enable_compile_cache
+    return os.path.join(enable_compile_cache(), "egtpu_prewarm_stamp.json")
+
+
+def _cache_is_prewarmed(g, mesh) -> bool:
+    """True when a previous run prewarmed THIS program set into the
+    persistent compile cache: the stamp fingerprint matches and the
+    cache still holds at least as many entries as when it was stamped."""
+    if os.environ.get("BENCH_FORCE_PREWARM"):
+        return False
+    try:
+        with open(_prewarm_stamp_path()) as f:
+            stamp = json.load(f)
+        if stamp.get("fingerprint") != _prewarm_fingerprint(g, mesh):
+            return False
+        from electionguard_tpu.utils import enable_compile_cache
+        entries = len([e for e in os.listdir(enable_compile_cache())
+                       if not e.startswith("egtpu_")])
+        return entries >= int(stamp.get("cache_entries", 1 << 62))
+    except (OSError, ValueError):
+        return False
+
+
+def _stamp_prewarm(g, mesh) -> None:
+    try:
+        from electionguard_tpu.utils import enable_compile_cache
+        entries = len([e for e in os.listdir(enable_compile_cache())
+                       if not e.startswith("egtpu_")])
+        with open(_prewarm_stamp_path(), "w") as f:
+            json.dump({"fingerprint": _prewarm_fingerprint(g, mesh),
+                       "cache_entries": entries}, f)
+    except OSError as e:
+        note(f"prewarm stamp failed: {e}")
+
+
 def _prewarm_tiles(g, init, mesh=None) -> None:
     """Compile every cap-shaped program the measured pass will hit, one
     cheap retried dummy dispatch per op.  dispatch_bucket collapses all
@@ -281,12 +347,14 @@ def run_workload(nballots: int, n_chips: int) -> None:
         # and full passes, and one encryptor rejects repeated ids (its
         # nonce PRF is keyed by ballot identity)
         def done(phase, **extra):
-            # per-phase partials land in RESULT as they complete, so a
-            # later-phase crash still leaves a diagnosable artifact
+            # per-phase partials land in RESULT as they complete AND are
+            # flushed to disk, so even a SIGKILL mid-later-phase leaves a
+            # diagnosable on-disk artifact (VERDICT r6 item 1)
             if tag == "full":
                 RESULT["phases_done"] = \
                     RESULT.get("phases_done", "") + f" {phase}"
                 RESULT.update(extra)
+            flush_partial()
 
         enc = BatchEncryptor(init, g, mesh=mesh)
         t0 = time.time()
@@ -328,12 +396,22 @@ def run_workload(nballots: int, n_chips: int) -> None:
     if sel_rows > jax_ops(g).tile // 8:
         # the full pass will dispatch at the tile-cap shape — compile it
         # now, under retry (pointless for the small CPU fallback, whose
-        # batches stay in the small power-of-two buckets)
-        note(f"warm-up done in {time.time() - t_setup:.1f}s; prewarming "
-             f"tile-shaped programs ...")
-        _prewarm_tiles(g, init, mesh)
+        # batches stay in the small power-of-two buckets) ... unless a
+        # previous run already prewarmed this exact program set into the
+        # persistent cache: then every dispatch is a cache hit and the
+        # measured pass can start immediately (warm-cache fast path)
+        if _cache_is_prewarmed(g, mesh):
+            note("persistent cache holds the stamped prewarm set; "
+                 "skipping tile prewarm")
+            RESULT["prewarm_skipped_warm_cache"] = True
+        else:
+            note(f"warm-up done in {time.time() - t_setup:.1f}s; "
+                 f"prewarming tile-shaped programs ...")
+            _prewarm_tiles(g, init, mesh)
+            _stamp_prewarm(g, mesh)
     t_setup = time.time() - t_setup
     RESULT["setup_s"] = round(t_setup, 1)
+    flush_partial()
     note(f"setup done in {t_setup:.1f}s; full pass ({nballots} ballots)")
 
     ballots = list(RandomBallotProvider(manifest, nballots, seed=1).ballots())
@@ -416,6 +494,7 @@ def main() -> int:
     nballots = int(os.environ.get(
         "BENCH_NBALLOTS", "2048" if platform == "tpu" else "32"))
     RESULT["nballots"] = nballots
+    flush_partial()
 
     from electionguard_tpu.utils import enable_compile_cache
     cache_dir = enable_compile_cache()

@@ -17,6 +17,7 @@ election record, log the final metrics, exit 0.
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 import threading
@@ -50,6 +51,10 @@ def main(argv=None) -> int:
                     action="store_true",
                     help="derive nonces deterministically from a fixed "
                          "seed (tests only)")
+    ap.add_argument("-timestamp", type=int, default=None,
+                    help="pin the ballot timestamp (tests/differential "
+                         "runs; default: stamp each batch with "
+                         "encryption time)")
     ap.add_argument("-noPrewarm", dest="no_prewarm", action="store_true",
                     help="skip the per-bucket compile prewarm at startup")
     add_group_flag(ap)
@@ -60,13 +65,22 @@ def main(argv=None) -> int:
 
     from electionguard_tpu.serve.service import EncryptionService
     seed = group.int_to_q(42) if args.fixed_nonces else None
+    # chaos hook for the SIGKILL recovery test: wedge the device-owner
+    # worker after N encrypted ballots so admitted-but-unpublished
+    # ballots pile up deterministically in the (journaled) queue
+    hold_after = None
+    if os.environ.get("EGTPU_CHAOS_HOLD_AFTER_BALLOTS"):
+        hold_after = int(os.environ["EGTPU_CHAOS_HOLD_AFTER_BALLOTS"])
+        log.warning("CHAOS: worker will wedge after %d ballots",
+                    hold_after)
     sw = Stopwatch()
     with maybe_profile("serve"):
         service = EncryptionService(
             init, group, port=args.port, out_dir=args.output,
             max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
             max_queue=args.max_queue, seed=seed,
-            prewarm=not args.no_prewarm)
+            timestamp=args.timestamp,
+            prewarm=not args.no_prewarm, hold_after=hold_after)
         log.info("serving on port %d (startup took %.2fs)", service.port,
                  sw.elapsed())
 

@@ -30,6 +30,13 @@ def main(argv=None) -> int:
     ap.add_argument("-serverHost", dest="server_host", default="localhost")
     ap.add_argument("-out", dest="output", default=None,
                     help="default dir for saveState")
+    ap.add_argument("-resumeFile", dest="resume_file", default=None,
+                    help="mid-ceremony checkpoint file: written after "
+                         "every mutating rpc; a relaunch pointed at an "
+                         "existing file resumes the ceremony in place "
+                         "(same port, same registration). Holds the "
+                         "secret polynomial — protect like the trustee "
+                         "state file")
     add_group_flag(ap)
     args = ap.parse_args(argv)
 
@@ -37,7 +44,8 @@ def main(argv=None) -> int:
     server = KeyCeremonyTrusteeServer(
         group, args.name,
         f"{args.server_host}:{args.server_port}",
-        out_dir=args.output, port=args.port)
+        out_dir=args.output, port=args.port,
+        resume_file=args.resume_file)
     log.info("trustee %s serving on %s (x=%d, quorum=%d)", args.name,
              server.url, server.x_coordinate, server.quorum)
     ok = server.wait_until_finished()

@@ -61,6 +61,18 @@ class DecryptionError(Exception):
     pass
 
 
+class TrusteeFailure(Exception):
+    """An available trustee failed mid-decryption (rpc exhausted its
+    retries, in-band error, malformed batch).  Internal signal: the
+    degradation loop catches it and demotes the trustee to the missing
+    set when quorum still holds."""
+
+    def __init__(self, trustee_id: str, reason: str):
+        super().__init__(f"{trustee_id}: {reason}")
+        self.trustee_id = trustee_id
+        self.reason = reason
+
+
 class Decryption:
     def __init__(self, group: GroupContext, election_init: ElectionInitialized,
                  trustees: Sequence[DecryptingTrusteeIF],
@@ -103,7 +115,48 @@ class Decryption:
                 for t in self.trustees]
 
     # ------------------------------------------------------------------
+    def _demote(self, trustee_id: str, reason: str) -> None:
+        """Move a failed available trustee to the missing set and
+        recompute the Lagrange basis — the cryptographic fault tolerance
+        of SURVEY.md §5.3 applied DYNAMICALLY: the threshold scheme never
+        needed the failed trustee's cooperation, only quorum-many
+        survivors holding its backup shares."""
+        quorum = self.init.config.quorum
+        remaining = [t for t in self.trustees if t.id != trustee_id]
+        if len(remaining) < quorum:
+            raise DecryptionError(
+                f"trustee {trustee_id} failed mid-decryption ({reason}) "
+                f"and the remaining {len(remaining)} guardians no longer "
+                f"meet quorum {quorum}")
+        import logging
+        logging.getLogger("egtpu.decrypt").warning(
+            "demoting trustee %s to missing (%s); recomputing with %d "
+            "available + %d missing", trustee_id, reason, len(remaining),
+            len(self.missing) + 1)
+        self.trustees = remaining
+        self.missing.append(trustee_id)
+        xs = [t.x_coordinate for t in remaining]
+        self.lagrange = {
+            t.id: lagrange_coefficient(self.group, xs, t.x_coordinate)
+            for t in remaining}
+
     def _decrypt_batch(
+            self, texts: list[ElGamalCiphertext]
+    ) -> list[tuple[int, ElementModP, tuple[PartialDecryption, ...]]]:
+        """``_decrypt_batch_once`` with graceful degradation: a trustee
+        that fails mid-batch (dead peer after bounded retries, in-band
+        error, malformed response) is demoted to the missing set and the
+        batch recomputed with compensated shares — as long as the
+        survivors still meet quorum.  Shares already gathered from the
+        failed attempt are discarded; the recompute is a fresh protocol
+        round, so the published shares are always one consistent set."""
+        while True:
+            try:
+                return self._decrypt_batch_once(texts)
+            except TrusteeFailure as e:
+                self._demote(e.trustee_id, e.reason)
+
+    def _decrypt_batch_once(
             self, texts: list[ElGamalCiphertext]
     ) -> list[tuple[int, ElementModP, tuple[PartialDecryption, ...]]]:
         """Decrypt a batch of ciphertexts; returns (t, g^t, shares) each.
@@ -130,9 +183,9 @@ class Decryption:
         for t in self.trustees:
             res = t.direct_decrypt(texts, qbar)
             if isinstance(res, Result):
-                raise DecryptionError(f"{t.id} directDecrypt: {res.error}")
+                raise TrusteeFailure(t.id, f"directDecrypt: {res.error}")
             if len(res) != n:
-                raise DecryptionError(f"{t.id} returned wrong batch size")
+                raise TrusteeFailure(t.id, "returned wrong batch size")
             k0 = self.init.guardian(t.id).coefficient_commitments[0].value
             for pad, d in zip(pads, res):
                 cp_x.append(k0)
@@ -151,18 +204,18 @@ class Decryption:
             for t in self.trustees:
                 res = t.compensated_decrypt(m, texts, qbar)
                 if isinstance(res, Result):
-                    raise DecryptionError(
-                        f"{t.id} compensatedDecrypt({m}): {res.error}")
+                    raise TrusteeFailure(
+                        t.id, f"compensatedDecrypt({m}): {res.error}")
                 if len(res) != n:
-                    raise DecryptionError(
-                        f"{t.id} returned wrong batch size for {m}")
+                    raise TrusteeFailure(
+                        t.id, f"returned wrong batch size for {m}")
                 expected_recovery = commitment_product(
                     g, m_rec.coefficient_commitments, t.x_coordinate)
                 for pad, c in zip(pads, res):
                     if c.recovered_public_key_share != expected_recovery:
-                        raise DecryptionError(
-                            f"recovery key of {t.id} for {m} mismatches "
-                            f"public commitments")
+                        raise TrusteeFailure(
+                            t.id, f"recovery key for {m} mismatches "
+                                  f"public commitments")
                     cp_x.append(c.recovered_public_key_share.value)
                     cp_g2.append(pad)
                     cp_y.append(c.partial_decryption.value)

@@ -120,6 +120,16 @@ def key_ceremony_exchange(
                     f"{sender.id} sendSecretKeyShare({receiver.id}): "
                     f"{share.error}")
             res = receiver.receive_secret_key_share(share)
+            if not res.ok and res.transport:
+                # transport death, not a rejection: the receiver never
+                # answered (its bounded retries are exhausted).  Abort —
+                # revealing a coordinate under challenge because the
+                # network died would leak secret-sharing state on every
+                # crash; only an explicit in-band rejection may trigger
+                # the reveal below.
+                return Result.Err(
+                    f"{receiver.id} unreachable receiving "
+                    f"{sender.id}'s share: {res.error}")
             if not res.ok:
                 # challenge path: sender must reveal the coordinate; everyone
                 # can check it against the public commitments.

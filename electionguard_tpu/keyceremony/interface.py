@@ -24,10 +24,19 @@ from electionguard_tpu.crypto.schnorr import SchnorrProof
 
 @dataclass(frozen=True)
 class Result:
-    """Ok/Err result carried in-band (common_rpc.proto ErrorResponse)."""
+    """Ok/Err result carried in-band (common_rpc.proto ErrorResponse).
+
+    ``transport`` distinguishes a TRANSPORT-LEVEL failure (rpc died after
+    its bounded retries — the peer's answer is unknown) from an in-band
+    rejection (the peer answered "no").  Failure handling differs: a
+    share-verification rejection legitimately triggers the public
+    challenge path, a dead peer must not — revealing a polynomial
+    coordinate because the network hiccuped would leak secret-sharing
+    state on every crash."""
 
     ok: bool
     error: str = ""
+    transport: bool = False
 
     @staticmethod
     def Ok() -> "Result":
@@ -36,6 +45,10 @@ class Result:
     @staticmethod
     def Err(msg: str) -> "Result":
         return Result(False, msg)
+
+    @staticmethod
+    def TransportErr(msg: str) -> "Result":
+        return Result(False, msg, transport=True)
 
 
 @dataclass(frozen=True)

@@ -224,3 +224,61 @@ class KeyCeremonyTrustee(KeyCeremonyTrusteeIF):
             return Result.Ok()
         except OSError as e:
             return Result.Err(f"save_state failed: {e}")
+
+    # ------------------------------------------------------------------
+    # mid-ceremony checkpoint (crash/restart resume — same sensitivity as
+    # the decrypting-trustee file: it holds the secret polynomial)
+    # ------------------------------------------------------------------
+    def ceremony_state(self) -> dict:
+        """The FULL mid-ceremony state: secret coefficients, own proofs,
+        every received public-key set, received shares, reveal audit.
+        ``from_ceremony_state`` restores a trustee that continues the
+        ceremony exactly where this one stopped."""
+        return {
+            "guardian_id": self._id,
+            "x_coordinate": self._x,
+            "quorum": self.quorum,
+            "coefficients": [a.value for a in self._coefficients],
+            "proofs": [[p.challenge.value, p.response.value]
+                       for p in self._proofs],
+            "other_public_keys": {
+                gid: {"x_coordinate": pk.x_coordinate,
+                      "commitments": [k.value
+                                      for k in pk.coefficient_commitments],
+                      "proofs": [[p.challenge.value, p.response.value]
+                                 for p in pk.coefficient_proofs]}
+                for gid, pk in self.other_public_keys.items()},
+            "received_shares": {
+                gid: q.value for gid, q in self.received_shares.items()},
+            "revealed_to": sorted(self._revealed_to),
+        }
+
+    @staticmethod
+    def from_ceremony_state(group: GroupContext,
+                            state: dict) -> "KeyCeremonyTrustee":
+        from electionguard_tpu.crypto.schnorr import SchnorrProof
+
+        def proofs_for(commitments, rows):
+            return tuple(
+                SchnorrProof(k, group.int_to_q(c), group.int_to_q(v))
+                for k, (c, v) in zip(commitments, rows))
+
+        t = KeyCeremonyTrustee(
+            group, state["guardian_id"], state["x_coordinate"],
+            state["quorum"],
+            coefficients=[group.int_to_q(v)
+                          for v in state["coefficients"]])
+        # restore the ORIGINAL proofs: a resumed trustee re-answers a
+        # retried sendPublicKeys with the bytes the first answer carried
+        t._proofs = proofs_for(t._commitments, state["proofs"])
+        for gid, pk in state["other_public_keys"].items():
+            commitments = tuple(ElementModP(v, group)
+                                for v in pk["commitments"])
+            t.other_public_keys[gid] = PublicKeys(
+                gid, pk["x_coordinate"], commitments,
+                proofs_for(commitments, pk["proofs"]))
+        t.received_shares = {
+            gid: group.int_to_q(v)
+            for gid, v in state["received_shares"].items()}
+        t._revealed_to = set(state["revealed_to"])
+        return t

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import os
 import struct
-from typing import Iterator
+from typing import Iterator, Optional
 
 from electionguard_tpu.ballot.ciphertext import EncryptedBallot
 from electionguard_tpu.ballot.plaintext import PlaintextBallot
@@ -140,10 +140,14 @@ class Publisher:
                 stream.write(b)
             return stream.n
 
-    def open_encrypted_ballots(self) -> "EncryptedBallotStream":
+    def open_encrypted_ballots(self,
+                               append: bool = False
+                               ) -> "EncryptedBallotStream":
         """Incremental framed writer: callers encrypting chunk-by-chunk
-        write each chunk and drop it, keeping host memory O(chunk)."""
-        return EncryptedBallotStream(self._path(_BALLOTS))
+        write each chunk and drop it, keeping host memory O(chunk).
+        ``append=True`` continues an existing stream (crash recovery:
+        repair the tail with ``repair_frame_stream`` first)."""
+        return EncryptedBallotStream(self._path(_BALLOTS), append=append)
 
     def write_tally_result(self, tally: TallyResult):
         with open(self._path(_TALLY), "wb") as f:
@@ -170,17 +174,54 @@ class Publisher:
             f.write(ballot.to_json())
 
 
+def repair_frame_stream(path: str) -> tuple[int, Optional[bytes]]:
+    """Truncate a framed stream to its last COMPLETE frame (a SIGKILL can
+    tear the final write) and return ``(n_frames, last_frame_bytes)``.
+    The one frame decode the caller needs for chain continuity (the last
+    ballot's confirmation code) comes back without re-reading the file."""
+    if not os.path.exists(path):
+        return 0, None
+    n = 0
+    last: Optional[bytes] = None
+    good_end = 0
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(4)
+            if len(hdr) < 4:
+                break
+            (size,) = struct.unpack(">I", hdr)
+            data = f.read(size)
+            if len(data) != size:
+                break
+            n += 1
+            last = data
+            good_end += 4 + size
+    actual = os.path.getsize(path)
+    if actual != good_end:
+        with open(path, "r+b") as f:
+            f.truncate(good_end)
+    return n, last
+
+
 class EncryptedBallotStream:
     """Appending framed EncryptedBallot writer (see Publisher.open_encrypted_ballots)."""
 
-    def __init__(self, path: str):
-        self._f = open(path, "wb")
+    def __init__(self, path: str, append: bool = False):
+        self._f = open(path, "ab" if append else "wb")
         self.n = 0
 
     def write(self, ballot: EncryptedBallot):
         _write_frame(self._f, serialize.publish_encrypted_ballot(
             ballot).SerializeToString())
         self.n += 1
+
+    def flush(self) -> None:
+        """Make every written frame durable (flush + fsync).  The serving
+        plane calls this once per drained batch: "published" is then a
+        well-defined on-disk state the crash-recovery replay can diff the
+        admission journal against."""
+        self._f.flush()
+        os.fsync(self._f.fileno())
 
     def close(self):
         self._f.close()

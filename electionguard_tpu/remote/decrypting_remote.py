@@ -77,7 +77,8 @@ class RemoteDecryptingTrusteeProxy(DecryptingTrusteeIF):
         try:
             resp = self._stub.call("directDecrypt", req, timeout=600.0)
         except grpc.RpcError as e:
-            return Result.Err(f"directDecrypt rpc to {self._id}: {e.code()}")
+            return Result.TransportErr(
+                f"directDecrypt rpc to {self._id}: {e.code()}")
         if resp.error:
             return Result.Err(resp.error)
         return [DirectDecryptionAndProof(
@@ -96,7 +97,7 @@ class RemoteDecryptingTrusteeProxy(DecryptingTrusteeIF):
         try:
             resp = self._stub.call("compensatedDecrypt", req, timeout=600.0)
         except grpc.RpcError as e:
-            return Result.Err(
+            return Result.TransportErr(
                 f"compensatedDecrypt rpc to {self._id}: {e.code()}")
         if resp.error:
             return Result.Err(resp.error)
@@ -112,7 +113,7 @@ class RemoteDecryptingTrusteeProxy(DecryptingTrusteeIF):
                                    pb.msg("FinishRequest")(all_ok=all_ok))
             return Result(resp.ok, resp.error)
         except grpc.RpcError as e:
-            return Result.Err(f"finish rpc to {self._id}: {e.code()}")
+            return Result.TransportErr(f"finish rpc to {self._id}: {e.code()}")
 
     def shutdown(self):
         self._channel.close()

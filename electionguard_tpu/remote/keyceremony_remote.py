@@ -19,6 +19,7 @@ Mirrors the reference's four key-ceremony classes (SURVEY.md §2 rows 1-4):
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
@@ -71,7 +72,10 @@ class RemoteTrusteeProxy(KeyCeremonyTrusteeIF):
         try:
             return self._stub.call(method, request)
         except grpc.RpcError as e:
-            return Result.Err(f"rpc {method} to {self._id}: {e.code()}")
+            # transport-level: the rpc died after its bounded retries —
+            # the peer's answer is unknown (vs. an in-band rejection)
+            return Result.TransportErr(
+                f"rpc {method} to {self._id}: {e.code()}")
 
     def send_public_keys(self) -> Union[PublicKeys, Result]:
         resp = self._call("sendPublicKeys", pb.msg("PublicKeySetRequest")())
@@ -291,11 +295,24 @@ class RemoteKeyCeremonyProxy:
 
 
 class KeyCeremonyTrusteeServer:
-    """One guardian process: registers, then serves the trustee rpcs."""
+    """One guardian process: registers, then serves the trustee rpcs.
+
+    ``resume_file`` enables mid-ceremony crash recovery: every mutating
+    rpc checkpoints the trustee's full ceremony state (secret polynomial,
+    received keys/shares) plus this server's identity (port, registration
+    nonce) to the file BEFORE the response is sent.  A relaunched process
+    pointed at the same file re-listens on the SAME port, re-registers
+    with the SAME nonce (the coordinator's idempotent replay path hands
+    back the original x-coordinate), restores the trustee, and the
+    coordinator's bounded-retry rpcs (rpc_util.Stub.call) pick up where
+    the dead process stopped.  The file holds the secret polynomial —
+    same sensitivity as the saved decrypting-trustee state.
+    """
 
     def __init__(self, group: GroupContext, guardian_id: str,
                  coordinator_url: str, out_dir: Optional[str] = None,
-                 port: int = 0, host: str = "localhost"):
+                 port: int = 0, host: str = "localhost",
+                 resume_file: Optional[str] = None):
         self.group = group
         self.guardian_id = guardian_id
         self.out_dir = out_dir
@@ -303,6 +320,17 @@ class KeyCeremonyTrusteeServer:
         self._all_ok: Optional[bool] = None
         self._done = threading.Event()
         self._ready = threading.Event()
+        self._resume_file = resume_file
+
+        resume = None
+        if resume_file and os.path.exists(resume_file):
+            with open(resume_file) as f:
+                resume = json.load(f)
+            if resume["guardian_id"] != guardian_id:
+                raise RuntimeError(
+                    f"resume file is for {resume['guardian_id']}, "
+                    f"not {guardian_id}")
+            port = int(resume["port"])  # the url the coordinator dials
 
         self.server, self.port = rpc_util.make_server(port)
         self.url = f"{host}:{self.port}"
@@ -319,9 +347,12 @@ class KeyCeremonyTrusteeServer:
         self.server.start()
 
         # register with the coordinator; it assigns our x-coordinate.
-        # The nonce identifies THIS process: a transport-level retry of a
-        # lost response replays idempotently, a relaunch does not.
-        self._reg_nonce = os.urandom(16)
+        # The nonce identifies THIS ceremony participation: a transport-
+        # level retry of a lost response replays idempotently, and a
+        # resumed process re-registers with its checkpointed nonce to
+        # reclaim its registration; a relaunch WITHOUT state does not.
+        self._reg_nonce = (bytes.fromhex(resume["nonce"]) if resume
+                           else os.urandom(16))
         reg = RemoteKeyCeremonyProxy(coordinator_url)
         try:
             resp = reg.register_trustee(guardian_id, self.url, group,
@@ -335,11 +366,41 @@ class KeyCeremonyTrusteeServer:
             raise RuntimeError(f"registration failed: {err}")
         self.x_coordinate = int(resp.x_coordinate)
         self.quorum = int(resp.quorum)
-        self.trustee = KeyCeremonyTrustee(group, guardian_id,
-                                          self.x_coordinate, self.quorum)
+        if resume is not None:
+            self.trustee = KeyCeremonyTrustee.from_ceremony_state(
+                group, resume["trustee"])
+            if self.trustee.x_coordinate != self.x_coordinate:
+                self.server.stop(grace=0)
+                raise RuntimeError(
+                    f"resumed x={self.trustee.x_coordinate} but "
+                    f"coordinator assigned x={self.x_coordinate}")
+            log.info("trustee %s RESUMED mid-ceremony: %d key sets, %d "
+                     "shares restored", guardian_id,
+                     len(self.trustee.other_public_keys),
+                     len(self.trustee.received_shares))
+        else:
+            self.trustee = KeyCeremonyTrustee(
+                group, guardian_id, self.x_coordinate, self.quorum)
+        self._checkpoint()
         self._ready.set()
         log.info("trustee %s registered: x=%d quorum=%d url=%s",
                  guardian_id, self.x_coordinate, self.quorum, self.url)
+
+    def _checkpoint(self) -> None:
+        """Durably persist the resume state (atomic replace + fsync) —
+        called BEFORE a mutating rpc's response goes out, so an ack'd
+        mutation is always recoverable (WAL discipline)."""
+        if not self._resume_file or self.trustee is None:
+            return
+        tmp = self._resume_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"guardian_id": self.guardian_id,
+                       "port": self.port,
+                       "nonce": self._reg_nonce.hex(),
+                       "trustee": self.trustee.ceremony_state()}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._resume_file)
 
     def _delegate(self) -> Optional[KeyCeremonyTrustee]:
         """The server must listen BEFORE registering (the coordinator
@@ -384,6 +445,8 @@ class KeyCeremonyTrusteeServer:
         if trustee is None:
             return Resp(ok=False, error="trustee not ready")
         res = trustee.receive_public_keys(keys)
+        if res.ok:
+            self._checkpoint()
         return Resp(ok=res.ok, error=res.error)
 
     def _send_secret_key_share(self, request, context):
@@ -416,6 +479,8 @@ class KeyCeremonyTrusteeServer:
         if trustee is None:
             return Resp(ok=False, error="trustee not ready")
         res = trustee.receive_secret_key_share(share)
+        if res.ok:
+            self._checkpoint()
         return Resp(ok=res.ok, error=res.error)
 
     def _challenge_share(self, request, context):
@@ -426,6 +491,7 @@ class KeyCeremonyTrusteeServer:
         resp = trustee.challenge_share(request.challenger_guardian_id)
         if isinstance(resp, Result):
             return pb.msg("PartialKeyChallengeResponse")(error=resp.error)
+        self._checkpoint()   # the reveal audit trail is durable state
         return pb.msg("PartialKeyChallengeResponse")(
             generating_guardian_id=resp.generating_guardian_id,
             designated_guardian_id=resp.designated_guardian_id,
@@ -444,6 +510,8 @@ class KeyCeremonyTrusteeServer:
         if trustee is None:
             return Resp(ok=False, error="trustee not ready")
         res = trustee.receive_challenged_share(resp)
+        if res.ok:
+            self._checkpoint()
         return Resp(ok=res.ok, error=res.error)
 
     def _save_state(self, request, context):

@@ -11,34 +11,121 @@ RemoteKeyCeremonyProxy.java:27).
 
 from __future__ import annotations
 
+import logging
 import os
+import random
 import socket
 import time
-from typing import Callable
+from dataclasses import dataclass
+from typing import Callable, Optional
 
 import grpc
 from google.protobuf import message_factory
 
 from electionguard_tpu.publish import pb
+from electionguard_tpu.testing import faults
 
 MAX_TRUSTEE_MESSAGE = 51 * 1000 * 1000   # key exchange / batch decrypt plane
 MAX_REGISTRATION_MESSAGE = 2000          # registration plane
 
-#: attempts per rpc on transient transport failure (UNAVAILABLE) — the
-#: reference retries nothing (SURVEY.md §5.3); we retry the one status
-#: that means "peer not reachable right now" so a guardian restart or a
-#: slow dial-back doesn't kill a whole ceremony.  EGTPU_RPC_RETRIES=1
-#: restores the reference's posture.
-try:
-    RPC_ATTEMPTS = max(1, int(os.environ.get("EGTPU_RPC_RETRIES", "3")))
-except ValueError:
-    import logging
-    logging.getLogger("rpc_util").warning(
-        "EGTPU_RPC_RETRIES=%r is not an integer; using 3",
-        os.environ.get("EGTPU_RPC_RETRIES"))
-    RPC_ATTEMPTS = 3
-_RPC_RETRY_WAIT = 0.5
-_RPC_CONNECT_WINDOW = 5.0   # max seconds a wait_for_ready retry may block
+# test seams: the chaos/retry tests record sleeps and pin the jitter
+_sleep = time.sleep
+_uniform = random.uniform
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        logging.getLogger("rpc_util").warning(
+            "%s=%r is not a number; using %s", name, os.environ.get(name),
+            default)
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, default)))
+    except ValueError:
+        logging.getLogger("rpc_util").warning(
+            "%s=%r is not an integer; using %s", name, os.environ.get(name),
+            default)
+        return default
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Transient-failure retry posture, env-tunable per process.
+
+    The reference retries nothing (SURVEY.md §5.3); we retry the one
+    status that means "peer not reachable right now" so a guardian
+    restart or a slow dial-back doesn't kill a whole ceremony.
+    ``EGTPU_RPC_RETRIES=1`` restores the reference's posture.
+
+    Backoff is FULL-JITTER exponential: wait ~ U(0, min(cap, base·2^k)).
+    A fixed or linear wait synchronizes retry herds — N trustees that
+    lose the coordinator at the same instant would all redial at the
+    same instant, forever; full jitter decorrelates them.
+
+    ``budget`` bounds the TOTAL seconds one Stub may spend sleeping
+    between retries across all its calls, so a flapping peer degrades to
+    fail-fast instead of consuming every caller's deadline.
+    """
+
+    attempts: int = 3        # EGTPU_RPC_RETRIES: tries per rpc
+    base_wait: float = 0.5   # EGTPU_RPC_RETRY_WAIT: backoff base (s)
+    max_wait: float = 8.0    # EGTPU_RPC_RETRY_CAP: backoff ceiling (s)
+    connect_window: float = 5.0   # EGTPU_RPC_CONNECT_WINDOW: max seconds
+    #                               a wait_for_ready retry may block
+    budget: float = 120.0    # EGTPU_RPC_RETRY_BUDGET: total backoff-sleep
+    #                          seconds per Stub before fail-fast
+
+    def backoff(self, attempt: int) -> float:
+        """Full-jitter wait before retry ``attempt`` (1-based)."""
+        return _uniform(0.0, min(self.max_wait,
+                                 self.base_wait * (2 ** (attempt - 1))))
+
+
+def retry_policy() -> RetryPolicy:
+    """The env-configured policy (read per call: tests monkeypatch env)."""
+    return RetryPolicy(
+        attempts=_env_int("EGTPU_RPC_RETRIES", 3),
+        base_wait=_env_float("EGTPU_RPC_RETRY_WAIT", 0.5),
+        max_wait=_env_float("EGTPU_RPC_RETRY_CAP", 8.0),
+        connect_window=_env_float("EGTPU_RPC_CONNECT_WINDOW", 5.0),
+        budget=_env_float("EGTPU_RPC_RETRY_BUDGET", 120.0))
+
+
+#: per-method deadline classes (defaults when Stub.call gets no timeout):
+#: registration/control rpcs are tiny and answered from memory; exchange
+#: legs run seconds of crypto on the production group; the data plane
+#: moves 51 MB batches through device dispatches.
+_DEADLINE_CLASS_OF = {
+    "registerTrustee": "registration",
+    "finish": "control",
+    "saveState": "control",
+    "getMetrics": "control",
+    "health": "control",
+    "sendPublicKeys": "exchange",
+    "receivePublicKeys": "exchange",
+    "sendSecretKeyShare": "exchange",
+    "receiveSecretKeyShare": "exchange",
+    "challengeShare": "exchange",
+    "receiveChallengedShare": "exchange",
+    "directDecrypt": "data",
+    "compensatedDecrypt": "data",
+    "encryptBallot": "data",
+    "encryptBallotBatch": "data",
+}
+
+
+def deadline_for(method: str) -> float:
+    """Default TOTAL deadline (s) for ``method`` by its class, env-tunable
+    via EGTPU_RPC_TIMEOUT_{REGISTRATION,CONTROL,EXCHANGE,DATA}."""
+    cls = _DEADLINE_CLASS_OF.get(method, "exchange")
+    defaults = {"registration": 30.0, "control": 30.0,
+                "exchange": 120.0, "data": 600.0}
+    return _env_float(f"EGTPU_RPC_TIMEOUT_{cls.upper()}", defaults[cls])
 
 
 def _method_classes(method_desc):
@@ -58,10 +145,20 @@ def generic_service(service_name: str,
             raise ValueError(f"missing impl for {service_name}.{m.name}")
         req_cls, _ = _method_classes(m)
         handlers[m.name] = grpc.unary_unary_rpc_method_handler(
-            impls[m.name],
+            faults.wrap_server_impl(m.name, impls[m.name]),
             request_deserializer=req_cls.FromString,
             response_serializer=lambda msg: msg.SerializeToString())
     return grpc.method_handlers_generic_handler(svc.full_name, handlers)
+
+
+def _is_transient(code, wfr: bool, per_try: float,
+                  remaining: float) -> bool:
+    """Is this failure worth a retry?  UNAVAILABLE always (peer not
+    reachable right now); DEADLINE_EXCEEDED only when it expired a
+    BOUNDED connect-window wait rather than the caller's own budget."""
+    return (code == grpc.StatusCode.UNAVAILABLE
+            or (wfr and per_try < remaining
+                and code == grpc.StatusCode.DEADLINE_EXCEEDED))
 
 
 class Stub:
@@ -70,6 +167,7 @@ class Stub:
     def __init__(self, channel: grpc.Channel, service_name: str):
         svc = pb.service_descriptor(service_name)
         self._methods = {}
+        self._retry_spent = 0.0   # cumulative backoff sleep (retry budget)
         for m in svc.methods:
             req_cls, resp_cls = _method_classes(m)
             self._methods[m.name] = channel.unary_unary(
@@ -77,26 +175,32 @@ class Stub:
                 request_serializer=lambda msg: msg.SerializeToString(),
                 response_deserializer=resp_cls.FromString)
 
-    def call(self, method: str, request, timeout: float = 60.0):
-        """One rpc with a TOTAL deadline of ``timeout`` seconds.
+    def call(self, method: str, request, timeout: Optional[float] = None,
+             policy: Optional[RetryPolicy] = None):
+        """One rpc with a TOTAL deadline of ``timeout`` seconds (None =
+        the method's deadline class, see ``deadline_for``).
 
-        UNAVAILABLE (transport-level) is retried with backoff while
-        budget remains, up to RPC_ATTEMPTS.  Retries pass
-        ``wait_for_ready`` so the channel actually re-dials a peer that
-        is coming (back) up instead of failing fast inside gRPC's own
-        reconnect backoff window — but each such wait is BOUNDED
-        (``_RPC_CONNECT_WINDOW``) so a permanently-dead peer fails in
-        seconds, not the whole deadline.  Safe because every service
-        method is idempotent: the batch/exchange rpcs are pure functions
-        of the request (plus fresh randomness), and both coordinators
-        treat a same-identity re-registration as idempotent.
+        UNAVAILABLE (transport-level) is retried with FULL-JITTER
+        exponential backoff while deadline, attempts, and the Stub's
+        retry budget all hold.  Retries pass ``wait_for_ready`` so the
+        channel actually re-dials a peer that is coming (back) up
+        instead of failing fast inside gRPC's own reconnect backoff
+        window — but each such wait is BOUNDED (``connect_window``) so a
+        permanently-dead peer fails in seconds, not the whole deadline.
+        Safe because every service method is idempotent: the
+        batch/exchange rpcs are pure functions of the request (plus
+        fresh randomness), and both coordinators treat a same-identity
+        re-registration as idempotent.
         """
+        pol = policy if policy is not None else retry_policy()
+        if timeout is None:
+            timeout = deadline_for(method)
         deadline = time.monotonic() + timeout
         attempt = 0
         while True:
             remaining = deadline - time.monotonic()
             wfr = attempt > 0
-            per_try = max(0.001, min(remaining, _RPC_CONNECT_WINDOW)
+            per_try = max(0.001, min(remaining, pol.connect_window)
                           if wfr else remaining)
             try:
                 return self._methods[method](
@@ -107,15 +211,16 @@ class Stub:
                 # a DEADLINE on a BOUNDED connect-wait means "still not
                 # reachable" — transient like UNAVAILABLE; a deadline on
                 # a full-budget attempt is a real timeout
-                transient = (code == grpc.StatusCode.UNAVAILABLE
-                             or (wfr and per_try < remaining
-                                 and code ==
-                                 grpc.StatusCode.DEADLINE_EXCEEDED))
-                wait = _RPC_RETRY_WAIT * attempt
-                if (not transient or attempt >= RPC_ATTEMPTS
-                        or deadline - time.monotonic() <= wait):
+                transient = _is_transient(code, wfr=wfr, per_try=per_try,
+                                          remaining=remaining)
+                if not transient or attempt >= pol.attempts:
                     raise
-                time.sleep(wait)
+                wait = pol.backoff(attempt)
+                if (deadline - time.monotonic() <= wait
+                        or self._retry_spent + wait > pol.budget):
+                    raise
+                self._retry_spent += wait
+                _sleep(wait)
 
 
 def group_constants_msg(group):
@@ -160,12 +265,14 @@ def check_group_constants(group, constants) -> str:
 
 def make_channel(url: str, max_message: int = MAX_TRUSTEE_MESSAGE,
                  keepalive_ms: int = 60_000) -> grpc.Channel:
-    """Plaintext channel with the reference's size/keepalive settings."""
-    return grpc.insecure_channel(url, options=[
+    """Plaintext channel with the reference's size/keepalive settings.
+    When a fault plan is active (EGTPU_FAULT_PLAN / faults.install), the
+    channel is wrapped with the plan's client interceptor."""
+    return faults.intercept_channel(grpc.insecure_channel(url, options=[
         ("grpc.max_receive_message_length", max_message),
         ("grpc.max_send_message_length", max_message),
         ("grpc.keepalive_time_ms", keepalive_ms),
-    ])
+    ]))
 
 
 def make_server(port: int, max_message: int = MAX_TRUSTEE_MESSAGE,

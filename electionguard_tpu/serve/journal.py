@@ -1,0 +1,105 @@
+"""Write-ahead admission journal: the serving plane's crash-safety spine.
+
+The durability contract of the online encryption service is *admitted ⇒
+published*: once a ballot is accepted into the admission queue (the
+client will eventually see a confirmation code for it), a crash of the
+service process must not lose it.  The batcher queue is memory; the
+growing record stream is written only when a batch drains through the
+device — everything in between dies with the process.
+
+So admission appends one fsync'd record to this journal BEFORE the
+ballot enters the queue.  On restart, ``EncryptionService`` replays the
+journal against the published record: every journaled ballot that never
+reached the record is re-encrypted (in admission order, chained onto the
+last published confirmation code), so the recovered record is exactly
+the record an uncrashed service would have produced — bit-for-bit, chain
+contiguous, verifier green.
+
+Format: one JSON line per admission (``{"id", "spoil", "ballot"}``).
+A SIGKILL can tear the final line; ``replay`` ignores a trailing partial
+line (its admission never ack'd — the fsync had not returned, so the
+client never saw the ballot accepted).  On a clean drain the service
+``reset()``s the journal: a non-empty journal is itself the crash marker.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+from electionguard_tpu.ballot.plaintext import PlaintextBallot
+
+JOURNAL_NAME = "admission_journal.wal"
+
+
+@dataclass(frozen=True)
+class JournalEntry:
+    ballot: PlaintextBallot
+    spoil: bool
+
+
+class AdmissionJournal:
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "ab")
+
+    def append(self, ballot: PlaintextBallot, spoil: bool) -> None:
+        """Durably record one admission (write + flush + fsync) — must
+        return before the ballot enters the admission queue."""
+        self._write({"id": ballot.ballot_id, "spoil": bool(spoil),
+                     "ballot": json.loads(ballot.to_json())})
+
+    def append_drop(self, ballot_id: str) -> None:
+        """Tombstone: the admission journaled just before was REJECTED
+        (queue full / draining) and the client told so — replay must not
+        resurrect it.  Append-only, like everything else in a WAL."""
+        self._write({"id": ballot_id, "drop": True})
+
+    def _write(self, rec: dict) -> None:
+        self._f.write(json.dumps(rec).encode() + b"\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def reset(self) -> None:
+        """Truncate after a clean drain: everything journaled has been
+        resolved (published or rejected in-band)."""
+        self._f.truncate(0)
+        self._f.seek(0)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def replay(path: str) -> list[JournalEntry]:
+    """Journaled admissions in admission order; a torn trailing line
+    (crash mid-append, admission never ack'd) is ignored."""
+    if not os.path.exists(path):
+        return []
+    entries: list[JournalEntry] = []
+    with open(path, "rb") as f:
+        data = f.read()
+    lines = data.split(b"\n")
+    for i, raw in enumerate(lines):
+        if not raw:
+            continue
+        torn_tail = (i == len(lines) - 1 and not data.endswith(b"\n"))
+        try:
+            rec = json.loads(raw)
+            if rec.get("drop"):
+                # tombstone: remove the latest pending entry for this id
+                for k in range(len(entries) - 1, -1, -1):
+                    if entries[k].ballot.ballot_id == rec["id"]:
+                        del entries[k]
+                        break
+                continue
+            ballot = PlaintextBallot.from_json(json.dumps(rec["ballot"]))
+        except (ValueError, KeyError):
+            if torn_tail:
+                break   # mid-append crash; the admission never ack'd
+            raise IOError(f"corrupt journal line {i} in {path}")
+        entries.append(JournalEntry(ballot, bool(rec["spoil"])))
+    return entries

@@ -108,7 +108,7 @@ class ServiceMetrics:
     COUNTERS = ("requests_admitted", "requests_rejected_queue_full",
                 "requests_rejected_draining", "requests_failed",
                 "ballots_encrypted", "ballots_invalid", "ballots_spoiled",
-                "batches_flushed", "padded_slots")
+                "ballots_recovered", "batches_flushed", "padded_slots")
 
     def __init__(self, queue_depth: Optional[Callable[[], int]] = None):
         self._lock = threading.Lock()

@@ -19,6 +19,7 @@ record is publishable, then stop the server.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Optional, Sequence
 
@@ -29,8 +30,10 @@ from electionguard_tpu.core.group import ElementModQ, GroupContext
 from electionguard_tpu.encrypt.encryptor import BatchEncryptor
 from electionguard_tpu.publish import pb, serialize
 from electionguard_tpu.publish.election_record import ElectionInitialized
-from electionguard_tpu.publish.publisher import Publisher
+from electionguard_tpu.publish.publisher import (Publisher,
+                                                 repair_frame_stream)
 from electionguard_tpu.remote import rpc_util
+from electionguard_tpu.serve import journal as wal
 from electionguard_tpu.serve.batcher import (DrainingError, DynamicBatcher,
                                              QueueFullError)
 from electionguard_tpu.serve.metrics import ServiceMetrics
@@ -62,18 +65,32 @@ class EncryptionService:
                  prewarm: bool = True,
                  mesh=None,
                  max_workers: int = 16,
-                 hold: Optional[threading.Event] = None):
+                 hold: Optional[threading.Event] = None,
+                 hold_after: Optional[int] = None):
         self.init = init
         self.group = group if group is not None else \
             init.joint_public_key.group
+        self._status = "STARTING"
         self.publisher = Publisher(out_dir) if out_dir else None
         self._stream = None
+        self.journal: Optional[wal.AdmissionJournal] = None
+        self._adm_lock = threading.Lock()
+        self.recovered_ballots = 0
+        gap: list[wal.JournalEntry] = []
+        code_seed: Optional[bytes] = None
         if self.publisher is not None:
             # the record dir is self-contained from the first ballot on:
             # init lands before serving starts, ballots append as batches
-            # drain, so a SIGTERM drain only has to close the stream
+            # drain, so a SIGTERM drain only has to close the stream.
+            # A restart first repairs a possibly-torn ballot stream and
+            # diffs the admission journal against it: the difference is
+            # exactly the admitted-but-unpublished gap a crash lost.
             self.publisher.write_election_initialized(init)
-            self._stream = self.publisher.open_encrypted_ballots()
+            jpath = os.path.join(out_dir, wal.JOURNAL_NAME)
+            gap, code_seed = self._plan_recovery(jpath)
+            self.journal = wal.AdmissionJournal(jpath)
+            self._stream = self.publisher.open_encrypted_ballots(
+                append=True)
         self.batcher = DynamicBatcher(max_batch=max_batch,
                                       max_wait_ms=max_wait_ms,
                                       max_queue=max_queue, buckets=buckets)
@@ -81,27 +98,104 @@ class EncryptionService:
         self.worker = EncryptionWorker(
             self.batcher, BatchEncryptor(init, self.group, mesh=mesh),
             self.metrics, seed=seed, timestamp=timestamp,
-            stream=self._stream, hold=hold)
+            stream=self._stream, hold=hold, code_seed=code_seed,
+            hold_after=hold_after)
         if prewarm:
             # compile every (program, bucket) pair before the first
             # request: under load the compile counter stays flat
             self.worker.prewarm()
         self.worker.start()
+        if gap:
+            self._status = "RECOVERING"
+            self._replay_gap(gap)
         self.server, self.port = rpc_util.make_server(
             port, max_workers=max_workers)
         self.server.add_generic_rpc_handlers((rpc_util.generic_service(
             _SERVICE,
             {"encryptBallot": self._encrypt_ballot,
              "encryptBallotBatch": self._encrypt_ballot_batch,
-             "getMetrics": self._get_metrics}),))
+             "getMetrics": self._get_metrics,
+             "health": self._health}),))
         self.server.start()
         self._drained = threading.Event()
+        self._status = "SERVING"
         log.info("encryption service on port %d (max_batch=%d "
-                 "max_wait=%.0fms max_queue=%d buckets=%s)", self.port,
-                 max_batch, max_wait_ms, max_queue,
-                 list(self.batcher.buckets))
+                 "max_wait=%.0fms max_queue=%d buckets=%s recovered=%d)",
+                 self.port, max_batch, max_wait_ms, max_queue,
+                 list(self.batcher.buckets), self.recovered_ballots)
+
+    # ---- crash recovery ----------------------------------------------
+    def _plan_recovery(self, jpath: str
+                       ) -> tuple[list[wal.JournalEntry], Optional[bytes]]:
+        """Repair the published stream's tail, then compute the replay
+        gap (journaled admissions never published) and the code-chain
+        head (last published ballot's confirmation code)."""
+        entries = wal.replay(jpath)
+        ballots_path = os.path.join(self.publisher.dir,
+                                    "encrypted_ballots.pb")
+        n_pub, last_frame = repair_frame_stream(ballots_path)
+        code_seed = None
+        published: set[str] = set()
+        if n_pub:
+            from electionguard_tpu.publish.publisher import _read_frames
+            for frame in _read_frames(ballots_path):
+                m = pb.EncryptedBallot()
+                m.ParseFromString(frame)
+                published.add(m.ballot_id)
+            m = pb.EncryptedBallot()
+            m.ParseFromString(last_frame)
+            code_seed = serialize.import_u256(m.code)
+        gap = [e for e in entries if e.ballot.ballot_id not in published]
+        if entries and not gap:
+            log.info("journal fully published (%d entries); nothing to "
+                     "recover", len(entries))
+        return gap, code_seed
+
+    def _replay_gap(self, gap: list[wal.JournalEntry]) -> None:
+        """Re-encrypt the crash gap through the normal worker path, in
+        admission order, BEFORE the server accepts new requests — the
+        recovered stream continues the code chain exactly where the
+        published record stops."""
+        import time
+        log.warning("recovering %d admitted-but-unpublished ballots "
+                    "from the journal", len(gap))
+        futures = []
+        for e in gap:
+            while True:   # a gap larger than the queue drains in waves
+                try:
+                    futures.append((e.ballot.ballot_id,
+                                    self.batcher.submit(e.ballot,
+                                                        spoil=e.spoil)))
+                    break
+                except QueueFullError:
+                    time.sleep(0.05)
+        for bid, fut in futures:
+            try:
+                fut.result(timeout=_RESULT_TIMEOUT)
+                self.recovered_ballots += 1
+                self.metrics.inc("ballots_recovered")
+            except InvalidBallotError as e:
+                # it was invalid the first time too: the original run
+                # would have answered in-band; resolution is identical
+                log.warning("recovered ballot %s invalid: %s", bid, e)
 
     # ---- rpc impls ---------------------------------------------------
+    def _admit(self, ballot: PlaintextBallot, spoil: bool):
+        """Journal-then-enqueue, atomically w.r.t. other admissions: the
+        WAL line is durable BEFORE the ballot enters the queue, so a
+        crash can lose the queue but never an admitted ballot.  A
+        rejected enqueue appends a tombstone so replay won't resurrect a
+        ballot whose client saw the rejection."""
+        with self._adm_lock:
+            if self.journal is not None:
+                self.journal.append(ballot, spoil)
+            try:
+                return self.batcher.submit(ballot, spoil=spoil)
+            except (QueueFullError, DrainingError):
+                if self.journal is not None:
+                    self.journal.append_drop(ballot.ballot_id)
+                raise
+
     def _submit(self, ballot_msg, spoil: bool, context):
         """Parse + admit one request; returns the future or aborts."""
         ballot = serialize.import_plaintext_ballot(ballot_msg)
@@ -110,7 +204,7 @@ class EncryptionService:
             return None, "ballot id prefix '__pad-' is reserved"
         try:
             self.metrics.inc("requests_admitted")
-            return self.batcher.submit(ballot, spoil=spoil), None
+            return self._admit(ballot, spoil), None
         except QueueFullError as e:
             self.metrics.inc("requests_admitted", -1)
             self.metrics.inc("requests_rejected_queue_full")
@@ -152,7 +246,7 @@ class EncryptionService:
                 continue
             try:
                 self.metrics.inc("requests_admitted")
-                pending.append((self.batcher.submit(ballot), None))
+                pending.append((self._admit(ballot, False), None))
             except QueueFullError as e:
                 self.metrics.inc("requests_admitted", -1)
                 self.metrics.inc("requests_rejected_queue_full")
@@ -167,6 +261,15 @@ class EncryptionService:
     def _get_metrics(self, request, context):
         return self.metrics.to_proto()
 
+    def _health(self, request, context):
+        depth = self.batcher.depth()
+        return pb.msg("HealthResponse")(
+            status=self._status,
+            ready=(self._status == "SERVING"
+                   and depth < self.batcher.max_queue),
+            queue_depth=depth,
+            recovered_ballots=self.recovered_ballots)
+
     # ---- lifecycle ---------------------------------------------------
     def drain(self, grace: float = 5.0) -> None:
         """Graceful shutdown: stop admitting, flush in-flight batches,
@@ -174,12 +277,19 @@ class EncryptionService:
         if self._drained.is_set():
             return
         self._drained.set()
+        self._status = "DRAINING"
         log.info("draining: %d requests queued", self.batcher.depth())
         self.batcher.close()
         self.worker.join(timeout=_RESULT_TIMEOUT)
         if self._stream is not None:
             self._stream.close()
             self._stream = None
+        if self.journal is not None and not self.worker.is_alive():
+            # everything admitted is now resolved (published or answered
+            # in-band); an empty journal marks the shutdown as clean
+            self.journal.reset()
+            self.journal.close()
+            self.journal = None
         # request threads blocked in _resolve still hold completed
         # futures; give them `grace` to serialize their responses
         self.server.stop(grace=grace).wait(grace)
@@ -234,6 +344,10 @@ class EncryptionClient:
 
     def metrics(self, timeout: float = 30.0):
         return self._stub.call("getMetrics", pb.msg("MetricsRequest")(),
+                               timeout=timeout)
+
+    def health(self, timeout: float = 30.0):
+        return self._stub.call("health", pb.msg("HealthRequest")(),
                                timeout=timeout)
 
     def close(self) -> None:

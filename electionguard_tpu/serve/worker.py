@@ -51,13 +51,21 @@ class EncryptionWorker(threading.Thread):
                  seed: Optional[ElementModQ] = None,
                  timestamp: Optional[int] = None,
                  stream=None,
-                 hold: Optional[threading.Event] = None):
+                 hold: Optional[threading.Event] = None,
+                 code_seed: Optional[bytes] = None,
+                 hold_after: Optional[int] = None):
         """``stream``: optional ``EncryptedBallotStream`` every real
         encrypted ballot is appended to (the growing record).
         ``timestamp``: pin the ballot timestamp (tests/differential runs);
         None stamps each batch with encryption time.
         ``hold``: when given, the worker waits on it before each pull —
-        a test hook to force queue buildup deterministically."""
+        a test hook to force queue buildup deterministically.
+        ``code_seed``: continue the confirmation-code chain from this
+        code (crash recovery: the last PUBLISHED ballot's code).
+        ``hold_after``: chaos hook — once this many ballots are
+        encrypted, the worker stops pulling forever (a deterministic
+        stand-in for "the device owner wedged/died mid-stream" that the
+        SIGKILL chaos test arms via EGTPU_CHAOS_HOLD_AFTER_BALLOTS)."""
         super().__init__(name="encryption-worker", daemon=True)
         self.batcher = batcher
         self.enc = encryptor
@@ -66,7 +74,8 @@ class EncryptionWorker(threading.Thread):
         self.timestamp = timestamp
         self.stream = stream
         self.hold = hold
-        self._code_seed: Optional[bytes] = None
+        self.hold_after = hold_after
+        self._code_seed: Optional[bytes] = code_seed
         self._pad_counter = 0
         self._filler_proto = self._make_filler_proto()
         self.error: Optional[BaseException] = None
@@ -105,6 +114,12 @@ class EncryptionWorker(threading.Thread):
         while True:
             if self.hold is not None:
                 self.hold.wait()
+            if (self.hold_after is not None
+                    and self.metrics.get("ballots_encrypted")
+                    >= self.hold_after):
+                log.warning("chaos hold: %d ballots encrypted, worker "
+                            "wedged", self.hold_after)
+                threading.Event().wait()   # wedge until SIGKILL
             batch = self.batcher.next_batch()
             if batch is None:
                 return
@@ -152,6 +167,10 @@ class EncryptionWorker(threading.Thread):
             if self.stream is not None:
                 for b in real_encrypted:
                     self.stream.write(b)
+                # batch-boundary durability: a crash after this point
+                # loses nothing from this batch; a crash before it is
+                # covered by the admission journal's replay
+                self.stream.flush()
         by_id = {b.ballot_id: b for b in real_encrypted}
         inv_by_id = {b.ballot_id: reason for b, reason in invalid}
         now = clock()

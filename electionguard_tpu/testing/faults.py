@@ -1,0 +1,282 @@
+"""Deterministic fault injection for the gRPC planes.
+
+The reference system retries nothing and was never tested against a
+failing network (SURVEY.md §5.3); this repo's retry/degradation paths
+exist precisely to survive such failures — and untested failure paths
+are broken failure paths.  This module injects faults *deterministically*
+(on the Nth call of a named method), so the chaos suite
+(tests/test_faults.py) can drive every recovery path and assert the
+election record still verifies.
+
+A ``FaultPlan`` is a list of rules::
+
+    {"rules": [
+        {"method": "registerTrustee", "kind": "unavailable", "on_calls": [1, 2]},
+        {"method": "directDecrypt",   "kind": "latency", "latency_s": 0.2},
+        {"method": "receiveSecretKeyShare", "kind": "drop_response",
+         "on_calls": [1], "where": "server"}
+    ]}
+
+Kinds:
+
+* ``unavailable`` / ``deadline`` — client side: the request never reaches
+  the peer; the caller sees UNAVAILABLE / DEADLINE_EXCEEDED (a dead or
+  unreachable peer).  Server side: the rpc aborts *before* the impl runs.
+* ``latency`` — added delay before the call proceeds (either side).
+* ``drop_response`` — server side only: the impl RUNS (state commits),
+  then the response is dropped and the client sees UNAVAILABLE — the
+  idempotency killer.  A retried rpc replays against already-committed
+  state; every service must tolerate that.
+* ``crash_after`` — server side: the impl runs, then the process "dies"
+  before the response goes out.  In-process tests wire ``plan.crash_cb``
+  (typically to stop the server); an env-loaded plan in a subprocess
+  hard-exits with ``os._exit(137)`` — a genuine crash: no atexit, no
+  graceful drain, connection reset.  Deterministic "trustee dies
+  mid-ceremony", at an exact protocol point instead of a timer.
+
+Activation:
+
+* in-process tests: ``faults.install(plan)`` / ``faults.clear()``;
+* subprocesses: ``EGTPU_FAULT_PLAN`` env var — inline JSON, or
+  ``@/path/to/plan.json``.  ``rpc_util.make_channel`` and
+  ``rpc_util.generic_service`` consult ``active_plan()`` so every client
+  channel and server in the process participates with zero call-site
+  changes.
+
+Call counters are per (where, method) and process-local; plans fire the
+same way on every run — no randomness, no wall-clock dependence.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import grpc
+
+
+class InjectedRpcError(grpc.RpcError):
+    """A client-side injected failure, quacking like a real RpcError."""
+
+    def __init__(self, code: grpc.StatusCode, details: str):
+        super().__init__()
+        self._code = code
+        self._details = details
+
+    def code(self) -> grpc.StatusCode:
+        return self._code
+
+    def details(self) -> str:
+        return self._details
+
+    def __str__(self) -> str:
+        return f"InjectedRpcError({self._code}, {self._details!r})"
+
+
+_KINDS = ("unavailable", "deadline", "latency", "drop_response",
+          "crash_after")
+
+
+@dataclass(frozen=True)
+class FaultRule:
+    method: str                  # method short name; "*" matches every method
+    kind: str                    # one of _KINDS
+    on_calls: tuple[int, ...] = ()   # 1-based call indices; () = every call
+    latency_s: float = 0.0
+    where: str = ""              # "client" | "server"; "" = kind default
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+
+    @property
+    def side(self) -> str:
+        if self.where:
+            return self.where
+        return ("server" if self.kind in ("drop_response", "crash_after")
+                else "client")
+
+    def matches(self, method: str, call_index: int) -> bool:
+        if self.method != "*" and self.method != method:
+            return False
+        return not self.on_calls or call_index in self.on_calls
+
+
+@dataclass
+class FaultPlan:
+    rules: list[FaultRule] = field(default_factory=list)
+    #: wired by in-process tests that use ``crash_after``: called with
+    #: the method name; typically stops the server to simulate a death
+    crash_cb: Optional[Callable[[str], None]] = None
+    #: env-loaded plans set this: ``crash_after`` without a wired cb
+    #: hard-exits the process (os._exit(137)) — a genuine crash
+    hard_exit: bool = False
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+        self._counts: dict[tuple[str, str], int] = {}
+        #: audit log of every injected fault: (where, method, call_index,
+        #: kind) — the chaos suite asserts its plan actually fired
+        self.injected: list[tuple[str, str, int, str]] = []
+
+    @staticmethod
+    def from_json(text: str) -> "FaultPlan":
+        data = json.loads(text)
+        return FaultPlan(rules=[
+            FaultRule(method=r["method"], kind=r["kind"],
+                      on_calls=tuple(r.get("on_calls", ())),
+                      latency_s=float(r.get("latency_s", 0.0)),
+                      where=r.get("where", ""))
+            for r in data.get("rules", [])])
+
+    @staticmethod
+    def from_env() -> Optional["FaultPlan"]:
+        spec = os.environ.get("EGTPU_FAULT_PLAN", "")
+        if not spec:
+            return None
+        if spec.startswith("@"):
+            with open(spec[1:]) as f:
+                spec = f.read()
+        plan = FaultPlan.from_json(spec)
+        plan.hard_exit = True
+        return plan
+
+    # ------------------------------------------------------------------
+    def _next_index(self, where: str, method: str) -> int:
+        with self._lock:
+            n = self._counts.get((where, method), 0) + 1
+            self._counts[(where, method)] = n
+            return n
+
+    def firing(self, where: str, method: str) -> list[tuple[FaultRule, int]]:
+        """Advance the (where, method) call counter and return the rules
+        firing on this call (with the call index, for the audit log)."""
+        n = self._next_index(where, method)
+        out = []
+        for r in self.rules:
+            if r.side == where and r.matches(method, n):
+                with self._lock:
+                    self.injected.append((where, method, n, r.kind))
+                out.append((r, n))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# process-wide active plan
+# ---------------------------------------------------------------------------
+
+_active: Optional[FaultPlan] = None
+_loaded_env = False
+_install_lock = threading.Lock()
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    """Activate ``plan`` for every channel/server created afterwards."""
+    global _active, _loaded_env
+    with _install_lock:
+        _active = plan
+        _loaded_env = True
+    return plan
+
+
+def clear() -> None:
+    global _active, _loaded_env
+    with _install_lock:
+        _active = None
+        # keep _loaded_env True: an explicit clear() must not resurrect
+        # an env plan mid-test
+        _loaded_env = True
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The installed plan, else one lazily loaded from EGTPU_FAULT_PLAN."""
+    global _active, _loaded_env
+    with _install_lock:
+        if not _loaded_env:
+            _loaded_env = True
+            _active = FaultPlan.from_env()
+        return _active
+
+
+# ---------------------------------------------------------------------------
+# client interceptor
+# ---------------------------------------------------------------------------
+
+class FaultClientInterceptor(grpc.UnaryUnaryClientInterceptor):
+    """Applies a plan's client-side rules before the request leaves."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+
+    def intercept_unary_unary(self, continuation, client_call_details,
+                              request):
+        method = client_call_details.method.rsplit("/", 1)[-1]
+        for rule, _n in self.plan.firing("client", method):
+            if rule.kind == "latency":
+                time.sleep(rule.latency_s)
+            elif rule.kind == "unavailable":
+                raise InjectedRpcError(
+                    grpc.StatusCode.UNAVAILABLE,
+                    f"injected UNAVAILABLE on {method}")
+            elif rule.kind == "deadline":
+                raise InjectedRpcError(
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                    f"injected DEADLINE_EXCEEDED on {method}")
+        return continuation(client_call_details, request)
+
+
+def intercept_channel(channel: grpc.Channel) -> grpc.Channel:
+    """Wrap ``channel`` with the active plan's client interceptor (no-op
+    without an active plan)."""
+    plan = active_plan()
+    if plan is None:
+        return channel
+    return grpc.intercept_channel(channel, FaultClientInterceptor(plan))
+
+
+# ---------------------------------------------------------------------------
+# server wrapper
+# ---------------------------------------------------------------------------
+
+def wrap_server_impl(method: str, fn: Callable) -> Callable:
+    """Wrap one ``fn(request, context)`` impl with the active plan's
+    server-side rules (no-op without an active plan)."""
+    plan = active_plan()
+    if plan is None:
+        return fn
+
+    def wrapped(request, context):
+        # context.abort raises, so a firing error rule never reaches the
+        # trailing fn call; drop/crash rules run fn exactly once first
+        for rule, _n in plan.firing("server", method):
+            if rule.kind == "latency":
+                time.sleep(rule.latency_s)
+            elif rule.kind in ("unavailable", "deadline"):
+                context.abort(
+                    grpc.StatusCode.UNAVAILABLE
+                    if rule.kind == "unavailable"
+                    else grpc.StatusCode.DEADLINE_EXCEEDED,
+                    f"injected {rule.kind} on {method}")
+            elif rule.kind == "drop_response":
+                fn(request, context)          # state COMMITS ...
+                context.abort(grpc.StatusCode.UNAVAILABLE,  # ... response lost
+                              f"injected response drop on {method}")
+            elif rule.kind == "crash_after":
+                fn(request, context)
+                if plan.crash_cb is not None:
+                    plan.crash_cb(method)
+                elif plan.hard_exit:
+                    logging.getLogger("egtpu.faults").warning(
+                        "injected crash after %s: hard process exit",
+                        method)
+                    os._exit(137)   # no atexit, no drain — a real crash
+                context.abort(grpc.StatusCode.UNAVAILABLE,
+                              f"injected crash after {method}")
+        return fn(request, context)
+
+    return wrapped

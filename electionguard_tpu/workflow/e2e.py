@@ -20,6 +20,7 @@ Run:  python -m electionguard_tpu.workflow.e2e -out /tmp/eg -nballots 20 \
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -77,6 +78,13 @@ def main(argv=None) -> int:
                          "verifier V13 in phase 5")
     ap.add_argument("-keep", action="store_true",
                     help="keep going past failures and dump all output")
+    ap.add_argument("-chaosRestartGuardian", dest="chaos_guardian",
+                    type=int, default=-1,
+                    help="chaos hook: this guardian hard-crashes "
+                         "(EGTPU_FAULT_PLAN crash_after) right after it "
+                         "commits its first received key share, then "
+                         "restarts from its resume file; the ceremony "
+                         "must still complete (fault-injection harness)")
     args = ap.parse_args(argv)
 
     out = args.output
@@ -105,6 +113,11 @@ def main(argv=None) -> int:
 
     # ---- phase 1: key ceremony (multi-process) ---------------------------
     t0 = time.time()
+    if args.chaos_guardian >= 0:
+        # the COORDINATOR (launched next) needs a retry window wide
+        # enough to bridge the guardian's kill→restart gap
+        os.environ.setdefault("EGTPU_RPC_RETRIES", "8")
+        os.environ.setdefault("EGTPU_RPC_RETRY_BUDGET", "300")
     kc_port = find_free_port()
     coord = RunCommand.python_module(
         "keyceremony-coordinator",
@@ -116,16 +129,43 @@ def main(argv=None) -> int:
         cmd_out)
     procs.append(coord)
     time.sleep(1.5)  # let the coordinator bind
+    chaos_dir = os.path.join(out, "chaos")
     guardians = []
     for i in range(args.nguardians):
+        flags = ["-name", f"guardian-{i}", "-serverPort", str(kc_port),
+                 "-out", trustee_dir] + group_flags
+        env = None
+        if args.chaos_guardian >= 0:
+            # resume files make every guardian restartable; only the
+            # chaos target actually crashes
+            os.makedirs(chaos_dir, exist_ok=True)
+            flags += ["-resumeFile",
+                      os.path.join(chaos_dir, f"guardian-{i}.resume")]
+            if i == args.chaos_guardian:
+                # deterministic death at a protocol point, not a timer:
+                # the guardian hard-exits (os._exit) right after it
+                # commits + checkpoints its first received key share,
+                # so the retried rpc must replay against restored state
+                env = {"EGTPU_FAULT_PLAN": json.dumps({"rules": [
+                    {"method": "receiveSecretKeyShare",
+                     "kind": "crash_after", "on_calls": [1]}]})}
         guardians.append(RunCommand.python_module(
             f"guardian-{i}", "electionguard_tpu.cli.run_remote_trustee",
-            ["-name", f"guardian-{i}", "-serverPort", str(kc_port),
-             "-out", trustee_dir] + group_flags,
-            cmd_out))
+            flags, cmd_out, env=env))
     procs.extend(guardians)
-    if not wait_all([coord] + guardians, timeout=180):
+    chaos_thread = None
+    if 0 <= args.chaos_guardian < len(guardians):
+        log.info("CHAOS: guardian-%d dies after its first committed key "
+                 "share and restarts from its resume file",
+                 args.chaos_guardian)
+        chaos_thread = guardians[args.chaos_guardian].restart_on_exit(
+            strip_env=("EGTPU_FAULT_PLAN",), downtime_s=1.0)
+    if not wait_all([coord] + guardians, timeout=240):
         return phase_fail("key-ceremony", [coord] + guardians)
+    if chaos_thread is not None:
+        chaos_thread.join(timeout=10)
+        log.info("[1] key ceremony survived the guardian-%d chaos "
+                 "restart", args.chaos_guardian)
     log.info("[1] key ceremony took %.1fs", time.time() - t0)
 
     # ---- phase 2: fake ballots + batch encryption ------------------------

@@ -12,6 +12,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import threading
 import time
 from typing import Optional
 
@@ -21,6 +22,7 @@ class RunCommand:
                  env: Optional[dict] = None):
         self.name = name
         self.args = list(args)
+        self._env = dict(env or {})
         os.makedirs(output_dir, exist_ok=True)
         self.stdout_path = os.path.join(output_dir, f"{name}.stdout")
         self.stderr_path = os.path.join(output_dir, f"{name}.stderr")
@@ -28,7 +30,7 @@ class RunCommand:
         self._stderr_f = open(self.stderr_path, "wb")
         self.process = subprocess.Popen(
             self.args, stdout=self._stdout_f, stderr=self._stderr_f,
-            env={**os.environ, **(env or {})})
+            env={**os.environ, **self._env})
 
     @staticmethod
     def python_module(name: str, module: str, flags: list[str],
@@ -56,6 +58,47 @@ class RunCommand:
             except subprocess.TimeoutExpired:
                 self.process.kill()
         self._close()
+
+    # ---- chaos hooks (fault-injection harness, ISSUE 2) --------------
+    def kill_hard(self):
+        """SIGKILL — no signal handlers, no atexit, no graceful drain:
+        the genuine crash the recovery paths must survive."""
+        if self.process.poll() is None:
+            self.process.kill()
+            self.process.wait(10)
+        self._close()
+
+    def restart(self) -> None:
+        """Relaunch the SAME argv (e.g. a trustee pointed at its resume
+        file); captured output appends so one log tells the whole
+        story.  The previous process must have exited."""
+        if self.process.poll() is None:
+            raise RuntimeError(f"{self.name} still running; kill first")
+        self._close()
+        self._stdout_f = open(self.stdout_path, "ab")
+        self._stderr_f = open(self.stderr_path, "ab")
+        self.process = subprocess.Popen(
+            self.args, stdout=self._stdout_f, stderr=self._stderr_f,
+            env={**os.environ, **self._env})
+
+    def restart_on_exit(self, strip_env: tuple[str, ...] = (),
+                        downtime_s: float = 1.0) -> threading.Thread:
+        """Watch for the process's FIRST exit (e.g. an EGTPU_FAULT_PLAN
+        crash_after hard-exit at a deterministic protocol point) and
+        relaunch it once, ``downtime_s`` later, with ``strip_env`` keys
+        removed so the fault does not re-fire.  Returns the daemon
+        watcher thread so callers can join it."""
+        def fire():
+            self.process.wait()
+            for k in strip_env:
+                self._env.pop(k, None)
+            time.sleep(downtime_s)
+            self.restart()
+
+        t = threading.Thread(target=fire, daemon=True,
+                             name=f"chaos-{self.name}")
+        t.start()
+        return t
 
     def _close(self):
         for f in (self._stdout_f, self._stderr_f):

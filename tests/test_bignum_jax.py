@@ -11,7 +11,7 @@ import numpy as np
 import pytest
 
 from electionguard_tpu.core import bignum_jax as bn
-from electionguard_tpu.core.group import production_group, tiny_group
+from electionguard_tpu.core.group import tiny_group
 from electionguard_tpu.core.group_jax import JaxGroupOps, jax_ops
 
 rng = random.Random(20260729)
@@ -113,8 +113,8 @@ def test_residue_check_tiny():
 # ---------------------------------------------------------------------------
 
 @pytest.mark.slow
-def test_production_mulmod_powmod():
-    g = production_group()
+def test_production_mulmod_powmod(pgroup):
+    g = pgroup
     ops = jax_ops(g)
     B = 4
     a = [rng.randrange(g.p) for _ in range(B)]
@@ -127,8 +127,8 @@ def test_production_mulmod_powmod():
 
 
 @pytest.mark.slow
-def test_production_g_pow_and_prod():
-    g = production_group()
+def test_production_g_pow_and_prod(pgroup):
+    g = pgroup
     ops = jax_ops(g)
     exps = [0, 1, g.q - 1, rng.randrange(g.q)]
     assert ops.g_pow_ints(exps) == [pow(g.g, e, g.p) for e in exps]
@@ -156,8 +156,8 @@ def test_multi_powmod_tiny():
     assert got == want
 
 
-def test_multi_powmod_production():
-    g = production_group()
+def test_multi_powmod_production(pgroup):
+    g = pgroup
     ops = jax_ops(g)
     B, k = 3, 3
     bases = [rng.randrange(1, g.p) for _ in range(B)]

@@ -1,0 +1,631 @@
+"""Chaos suite: every injected failure drives a real recovery path and
+the election record still verifies (ISSUE 2 acceptance).
+
+Layers under test, all with deterministic fault plans (testing/faults.py
+— Nth-call injection, no timers, no randomness):
+
+* the fault-plan machinery itself (client interceptor, server wrapper,
+  the drop-response idempotency killer, env-var activation);
+* key ceremony: a trustee "process" dies right after committing its
+  first received key share and restarts from its resume file — the
+  ceremony completes and every trustee file lands;
+* decryption: a trustee dies mid-run; while quorum holds it is demoted
+  to the missing set and the tally completes with compensated shares;
+  below quorum the run fails cleanly with a quorum error;
+* serving plane: a crashed encryption service replays its write-ahead
+  admission journal on restart — zero lost admitted ballots, the code
+  chain contiguous, the record bit-for-bit the offline encryptor's
+  output.  Both an in-process crash and a real SIGKILL'd subprocess.
+
+Everything here is tiny-group and deliberately non-slow: failure
+semantics are tier-1 machinery, not an overnight suite.
+"""
+
+import json
+import os
+import threading
+import time
+
+import grpc
+import pytest
+
+from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+from electionguard_tpu.core.dlog import DLog
+from electionguard_tpu.crypto.elgamal import elgamal_encrypt
+from electionguard_tpu.ballot.tally import (EncryptedTally,
+                                            EncryptedTallyContest,
+                                            EncryptedTallySelection)
+from electionguard_tpu.decrypt.decryption import (Decryption,
+                                                  DecryptionError)
+from electionguard_tpu.decrypt.trustee import DecryptingTrustee
+from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+from electionguard_tpu.keyceremony.interface import Result
+from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+from electionguard_tpu.publish.election_record import ElectionConfig
+from electionguard_tpu.remote import rpc_util
+from electionguard_tpu.remote.decrypting_remote import (
+    DecryptingTrusteeServer, DecryptionCoordinator)
+from electionguard_tpu.remote.keyceremony_remote import (
+    KeyCeremonyCoordinator, KeyCeremonyTrusteeServer, RemoteKeyCeremonyProxy)
+from electionguard_tpu.serve import journal as wal
+from electionguard_tpu.testing import faults
+from tests.test_keyceremony import tiny_manifest
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    """A test's fault plan must never leak into the next test."""
+    yield
+    faults.clear()
+
+
+@pytest.fixture
+def fastrpc(monkeypatch):
+    """Fast, deterministic retry posture: 2 attempts, pinned jitter
+    (upper bound), sub-second bounded connect windows."""
+    monkeypatch.setenv("EGTPU_RPC_RETRIES", "2")
+    monkeypatch.setenv("EGTPU_RPC_RETRY_WAIT", "0.2")
+    monkeypatch.setenv("EGTPU_RPC_RETRY_CAP", "0.4")
+    monkeypatch.setenv("EGTPU_RPC_CONNECT_WINDOW", "0.4")
+    monkeypatch.setattr(rpc_util, "_uniform", lambda lo, hi: hi)
+
+
+# =====================================================================
+# fault-plan machinery
+# =====================================================================
+
+
+def test_fault_plan_parsing_and_env_activation(tmp_path, monkeypatch):
+    spec = {"rules": [{"method": "x", "kind": "unavailable",
+                       "on_calls": [2]},
+                      {"method": "*", "kind": "latency",
+                       "latency_s": 0.5}]}
+    monkeypatch.setenv("EGTPU_FAULT_PLAN", json.dumps(spec))
+    plan = faults.FaultPlan.from_env()
+    assert plan.hard_exit  # env plans crash for real on crash_after
+    assert plan.rules[0].on_calls == (2,)
+    assert plan.rules[1].method == "*"
+    # @file indirection
+    p = tmp_path / "plan.json"
+    p.write_text(json.dumps(spec))
+    monkeypatch.setenv("EGTPU_FAULT_PLAN", f"@{p}")
+    assert faults.FaultPlan.from_env().rules == plan.rules
+    monkeypatch.delenv("EGTPU_FAULT_PLAN")
+    assert faults.FaultPlan.from_env() is None
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.FaultRule(method="m", kind="bogus")
+
+
+def test_fault_rule_matching_and_sides():
+    r = faults.FaultRule(method="*", kind="latency")
+    assert r.matches("anything", 7)          # wildcard + every call
+    assert r.side == "client"                # latency defaults client
+    assert faults.FaultRule(method="m", kind="drop_response").side \
+        == "server"
+    assert faults.FaultRule(method="m", kind="unavailable",
+                            where="server").side == "server"
+    n = faults.FaultRule(method="m", kind="deadline", on_calls=(2, 4))
+    assert not n.matches("m", 1) and n.matches("m", 2)
+    assert not n.matches("other", 2)
+
+
+def test_client_injected_unavailable_is_retried_through(tgroup, fastrpc):
+    """An injected UNAVAILABLE on the first attempt is absorbed by the
+    retry layer: the caller never sees the fault."""
+    coord = KeyCeremonyCoordinator(tgroup, 1, 1, port=0)
+    plan = faults.install(faults.FaultPlan(rules=[faults.FaultRule(
+        method="registerTrustee", kind="unavailable", on_calls=(1,))]))
+    try:
+        proxy = RemoteKeyCeremonyProxy(f"localhost:{coord.port}")
+        resp = proxy.register_trustee("solo", "localhost:9", tgroup,
+                                      nonce=b"n1")
+        proxy.close()
+        assert resp.x_coordinate == 1 and not resp.error
+        # the audit log proves the fault actually fired (attempt 1),
+        # and the retry (call 2) went through clean
+        assert plan.injected == [("client", "registerTrustee", 1,
+                                  "unavailable")]
+    finally:
+        coord.shutdown(all_ok=True)
+
+
+def test_client_injected_deadline_is_fatal_first_attempt(tgroup, fastrpc):
+    """DEADLINE_EXCEEDED on a first (full-budget) attempt is a real
+    timeout, not a connect hiccup — no retry."""
+    coord = KeyCeremonyCoordinator(tgroup, 1, 1, port=0)
+    plan = faults.install(faults.FaultPlan(rules=[faults.FaultRule(
+        method="registerTrustee", kind="deadline", on_calls=(1,))]))
+    try:
+        proxy = RemoteKeyCeremonyProxy(f"localhost:{coord.port}")
+        with pytest.raises(grpc.RpcError) as ei:
+            proxy.register_trustee("solo", "localhost:9", tgroup)
+        proxy.close()
+        assert ei.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+        assert len(plan.injected) == 1       # exactly one attempt
+        assert coord.ready() == 0            # never reached the peer
+    finally:
+        coord.shutdown(all_ok=True)
+
+
+def test_injected_latency_delays_the_call(tgroup, fastrpc):
+    coord = KeyCeremonyCoordinator(tgroup, 1, 1, port=0)
+    faults.install(faults.FaultPlan(rules=[faults.FaultRule(
+        method="registerTrustee", kind="latency", latency_s=0.25)]))
+    try:
+        proxy = RemoteKeyCeremonyProxy(f"localhost:{coord.port}")
+        t0 = time.monotonic()
+        resp = proxy.register_trustee("solo", "localhost:9", tgroup,
+                                      nonce=b"n1")
+        proxy.close()
+        assert time.monotonic() - t0 >= 0.25
+        assert resp.x_coordinate == 1
+    finally:
+        coord.shutdown(all_ok=True)
+
+
+def test_server_drop_response_replays_idempotently(tgroup, fastrpc):
+    """The idempotency killer: the impl RUNS (registration committed),
+    the response is dropped, the client retries — the replay must hand
+    back the original answer, not a duplicate registration."""
+    plan = faults.install(faults.FaultPlan(rules=[faults.FaultRule(
+        method="registerTrustee", kind="drop_response", on_calls=(1,))]))
+    coord = KeyCeremonyCoordinator(tgroup, 1, 1, port=0)  # wrapped server
+    try:
+        proxy = RemoteKeyCeremonyProxy(f"localhost:{coord.port}")
+        resp = proxy.register_trustee("solo", "localhost:9", tgroup,
+                                      nonce=b"n1")
+        proxy.close()
+        assert resp.x_coordinate == 1 and not resp.error
+        assert coord.ready() == 1            # committed exactly once
+        assert ("server", "registerTrustee", 1,
+                "drop_response") in plan.injected
+    finally:
+        coord.shutdown(all_ok=True)
+
+
+# =====================================================================
+# key ceremony: trustee dies mid-ceremony, restarts from its resume file
+# =====================================================================
+
+
+def test_key_ceremony_survives_trustee_crash_restart(tgroup, tmp_path,
+                                                     monkeypatch):
+    """Acceptance (a): guardian-1's process dies right after it commits
+    (and checkpoints) its first received key share; a new process pointed
+    at the resume file re-binds the same port, re-registers with the same
+    nonce, restores the polynomial and received state — and the ceremony,
+    bridged by the coordinator's bounded retries, completes."""
+    monkeypatch.setenv("EGTPU_RPC_RETRIES", "8")
+    monkeypatch.setenv("EGTPU_RPC_RETRY_WAIT", "0.5")
+    monkeypatch.setenv("EGTPU_RPC_RETRY_CAP", "1.0")
+    monkeypatch.setenv("EGTPU_RPC_CONNECT_WINDOW", "1.0")
+    monkeypatch.setattr(rpc_util, "_uniform", lambda lo, hi: hi)
+
+    crashed = threading.Event()
+    victim: dict = {}
+
+    def crash(_method):
+        # the "process" dies: its server vanishes a beat after the
+        # response is dropped (so the client's failure is the clean
+        # injected UNAVAILABLE, as for a torn connection)
+        threading.Timer(0.1,
+                        lambda: victim["server"].server.stop(grace=0)
+                        ).start()
+        crashed.set()
+
+    # exchange round 3 starts with (sender=guardian-0, receiver=
+    # guardian-1): the 1st receiveSecretKeyShare served in this process
+    # is guardian-1's — a deterministic protocol point, not a timer
+    plan = faults.FaultPlan(rules=[faults.FaultRule(
+        method="receiveSecretKeyShare", kind="crash_after",
+        on_calls=(1,))])
+    plan.crash_cb = crash
+    faults.install(plan)
+
+    coord = KeyCeremonyCoordinator(tgroup, 3, 2, port=0)
+    resume = str(tmp_path / "guardian-1.resume")
+    servers = []
+    try:
+        for i in range(3):
+            servers.append(KeyCeremonyTrusteeServer(
+                tgroup, f"guardian-{i}", f"localhost:{coord.port}",
+                out_dir=str(tmp_path),
+                resume_file=resume if i == 1 else None))
+        victim["server"] = servers[1]
+        assert coord.wait_for_registrations(timeout=10)
+
+        box: dict = {}
+        th = threading.Thread(target=lambda: box.setdefault(
+            "res", coord.run_key_ceremony(str(tmp_path))))
+        th.start()
+        assert crashed.wait(timeout=30), "fault plan never fired"
+        assert os.path.exists(resume)
+        time.sleep(0.3)   # let the dead server release its port
+
+        # relaunch from the resume file (retry the bind: the old socket
+        # may take a beat to fully release)
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                servers[1] = KeyCeremonyTrusteeServer(
+                    tgroup, "guardian-1", f"localhost:{coord.port}",
+                    out_dir=str(tmp_path), resume_file=resume)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        assert servers[1].x_coordinate == 2   # reclaimed, not reassigned
+        # the checkpointed share survived the crash
+        assert "guardian-0" in servers[1].trustee.received_shares
+
+        th.join(timeout=120)
+        assert not th.is_alive(), "ceremony wedged after the restart"
+        results = box["res"]
+        assert not isinstance(results, Result), \
+            f"ceremony failed: {results.error}"
+        joint = tgroup.mult_p(*(s.trustee.election_public_key
+                                for s in servers))
+        assert results.joint_public_key == joint
+        for s in servers:
+            assert len(s.trustee.received_shares) == 2
+        assert ("server", "receiveSecretKeyShare", 1,
+                "crash_after") in plan.injected
+        for i in range(3):
+            assert (tmp_path / f"trustee-guardian-{i}.json").exists()
+    finally:
+        faults.clear()
+        coord.shutdown(all_ok=True)
+        for s in servers:
+            s.shutdown()
+
+
+# =====================================================================
+# decryption: trustee dies mid-run
+# =====================================================================
+
+
+@pytest.fixture(scope="module")
+def dec_election(tgroup):
+    """3-guardian/quorum-2 ceremony (in-process) + a small encrypted
+    tally: votes [3, 2] over 5 cast ballots."""
+    trustees = [KeyCeremonyTrustee(tgroup, f"guardian-{i}", i + 1, 2)
+                for i in range(3)]
+    results = key_ceremony_exchange(trustees, tgroup)
+    init = results.make_election_initialized(
+        ElectionConfig(tiny_manifest(), 3, 2))
+    votes = [3, 2]
+    cts = []
+    for v in votes:
+        acc = None
+        for j in range(5):
+            ct = elgamal_encrypt(tgroup, 1 if j < v else 0,
+                                 tgroup.rand_q(), init.joint_public_key)
+            acc = ct if acc is None else acc.mult(ct)
+        cts.append(acc)
+    tally = EncryptedTally("t", (EncryptedTallyContest(
+        "contest-0", 0, tuple(
+            EncryptedTallySelection(f"sel-{i}", i, ct)
+            for i, ct in enumerate(cts))),), cast_ballot_count=5)
+    return dict(init=init, votes=votes, tally=tally,
+                states=[t.decrypting_trustee_state() for t in trustees],
+                dlog=DLog(tgroup, max_exponent=10))
+
+
+def _spin_decryption(tgroup, dec_election):
+    coord = DecryptionCoordinator(tgroup, navailable=3, port=0)
+    servers = []
+    for i in range(3):
+        servers.append(DecryptingTrusteeServer(
+            tgroup,
+            DecryptingTrustee.from_state(tgroup,
+                                         dec_election["states"][i]),
+            f"localhost:{coord.port}"))
+    assert coord.wait_for_registrations(timeout=10)
+    coord.mark_started()
+    return coord, servers
+
+
+def test_decryption_demotes_dead_trustee_when_quorum_holds(
+        tgroup, dec_election, fastrpc):
+    """Acceptance (b) success half: guardian-0 dies mid-decryption (its
+    first directDecrypt commits, the response is lost, the process is
+    gone); it is demoted to the missing set and the tally completes with
+    compensated shares from the two survivors — quorum was all the
+    threshold scheme ever needed."""
+    victim: dict = {}
+    plan = faults.FaultPlan(rules=[faults.FaultRule(
+        method="directDecrypt", kind="crash_after", on_calls=(1,))])
+    plan.crash_cb = lambda _m: threading.Timer(
+        0.05, lambda: victim["server"].server.stop(grace=0)).start()
+    faults.install(plan)
+    coord, servers = _spin_decryption(tgroup, dec_election)
+    victim["server"] = servers[0]
+    try:
+        d = Decryption(tgroup, dec_election["init"], coord.proxies, [],
+                       dec_election["dlog"])
+        out = d.decrypt(dec_election["tally"])
+        got = [s.tally for s in out.contests[0].selections]
+        assert got == dec_election["votes"]
+        # guardian-0 was demoted and reconstructed, mid-run
+        assert d.missing == ["guardian-0"]
+        assert [t.id for t in d.trustees] == ["guardian-1", "guardian-2"]
+        for s in out.contests[0].selections:
+            by_id = {sh.guardian_id: sh for sh in s.shares}
+            assert set(by_id) == {"guardian-0", "guardian-1",
+                                  "guardian-2"}
+            # the reconstructed share carries its compensating parts
+            assert by_id["guardian-0"].proof is None
+            assert set(by_id["guardian-0"].recovered_parts) == \
+                {"guardian-1", "guardian-2"}
+        assert ("server", "directDecrypt", 1,
+                "crash_after") in plan.injected
+    finally:
+        faults.clear()
+        coord.shutdown(all_ok=True)
+        for s in servers:
+            s.shutdown()
+
+
+def test_decryption_fails_cleanly_below_quorum(tgroup, dec_election,
+                                               fastrpc):
+    """Acceptance (b) failure half: with two of three guardians dead the
+    survivors cannot meet quorum 2 — the run must fail with an explicit
+    quorum error after bounded retries, not hang or emit a bad tally."""
+    coord, servers = _spin_decryption(tgroup, dec_election)
+    try:
+        servers[0].server.stop(grace=0)
+        servers[1].server.stop(grace=0)
+        d = Decryption(tgroup, dec_election["init"], coord.proxies, [],
+                       dec_election["dlog"])
+        t0 = time.monotonic()
+        with pytest.raises(DecryptionError,
+                           match="no longer meet quorum"):
+            d.decrypt(dec_election["tally"])
+        # bounded: two demote rounds of fast retries, not a hang
+        assert time.monotonic() - t0 < 30
+    finally:
+        coord.shutdown(all_ok=True)
+        for s in servers:
+            s.shutdown()
+
+
+# =====================================================================
+# serving plane: write-ahead journal + crash recovery
+# =====================================================================
+
+
+def _ballots(n, seed=3):
+    return list(RandomBallotProvider(tiny_manifest(), n,
+                                     seed=seed).ballots())
+
+
+def test_journal_replay_tombstones_and_torn_tail(tmp_path):
+    path = str(tmp_path / wal.JOURNAL_NAME)
+    j = wal.AdmissionJournal(path)
+    ballots = _ballots(3)
+    j.append(ballots[0], False)
+    j.append(ballots[1], True)
+    j.append(ballots[2], False)
+    j.append_drop(ballots[1].ballot_id)   # rejected after journaling
+    j.close()
+    # a SIGKILL can tear the final line mid-append: that admission was
+    # never ack'd, so replay must ignore it — and only it
+    with open(path, "ab") as f:
+        f.write(b'{"id": "torn-ball')
+    entries = wal.replay(path)
+    assert [(e.ballot.ballot_id, e.spoil) for e in entries] == \
+        [(ballots[0].ballot_id, False), (ballots[2].ballot_id, False)]
+    # corruption anywhere BUT a torn tail is an error, not a skip
+    with open(path, "ab") as f:
+        f.write(b'\n{"id": "x", "spoil": false, "ballot": {}}\n')
+    with pytest.raises(IOError, match="corrupt journal line"):
+        wal.replay(path)
+    # reset truncates: an empty journal is the clean-shutdown marker
+    j2 = wal.AdmissionJournal(path)
+    j2.reset()
+    j2.close()
+    assert wal.replay(path) == []
+
+
+def test_repair_frame_stream_truncates_torn_tail(tmp_path):
+    from electionguard_tpu.publish.publisher import repair_frame_stream
+    import struct
+    path = str(tmp_path / "ballots.pb")
+    frames = [b"frame-one", b"frame-two-longer"]
+    with open(path, "wb") as f:
+        for fr in frames:
+            f.write(struct.pack(">I", len(fr)) + fr)
+        f.write(struct.pack(">I", 100) + b"torn")   # crash mid-frame
+    n, last = repair_frame_stream(path)
+    assert (n, last) == (2, frames[1])
+    assert os.path.getsize(path) == sum(4 + len(fr) for fr in frames)
+    n2, last2 = repair_frame_stream(path)           # idempotent
+    assert (n2, last2) == (2, frames[1])
+    assert repair_frame_stream(str(tmp_path / "absent.pb")) == (0, None)
+
+
+@pytest.fixture(scope="module")
+def chaos_init(tgroup):
+    from electionguard_tpu.keyceremony.exchange import \
+        key_ceremony_exchange
+    trustees = [KeyCeremonyTrustee(tgroup, f"guardian-{i}", i + 1, 2)
+                for i in range(3)]
+    return key_ceremony_exchange(trustees, tgroup) \
+        .make_election_initialized(ElectionConfig(tiny_manifest(), 3, 2),
+                                   {"created_by": "chaos-test"})
+
+
+_TS = 1754_000_000
+
+
+def test_service_crash_recovery_replays_exact_gap(chaos_init, tgroup,
+                                                  tmp_path):
+    """In-process crash: the worker wedges after 2 published ballots (the
+    EGTPU_CHAOS_HOLD_AFTER_BALLOTS hook), 3 more are admitted (journaled)
+    but never encrypted, the service "dies".  A restarted service must
+    re-encrypt exactly the 3-ballot gap, chain-contiguous, and the final
+    record must be bit-for-bit the offline encryptor's output."""
+    from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+    from electionguard_tpu.publish.election_record import ElectionRecord
+    from electionguard_tpu.publish.publisher import Consumer
+    from electionguard_tpu.serve.service import (EncryptionClient,
+                                                 EncryptionService)
+    from electionguard_tpu.verify.verifier import Verifier
+
+    out = str(tmp_path / "record")
+    ballots = _ballots(7)
+    svc = EncryptionService(chaos_init, tgroup, port=0, out_dir=out,
+                            max_batch=4, max_wait_ms=15, seed=tgroup.int_to_q(42),
+                            timestamp=_TS, prewarm=False, hold_after=2)
+    client = EncryptionClient(f"localhost:{svc.port}", tgroup)
+    first = [client.encrypt(b) for b in ballots[:2]]   # published
+    assert [e.ballot_id for e in first] == \
+        [b.ballot_id for b in ballots[:2]]
+    # worker is now wedged: these are admitted (fsync'd WAL) but will
+    # never be encrypted by THIS incarnation
+    for b in ballots[2:5]:
+        svc._admit(b, False)
+    # crash: the server vanishes, no drain, no journal reset
+    svc.server.stop(grace=0)
+    client.close()
+    assert len(wal.replay(os.path.join(out, wal.JOURNAL_NAME))) == 5
+
+    svc2 = EncryptionService(chaos_init, tgroup, port=0, out_dir=out,
+                             max_batch=4, max_wait_ms=15,
+                             seed=tgroup.int_to_q(42), timestamp=_TS,
+                             prewarm=False)
+    try:
+        assert svc2.recovered_ballots == 3
+        client2 = EncryptionClient(f"localhost:{svc2.port}", tgroup)
+        h = client2.health()
+        assert (h.status, h.ready, h.recovered_ballots) == \
+            ("SERVING", True, 3)
+        more = [client2.encrypt(b) for b in ballots[5:]]
+        assert len(more) == 2
+        client2.close()
+    finally:
+        svc2.drain()
+    # clean drain resolved everything: empty journal = clean marker
+    assert os.path.getsize(os.path.join(out, wal.JOURNAL_NAME)) == 0
+
+    cons = Consumer(out, tgroup)
+    record = ElectionRecord(cons.read_election_initialized())
+    record.encrypted_ballots = list(cons.iterate_encrypted_ballots())
+    # zero lost admitted ballots, in admission order
+    assert [b.ballot_id for b in record.encrypted_ballots] == \
+        [b.ballot_id for b in ballots]
+    res = Verifier(record, tgroup).verify()
+    assert res.ok, res.summary()
+    # bit-for-bit: one offline pass over the same ballots reproduces the
+    # crash-straddling record exactly — the recovery re-encrypted the
+    # gap on the SAME code chain the crashed service left behind
+    offline, invalid = BatchEncryptor(chaos_init, tgroup).encrypt_ballots(
+        ballots, seed=tgroup.int_to_q(42), timestamp=_TS)
+    assert not invalid
+    assert offline == record.encrypted_ballots
+
+
+def test_sigkill_service_restarts_from_journal(chaos_init, tgroup,
+                                               tmp_path):
+    """Acceptance (c), for real: the service subprocess is SIGKILL'd with
+    admitted-but-unpublished ballots in its (journaled) queue; the
+    restarted process replays the journal, reports the recovery over the
+    health rpc, keeps serving, and a SIGTERM drain publishes a verifiable
+    chain-contiguous record with zero lost admitted ballots."""
+    from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+    from electionguard_tpu.publish.election_record import ElectionRecord
+    from electionguard_tpu.publish.publisher import Consumer, Publisher
+    from electionguard_tpu.serve.service import EncryptionClient
+    from electionguard_tpu.verify.verifier import Verifier
+    from electionguard_tpu.workflow.run_command import RunCommand
+
+    indir = str(tmp_path / "init")
+    Publisher(indir).write_election_initialized(chaos_init)
+    out = str(tmp_path / "record")
+    port = rpc_util.find_free_port()
+    url = f"localhost:{port}"
+    ballots = _ballots(7)
+
+    def wait_serving(recovered, timeout=120):
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            c = EncryptionClient(url, tgroup)
+            try:
+                h = c.health(timeout=5)
+                last = (h.status, h.recovered_ballots)
+                if h.status == "SERVING" and \
+                        h.recovered_ballots == recovered:
+                    return
+            except grpc.RpcError:
+                pass
+            finally:
+                c.close()
+            time.sleep(0.5)
+        raise AssertionError(f"service never SERVING/{recovered}: {last}")
+
+    svc = RunCommand.python_module(
+        "encryption-service", "electionguard_tpu.cli.run_encryption_service",
+        ["-in", indir, "-out", out, "-port", str(port), "-maxBatch", "4",
+         "-maxWaitMs", "15", "-fixedNonces", "-timestamp", str(_TS),
+         "-noPrewarm", "-group", "tiny"],
+        str(tmp_path / "logs"),
+        env={"EGTPU_CHAOS_HOLD_AFTER_BALLOTS": "2"})
+    try:
+        wait_serving(recovered=0)
+        client = EncryptionClient(url, tgroup)
+        first = [client.encrypt(b, timeout=60) for b in ballots[:2]]
+        assert [e.ballot_id for e in first] == \
+            [b.ballot_id for b in ballots[:2]]
+        # the worker is wedged; these admissions journal, then hang —
+        # their client threads die with the SIGKILL'd connection
+        def submit_lost(b):
+            try:
+                client.encrypt(b, timeout=60)
+            except (grpc.RpcError, Exception):  # noqa: BLE001
+                pass
+        threads = [threading.Thread(target=submit_lost, args=(b,),
+                                    daemon=True) for b in ballots[2:5]]
+        for t in threads:
+            t.start()
+        jpath = os.path.join(out, wal.JOURNAL_NAME)
+        deadline = time.monotonic() + 60
+        while len(wal.replay(jpath)) < 5:
+            assert time.monotonic() < deadline, "admissions never journaled"
+            time.sleep(0.2)
+
+        svc.kill_hard()          # SIGKILL: no handlers, no drain
+        client.close()
+        svc._env.pop("EGTPU_CHAOS_HOLD_AFTER_BALLOTS")
+        svc.restart()
+        wait_serving(recovered=3)
+
+        client2 = EncryptionClient(url, tgroup)
+        more = [client2.encrypt(b, timeout=60) for b in ballots[5:]]
+        assert len(more) == 2
+        client2.close()
+
+        svc.process.terminate()  # SIGTERM: graceful drain + publish
+        assert svc.wait_for(60) == 0, "drain did not exit cleanly"
+    finally:
+        svc.kill()
+
+    assert os.path.getsize(os.path.join(out, wal.JOURNAL_NAME)) == 0
+    cons = Consumer(out, tgroup)
+    record = ElectionRecord(cons.read_election_initialized())
+    record.encrypted_ballots = list(cons.iterate_encrypted_ballots())
+    got_ids = [b.ballot_id for b in record.encrypted_ballots]
+    # zero lost admitted ballots, the pre-crash prefix in order
+    assert sorted(got_ids) == sorted(b.ballot_id for b in ballots)
+    assert got_ids[:2] == [b.ballot_id for b in ballots[:2]]
+    res = Verifier(record, tgroup).verify()
+    assert res.ok, res.summary()
+    # bit-for-bit: the offline encryptor over the record's admission
+    # order reproduces ciphertexts and codes across BOTH crash boundaries
+    by_id = {b.ballot_id: b for b in ballots}
+    offline, invalid = BatchEncryptor(chaos_init, tgroup).encrypt_ballots(
+        [by_id[i] for i in got_ids], seed=tgroup.int_to_q(42),
+        timestamp=_TS)
+    assert not invalid
+    assert offline == record.encrypted_ballots

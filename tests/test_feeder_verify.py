@@ -113,6 +113,7 @@ def _run_cli(record_dir, feeders):
 
 
 @pytest.mark.slow
+@pytest.mark.e2e
 def test_cli_feeders_pass_and_reject_tamper(record_dir, election):
     proc = _run_cli(record_dir, 2)
     assert proc.returncode == 0, proc.stdout + proc.stderr

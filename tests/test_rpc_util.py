@@ -1,0 +1,170 @@
+"""Stub.call retry-machinery edge cases, exercised directly (they were
+previously covered only implicitly through the e2e suites): full-jitter
+exponential backoff, deadline exhaustion mid-backoff, the bounded
+connect-window DEADLINE reclassification, the retry budget, and the
+EGTPU_RPC_RETRIES=1 reference posture.
+"""
+
+import time
+
+import grpc
+import pytest
+
+from electionguard_tpu.publish import pb
+from electionguard_tpu.remote import rpc_util
+
+
+def _dead_stub():
+    """A stub dialing a port nothing listens on (fails UNAVAILABLE)."""
+    port = rpc_util.find_free_port()
+    channel = rpc_util.make_channel(f"localhost:{port}",
+                                    rpc_util.MAX_REGISTRATION_MESSAGE)
+    return rpc_util.Stub(channel, "RemoteKeyCeremonyService"), channel
+
+
+def _req():
+    return pb.msg("RegisterKeyCeremonyTrusteeRequest")(
+        guardian_id="x", remote_url="localhost:1")
+
+
+@pytest.fixture()
+def sleeps(monkeypatch):
+    """Record backoff sleeps instead of sleeping; pin jitter to its
+    upper bound so waits are deterministic."""
+    rec = {"sleeps": [], "uniform": []}
+
+    def fake_sleep(s):
+        rec["sleeps"].append(round(s, 6))
+
+    def fake_uniform(lo, hi):
+        rec["uniform"].append((lo, round(hi, 6)))
+        return hi
+
+    monkeypatch.setattr(rpc_util, "_sleep", fake_sleep)
+    monkeypatch.setattr(rpc_util, "_uniform", fake_uniform)
+    return rec
+
+
+def test_full_jitter_exponential_backoff(sleeps):
+    """Waits double from base to cap, drawn from U(0, bound) — not the
+    old synchronized-herd linear ladder."""
+    pol = rpc_util.RetryPolicy(attempts=4, base_wait=0.1, max_wait=0.3,
+                               connect_window=0.05, budget=100.0)
+    stub, channel = _dead_stub()
+    try:
+        with pytest.raises(grpc.RpcError):
+            stub.call("registerTrustee", _req(), timeout=30, policy=pol)
+    finally:
+        channel.close()
+    # 4 attempts -> 3 backoffs; bounds 0.1, 0.2, then capped at 0.3
+    assert sleeps["sleeps"] == [0.1, 0.2, 0.3]
+    # every draw was full-jitter: U(0, bound)
+    assert [u for u in sleeps["uniform"]] == [(0.0, 0.1), (0.0, 0.2),
+                                              (0.0, 0.3)]
+
+
+def test_deadline_exhaustion_mid_backoff(sleeps):
+    """A backoff wait that would overshoot the caller's total deadline is
+    not slept: the call raises immediately with the real error."""
+    pol = rpc_util.RetryPolicy(attempts=10, base_wait=5.0, max_wait=60.0,
+                               connect_window=0.05, budget=1000.0)
+    stub, channel = _dead_stub()
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(grpc.RpcError):
+            stub.call("registerTrustee", _req(), timeout=1.5, policy=pol)
+    finally:
+        channel.close()
+    assert sleeps["sleeps"] == []          # never slept into the deadline
+    assert time.monotonic() - t0 < 1.4     # and never blocked out to it
+
+
+def test_retry_budget_bounds_total_backoff(sleeps):
+    """Once the Stub's cumulative backoff reaches the budget, the next
+    transient failure is raised instead of retried."""
+    pol = rpc_util.RetryPolicy(attempts=10, base_wait=0.1, max_wait=10.0,
+                               connect_window=0.05, budget=0.15)
+    stub, channel = _dead_stub()
+    try:
+        with pytest.raises(grpc.RpcError):
+            stub.call("registerTrustee", _req(), timeout=30, policy=pol)
+    finally:
+        channel.close()
+    # first backoff (0.1) fits the 0.15 budget; the second (0.2) does not
+    assert sleeps["sleeps"] == [0.1]
+
+
+def test_connect_window_deadline_is_transient():
+    """DEADLINE_EXCEEDED expiring a BOUNDED wait_for_ready window means
+    "peer still unreachable" (transient); expiring the caller's own full
+    budget means a real timeout (fatal)."""
+    D = grpc.StatusCode.DEADLINE_EXCEEDED
+    assert rpc_util._is_transient(grpc.StatusCode.UNAVAILABLE,
+                                  wfr=False, per_try=5, remaining=60)
+    assert rpc_util._is_transient(D, wfr=True, per_try=5, remaining=60)
+    assert not rpc_util._is_transient(D, wfr=True, per_try=60,
+                                      remaining=60)  # full-budget wait
+    assert not rpc_util._is_transient(D, wfr=False, per_try=60,
+                                      remaining=60)  # first attempt
+
+
+def test_connect_window_bounds_each_retry(sleeps):
+    """wait_for_ready retries block at most connect_window each, so a
+    permanently-dead peer exhausts attempts in seconds — well inside a
+    long caller deadline."""
+    pol = rpc_util.RetryPolicy(attempts=3, base_wait=0.01, max_wait=0.01,
+                               connect_window=0.3, budget=100.0)
+    stub, channel = _dead_stub()
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(grpc.RpcError):
+            stub.call("registerTrustee", _req(), timeout=60, policy=pol)
+    finally:
+        channel.close()
+    elapsed = time.monotonic() - t0
+    # 2 bounded wfr waits (~0.3 s each) + fail-fast first attempt: the
+    # 60 s deadline was never consumed
+    assert elapsed < 5.0
+    assert len(sleeps["sleeps"]) == 2
+
+
+def test_retries_1_restores_reference_posture(sleeps, monkeypatch):
+    """EGTPU_RPC_RETRIES=1 = the reference's no-retry behavior: one
+    attempt, no backoff, immediate failure."""
+    monkeypatch.setenv("EGTPU_RPC_RETRIES", "1")
+    assert rpc_util.retry_policy().attempts == 1
+    stub, channel = _dead_stub()
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(grpc.RpcError):
+            stub.call("registerTrustee", _req(), timeout=20)
+    finally:
+        channel.close()
+    assert sleeps["sleeps"] == []
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_deadline_classes_env_tunable(monkeypatch):
+    """Registration/control rpcs default short, data plane long; every
+    class is an env knob."""
+    assert rpc_util.deadline_for("registerTrustee") == 30.0
+    assert rpc_util.deadline_for("finish") == 30.0
+    assert rpc_util.deadline_for("sendPublicKeys") == 120.0
+    assert rpc_util.deadline_for("directDecrypt") == 600.0
+    assert rpc_util.deadline_for("encryptBallotBatch") == 600.0
+    monkeypatch.setenv("EGTPU_RPC_TIMEOUT_DATA", "42.5")
+    assert rpc_util.deadline_for("directDecrypt") == 42.5
+
+
+def test_env_policy_parsing(monkeypatch):
+    monkeypatch.setenv("EGTPU_RPC_RETRIES", "7")
+    monkeypatch.setenv("EGTPU_RPC_RETRY_WAIT", "0.25")
+    monkeypatch.setenv("EGTPU_RPC_RETRY_CAP", "4")
+    monkeypatch.setenv("EGTPU_RPC_CONNECT_WINDOW", "2")
+    monkeypatch.setenv("EGTPU_RPC_RETRY_BUDGET", "33")
+    pol = rpc_util.retry_policy()
+    assert (pol.attempts, pol.base_wait, pol.max_wait,
+            pol.connect_window, pol.budget) == (7, 0.25, 4.0, 2.0, 33.0)
+    # malformed values degrade to defaults instead of crashing a trustee
+    monkeypatch.setenv("EGTPU_RPC_RETRIES", "not-a-number")
+    assert rpc_util.retry_policy().attempts == 3
