@@ -342,6 +342,8 @@ def run_workload(nballots: int, n_chips: int) -> None:
         ElectionConfig(manifest, 1, 1), {"created_by": "bench"})
     seed = g.int_to_q(42)
 
+    from electionguard_tpu.obs import trace as obs_trace
+
     def pipeline(bs, tag):
         # fresh encryptor per record: ballot ids repeat between the warm
         # and full passes, and one encryptor rejects repeated ids (its
@@ -356,27 +358,36 @@ def run_workload(nballots: int, n_chips: int) -> None:
                 RESULT.update(extra)
             flush_partial()
 
+        # per-phase spans (EGTPU_OBS_TRACE): compile time inside a phase
+        # is attributed to it by the obs.jaxmon listener, so the span
+        # artifact separates host orchestration / device compile /
+        # device execute per bench phase
         enc = BatchEncryptor(init, g, mesh=mesh)
         t0 = time.time()
-        encrypted, invalid = retry(
-            f"{tag}-encrypt", lambda: enc.encrypt_ballots(bs, seed=seed))
+        with obs_trace.span(f"bench.encrypt.{tag}", {"n": len(bs)}):
+            encrypted, invalid = retry(
+                f"{tag}-encrypt",
+                lambda: enc.encrypt_ballots(bs, seed=seed))
         dt_enc = time.time() - t0
         assert not invalid and len(encrypted) == len(bs)
         done("encrypt", encrypt_per_s=round(len(bs) / dt_enc, 1))
         t0 = time.time()
-        tally_result = retry(
-            f"{tag}-tally", lambda: accumulate_ballots(init, encrypted))
+        with obs_trace.span(f"bench.tally.{tag}"):
+            tally_result = retry(
+                f"{tag}-tally", lambda: accumulate_ballots(init, encrypted))
         done("tally", tally_s=round(time.time() - t0, 3))
         record = ElectionRecord(election_init=init,
                                 encrypted_ballots=encrypted,
                                 tally_result=tally_result)
         # warmup pass compiles every kernel at the measured shapes
-        res = retry(f"{tag}-verify-warm",
-                    lambda: Verifier(record, g, mesh=mesh).verify())
+        with obs_trace.span(f"bench.verify-warm.{tag}"):
+            res = retry(f"{tag}-verify-warm",
+                        lambda: Verifier(record, g, mesh=mesh).verify())
         assert res.ok, res.summary()
         done("verify_warm")
         t0 = time.time()
-        with maybe_profile(f"bench-verify-{tag}"):
+        with maybe_profile(f"bench-verify-{tag}"), \
+                obs_trace.span(f"bench.verify.{tag}", {"n": len(bs)}):
             res = retry(f"{tag}-verify",
                         lambda: Verifier(record, g, mesh=mesh).verify())
         dt_ver = time.time() - t0
@@ -502,6 +513,11 @@ def main() -> int:
         RESULT["compile_cache_entries_start"] = len(os.listdir(cache_dir))
     except OSError:
         pass
+
+    # span artifacts per phase when EGTPU_OBS_TRACE is set (plus the
+    # Prometheus endpoint / JSONL log mirror on their own env vars)
+    from electionguard_tpu import obs
+    obs.init_from_env()
 
     import jax
     n_chips = max(1, len(jax.devices()))

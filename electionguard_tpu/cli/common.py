@@ -24,7 +24,16 @@ def setup_logging(name: str) -> logging.Logger:
         level=os.environ.get("EGTPU_LOG", "INFO"),
         format="%(asctime)s [%(levelname)s] %(name)s: %(message)s",
         stream=sys.stdout)
-    return logging.getLogger(name)
+    log = logging.getLogger(name)
+    # one hook lights up the whole observability surface in every binary:
+    # EGTPU_OBS_TRACE (spans), EGTPU_OBS_HTTP (Prometheus endpoint),
+    # EGTPU_OBS_LOG (structured JSONL mirror) — all off by default
+    from electionguard_tpu import obs
+    info = obs.init_from_env()
+    if info:
+        log.info("observability: %s", " ".join(
+            f"{k}={v}" for k, v in sorted(info.items())))
+    return log
 
 
 def add_group_flag(ap: argparse.ArgumentParser):

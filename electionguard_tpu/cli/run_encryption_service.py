@@ -57,6 +57,10 @@ def main(argv=None) -> int:
                          "encryption time)")
     ap.add_argument("-noPrewarm", dest="no_prewarm", action="store_true",
                     help="skip the per-bucket compile prewarm at startup")
+    ap.add_argument("-metricsPort", dest="metrics_port", type=int,
+                    default=None,
+                    help="serve Prometheus text metrics on this HTTP "
+                         "port (0 = ephemeral; also via EGTPU_OBS_HTTP)")
     add_group_flag(ap)
     args = ap.parse_args(argv)
 
@@ -80,9 +84,13 @@ def main(argv=None) -> int:
             max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
             max_queue=args.max_queue, seed=seed,
             timestamp=args.timestamp,
-            prewarm=not args.no_prewarm, hold_after=hold_after)
+            prewarm=not args.no_prewarm, hold_after=hold_after,
+            metrics_http_port=args.metrics_port)
         log.info("serving on port %d (startup took %.2fs)", service.port,
                  sw.elapsed())
+        if service.metrics_http_port is not None:
+            log.info("prometheus metrics on http://127.0.0.1:%d/metrics",
+                     service.metrics_http_port)
 
         stop = threading.Event()
 

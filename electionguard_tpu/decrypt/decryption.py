@@ -150,11 +150,17 @@ class Decryption:
         survivors still meet quorum.  Shares already gathered from the
         failed attempt are discarded; the recompute is a fresh protocol
         round, so the published shares are always one consistent set."""
-        while True:
-            try:
-                return self._decrypt_batch_once(texts)
-            except TrusteeFailure as e:
-                self._demote(e.trustee_id, e.reason)
+        from electionguard_tpu.obs import trace
+        attrs = ({"n_texts": len(texts), "n_trustees": len(self.trustees),
+                  "n_missing": len(self.missing)}
+                 if trace.enabled() else None)
+        with trace.span("decrypt.batch", attrs) as sp:
+            while True:
+                try:
+                    return self._decrypt_batch_once(texts)
+                except TrusteeFailure as e:
+                    sp.set("demoted", e.trustee_id)
+                    self._demote(e.trustee_id, e.reason)
 
     def _decrypt_batch_once(
             self, texts: list[ElGamalCiphertext]

@@ -91,6 +91,22 @@ class BatchEncryptor:
             spoiled_ids: Optional[set] = None,
             timestamp: Optional[int] = None,
     ) -> tuple[list[EncryptedBallot], list[tuple[PlaintextBallot, str]]]:
+        from electionguard_tpu.obs import trace
+        attrs = {"n": len(ballots)} if trace.enabled() else None
+        with trace.span("encrypt.batch", attrs):
+            return self._encrypt_ballots(
+                ballots, seed=seed, code_seed=code_seed,
+                ballot_index_base=ballot_index_base,
+                spoiled_ids=spoiled_ids, timestamp=timestamp)
+
+    def _encrypt_ballots(
+            self, ballots: Sequence[PlaintextBallot],
+            seed: Optional[ElementModQ] = None,
+            code_seed: Optional[bytes] = None,
+            ballot_index_base: int = 0,
+            spoiled_ids: Optional[set] = None,
+            timestamp: Optional[int] = None,
+    ) -> tuple[list[EncryptedBallot], list[tuple[PlaintextBallot, str]]]:
         """Encrypt a batch.  Returns (encrypted, invalid) where invalid is
         [(ballot, reason)] — mirroring batchEncryption's invalidDir.
 

@@ -76,6 +76,15 @@ def key_ceremony_exchange(
         trustees: Sequence[KeyCeremonyTrusteeIF],
         group: GroupContext) -> Union[KeyCeremonyResults, Result]:
     """Run the full pairwise ceremony; returns results or an Err Result."""
+    from electionguard_tpu.obs import trace
+    attrs = {"n_trustees": len(trustees)} if trace.enabled() else None
+    with trace.span("keyceremony.exchange", attrs):
+        return _key_ceremony_exchange(trustees, group)
+
+
+def _key_ceremony_exchange(
+        trustees: Sequence[KeyCeremonyTrusteeIF],
+        group: GroupContext) -> Union[KeyCeremonyResults, Result]:
     if len({t.id for t in trustees}) != len(trustees):
         return Result.Err("duplicate trustee ids")
     if len({t.x_coordinate for t in trustees}) != len(trustees):

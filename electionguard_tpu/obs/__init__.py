@@ -1,0 +1,47 @@
+"""Observability: metrics registry, distributed tracing, structured logs.
+
+The one import surface for the rest of the codebase::
+
+    from electionguard_tpu import obs
+    obs.init_from_env()           # CLI startup (cli/common.setup_logging)
+    with obs.span("phase.encrypt", {"n": n}): ...
+    obs.REGISTRY.counter("things_total").inc()
+
+Env vars (all off by default; see README "Observability"):
+
+* ``EGTPU_OBS_TRACE=<dir>``   — export spans as JSONL under <dir>
+* ``EGTPU_OBS_TRACE_ID=<hex>``— join an existing trace (set by e2e)
+* ``EGTPU_OBS_PARENT_SPAN=<id>`` — parent of this process's root span
+* ``EGTPU_OBS_PROC=<name>``   — process name in spans/logs
+* ``EGTPU_OBS_HTTP=<port>``   — Prometheus /metrics endpoint (0=ephemeral)
+* ``EGTPU_OBS_LOG=<dir>``     — JSONL log mirror (defaults to trace dir)
+"""
+
+from __future__ import annotations
+
+from electionguard_tpu.obs.registry import (REGISTRY,  # noqa: F401
+                                            MetricsRegistry, expose,
+                                            merged_snapshot,
+                                            merged_to_proto,
+                                            prometheus_text_all)
+from electionguard_tpu.obs.trace import (enable_from_env,  # noqa: F401
+                                         enabled, span)
+
+
+def init_from_env() -> dict:
+    """Light up every env-selected obs surface (idempotent); called once
+    per process from ``cli/common.setup_logging``.  Returns what was
+    enabled, for the caller's startup log line."""
+    from electionguard_tpu.obs import httpd, jaxmon, slog, trace
+    info: dict = {}
+    if trace.enable_from_env():
+        info["trace_dir"] = trace._dir
+        info["trace_id"] = trace.trace_id()
+        jaxmon.install()   # compile spans need the listener
+    handler = slog.install_from_env()
+    if handler is not None:
+        info["log"] = handler.path
+    port = httpd.maybe_start_from_env()
+    if port is not None:
+        info["metrics_port"] = port
+    return info

@@ -1,0 +1,108 @@
+"""Merge per-process span JSONL files into one Chrome-trace timeline.
+
+Every traced process exports ``spans-<proc>-<pid>.jsonl`` into the
+shared trace dir (obs.trace).  This module loads them all, validates the
+cross-process structure (one trace id, resolvable parent links, every
+span inside its process-root envelope) and emits Chrome-trace JSON —
+``{"traceEvents": [...]}`` — which Perfetto (ui.perfetto.dev) and
+``chrome://tracing`` open directly: one track per process, rpc
+client/server pairs linked by parent ids across tracks.
+
+``tools/assemble_trace.py`` is the CLI wrapper; ``workflow/e2e.py``
+calls ``merge_dir`` after a traced run.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+#: root-envelope slack (us): a retroactive device.compile event can start
+#: marginally before the exporting process's root span opened
+_SLACK_US = 2_000_000
+
+
+def load_spans(trace_dir: str) -> list[dict]:
+    spans: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(trace_dir, "spans-*.jsonl"))):
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    spans.append(json.loads(line))
+                except ValueError as e:
+                    raise ValueError(
+                        f"{path}:{lineno}: malformed span line: {e}")
+    return spans
+
+
+def validate(spans: list[dict]) -> dict:
+    """Structural report over a merged span set.  A clean single-run
+    trace has exactly one trace id, no orphan parents, and every span
+    inside its process's root envelope (``gaps`` empty)."""
+    ids = {s["span_id"] for s in spans}
+    trace_ids = sorted({s["trace_id"] for s in spans})
+    procs = sorted({(s["proc"], s["pid"]) for s in spans})
+    orphans = [s["span_id"] for s in spans
+               if s["parent_id"] and s["parent_id"] not in ids]
+    roots = {s["pid"]: s for s in spans if s["name"] == "process"}
+    gaps = []
+    for s in spans:
+        root = roots.get(s["pid"])
+        if root is None:
+            gaps.append({"span": s["span_id"], "why": "no process root"})
+        elif s is not root and not (
+                root["ts"] - _SLACK_US <= s["ts"]
+                and s["ts"] + s["dur"]
+                <= root["ts"] + root["dur"] + _SLACK_US):
+            gaps.append({"span": s["span_id"], "name": s["name"],
+                         "why": "outside process root envelope"})
+    by_id = {s["span_id"]: s for s in spans}
+    rpc_pairs = unpaired = 0
+    for s in spans:
+        if s["name"].startswith("rpc.server."):
+            parent = by_id.get(s["parent_id"])
+            if parent is not None and parent["name"] == \
+                    "rpc.client." + s["name"][len("rpc.server."):]:
+                rpc_pairs += 1
+            else:
+                unpaired += 1
+    return {"n_spans": len(spans), "trace_ids": trace_ids,
+            "processes": [f"{p}:{pid}" for p, pid in procs],
+            "orphans": orphans, "gaps": gaps,
+            "rpc_pairs": rpc_pairs, "rpc_server_unpaired": unpaired}
+
+
+def chrome_trace(spans: list[dict]) -> dict:
+    """Chrome-trace JSON: per-process named tracks, one complete ("X")
+    event per span, parent/trace ids preserved under ``args``."""
+    events: list[dict] = []
+    named: set[int] = set()
+    for s in sorted(spans, key=lambda s: s["ts"]):
+        if s["pid"] not in named:
+            named.add(s["pid"])
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": s["pid"], "tid": 0,
+                           "args": {"name": f"{s['proc']} ({s['pid']})"}})
+        args = {"trace_id": s["trace_id"], "span_id": s["span_id"],
+                "parent_id": s["parent_id"]}
+        args.update(s.get("attrs") or {})
+        events.append({"ph": "X", "name": s["name"], "cat": "egtpu",
+                       "ts": s["ts"], "dur": max(s["dur"], 1),
+                       "pid": s["pid"], "tid": s.get("tid", 0),
+                       "args": args})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def merge_dir(trace_dir: str, out_path: str) -> dict:
+    """Load + validate + write the merged Chrome trace; returns the
+    validation report (with ``out`` added)."""
+    spans = load_spans(trace_dir)
+    report = validate(spans)
+    with open(out_path, "w") as f:
+        json.dump(chrome_trace(spans), f)
+    report["out"] = out_path
+    return report

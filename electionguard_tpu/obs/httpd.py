@@ -1,0 +1,80 @@
+"""Prometheus text exposition endpoint.
+
+A tiny threaded HTTP server serving ``/metrics`` (the merged exposition
+of the default registry plus every ``expose()``d one — see
+``obs.registry``) and ``/healthz``.  One per process; port 0 binds an
+ephemeral port (the bound port is returned and logged, so multi-process
+runs on one host never collide).
+
+Enable per process with ``EGTPU_OBS_HTTP=<port>`` (``obs.init_from_env``)
+or programmatically with ``start()``; then::
+
+    curl -s localhost:<port>/metrics
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from electionguard_tpu.obs import registry
+
+log = logging.getLogger("egtpu.obs.httpd")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 — http.server API
+        if self.path.split("?", 1)[0] == "/metrics":
+            body = registry.prometheus_text_all().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif self.path.split("?", 1)[0] == "/healthz":
+            body, ctype = b"ok\n", "text/plain"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # scrapes are not stdout events
+        log.debug("http %s", fmt % args)
+
+
+def start(port: int = 0,
+          addr: str = "127.0.0.1") -> tuple[ThreadingHTTPServer, int]:
+    """Serve /metrics on ``addr:port`` (0 = ephemeral) from a daemon
+    thread; returns (server, bound_port)."""
+    server = ThreadingHTTPServer((addr, port), _Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="obs-metrics-http")
+    t.start()
+    bound = server.server_address[1]
+    log.info("metrics endpoint on http://%s:%d/metrics", addr, bound)
+    return server, bound
+
+
+_started: Optional[tuple[ThreadingHTTPServer, int]] = None
+_start_lock = threading.Lock()
+
+
+def maybe_start_from_env() -> Optional[int]:
+    """Start the endpoint when ``EGTPU_OBS_HTTP=<port>`` is set
+    (idempotent); returns the bound port or None."""
+    global _started
+    spec = os.environ.get("EGTPU_OBS_HTTP", "")
+    if not spec:
+        return None
+    with _start_lock:
+        if _started is None:
+            try:
+                _started = start(int(spec))
+            except (ValueError, OSError) as e:
+                log.warning("EGTPU_OBS_HTTP=%r: endpoint not started: %s",
+                            spec, e)
+                return None
+        return _started[1]

@@ -1,0 +1,58 @@
+"""Device-time attribution via ``jax.monitoring``.
+
+One process-wide listener counts every backend compilation into the
+default registry (``jax_backend_compiles_total`` plus cumulative
+``jax_backend_compile_seconds_total``) and — when tracing is on —
+exports a retroactive ``device.compile`` span parented to whatever span
+was active on the compiling thread, so a compile that lands inside a
+``worker.batch`` or bench-phase span is attributed to that batch.
+
+This is the live twin of the persistent-compile-cache-dir accounting
+bench.py does: it sees *every* compile on every platform, not only the
+ones above the persist threshold.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from electionguard_tpu.obs import trace
+from electionguard_tpu.obs.registry import REGISTRY
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_installed = False
+_count = 0
+
+
+def _on_event_duration(event: str, duration: float, **kw) -> None:
+    global _count
+    if event != _COMPILE_EVENT:
+        return
+    with _lock:
+        _count += 1
+    REGISTRY.counter("jax_backend_compiles_total").inc()
+    REGISTRY.counter("jax_backend_compile_seconds_total").inc(duration)
+    if trace.enabled():
+        dur_us = int(duration * 1e6)
+        trace.export_event("device.compile", trace._now_us() - dur_us,
+                           dur_us)
+
+
+def install() -> None:
+    """Idempotently hook jax.monitoring so every backend compile in this
+    process is counted (and traced, when tracing is on)."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+    import jax.monitoring
+    jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+
+
+def compile_count() -> int:
+    """Backend compiles observed in this process since install()."""
+    with _lock:
+        return _count

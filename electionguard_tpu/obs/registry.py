@@ -1,0 +1,327 @@
+"""Process-wide metrics registry: counters, gauges, fixed-bucket histograms.
+
+One registry instance is one namespace of named (optionally labeled)
+metrics.  The module-level ``REGISTRY`` is the process default — rpc
+plumbing (client retries, server call counts) and the jax compile
+listener write there; subsystems that need isolated counting (one
+``ServiceMetrics`` per serving instance, tests) create their own
+``MetricsRegistry`` and register it for exposition with ``expose()``.
+
+Three read paths, all built on ``snapshot()`` (a plain JSON-able dict):
+
+* ``prometheus_text()`` / ``prometheus_text_all()`` — Prometheus text
+  exposition (served by ``obs.httpd``);
+* ``to_proto()`` / ``merged_to_proto()`` — the ``metrics`` rpc's
+  ``MetricsResponse`` (every gRPC server answers it, see
+  ``rpc_util.generic_service``);
+* ``MetricsRegistry.merge(snapshots)`` — cross-process aggregation:
+  counters and histogram buckets sum, gauges sum (they are point-in-time
+  per process; a merged gauge reads as the fleet total).
+
+Everything is lock-protected and cheap enough for per-request updates.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import weakref
+from typing import Callable, Optional, Sequence
+
+#: shared default bucket edges (ms): log-ish spacing from sub-ms to minutes
+LATENCY_MS_BOUNDS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                     500.0, 1000.0, 2500.0, 5000.0, 15000.0, 60000.0)
+
+
+def flat_name(name: str, labels: Optional[dict] = None) -> str:
+    """Prometheus-style series name: ``name{k="v",...}`` (keys sorted)."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotonic-by-convention numeric metric (float increments allowed —
+    e.g. cumulative backoff seconds; negative ``inc`` is permitted for
+    the rare admit-then-unadmit correction the serving plane does)."""
+
+    __slots__ = ("name", "labels", "_v", "_lock")
+
+    def __init__(self, name: str, labels: Optional[dict] = None):
+        self.name = name
+        self.labels = dict(labels) if labels else None
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, by=1) -> None:
+        with self._lock:
+            self._v += by
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._v
+
+
+class Gauge:
+    """Point-in-time value: either ``set()`` explicitly or backed by a
+    zero-arg callback read at snapshot time."""
+
+    __slots__ = ("name", "labels", "_v", "_fn", "_lock")
+
+    def __init__(self, name: str, labels: Optional[dict] = None,
+                 fn: Optional[Callable[[], float]] = None):
+        self.name = name
+        self.labels = dict(labels) if labels else None
+        self._v = 0
+        self._fn = fn
+        self._lock = threading.Lock()
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._v = v
+
+    @property
+    def value(self):
+        if self._fn is not None:
+            try:
+                return self._fn()
+            except Exception:  # noqa: BLE001 — a dead callback reads 0
+                return 0
+        with self._lock:
+            return self._v
+
+
+class Histogram:
+    """Fixed-bound histogram: counts[i] observations ≤ bounds[i], last
+    bucket is overflow.  Snapshot-able without stopping writers."""
+
+    __slots__ = ("name", "labels", "bounds", "_counts", "_sum", "_n",
+                 "_lock")
+
+    def __init__(self, name: str, bounds: Sequence[float],
+                 labels: Optional[dict] = None):
+        self.name = name
+        self.labels = dict(labels) if labels else None
+        self.bounds = tuple(float(b) for b in bounds)
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        i = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._n += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(name=self.name, bounds=list(self.bounds),
+                        counts=list(self._counts), sum=self._sum,
+                        count=self._n)
+
+    def mean(self) -> float:
+        with self._lock:
+            return self._sum / self._n if self._n else 0.0
+
+    def quantile(self, q: float) -> float:
+        """Upper bucket-bound estimate of the q-quantile (q in [0,1])."""
+        with self._lock:
+            n, counts = self._n, list(self._counts)
+        if n == 0:
+            return 0.0
+        target = q * n
+        seen = 0
+        for i, c in enumerate(counts):
+            seen += c
+            if seen >= target:
+                return (self.bounds[i] if i < len(self.bounds)
+                        else self.bounds[-1])
+        return self.bounds[-1]
+
+
+class MetricsRegistry:
+    """Get-or-create namespace of metrics; the same (name, labels) always
+    returns the same object, so call sites never cache by hand unless
+    they are on a hot path."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    # ---- get-or-create ----------------------------------------------
+    def counter(self, name: str, labels: Optional[dict] = None) -> Counter:
+        key = flat_name(name, labels)
+        with self._lock:
+            c = self._counters.get(key)
+            if c is None:
+                c = self._counters[key] = Counter(name, labels)
+            return c
+
+    def gauge(self, name: str, labels: Optional[dict] = None,
+              fn: Optional[Callable[[], float]] = None) -> Gauge:
+        key = flat_name(name, labels)
+        with self._lock:
+            g = self._gauges.get(key)
+            if g is None:
+                g = self._gauges[key] = Gauge(name, labels, fn=fn)
+            elif fn is not None:
+                g._fn = fn
+            return g
+
+    def histogram(self, name: str,
+                  bounds: Sequence[float] = LATENCY_MS_BOUNDS,
+                  labels: Optional[dict] = None) -> Histogram:
+        key = flat_name(name, labels)
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                h = self._histograms[key] = Histogram(name, bounds, labels)
+            return h
+
+    # ---- read paths --------------------------------------------------
+    def snapshot(self) -> dict:
+        """Plain JSON-able view: {"counters": {flat: v}, "gauges": ...,
+        "histograms": {flat: {bounds, counts, sum, count}}}."""
+        with self._lock:
+            counters = list(self._counters.items())
+            gauges = list(self._gauges.items())
+            hists = list(self._histograms.items())
+        return {
+            "counters": {k: c.value for k, c in counters},
+            "gauges": {k: g.value for k, g in gauges},
+            "histograms": {k: h.snapshot() for k, h in hists},
+        }
+
+    @staticmethod
+    def merge(snapshots: Sequence[dict]) -> dict:
+        """Merge per-process ``snapshot()`` dicts: counters and gauges
+        sum; histograms sum bucket-wise (first-seen bounds win — a
+        mismatched-bounds series is summed on count/sum only)."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for snap in snapshots:
+            for k, v in snap.get("counters", {}).items():
+                out["counters"][k] = out["counters"].get(k, 0) + v
+            for k, v in snap.get("gauges", {}).items():
+                out["gauges"][k] = out["gauges"].get(k, 0) + v
+            for k, h in snap.get("histograms", {}).items():
+                acc = out["histograms"].get(k)
+                if acc is None:
+                    out["histograms"][k] = {
+                        "name": h.get("name", k),
+                        "bounds": list(h["bounds"]),
+                        "counts": list(h["counts"]),
+                        "sum": h["sum"], "count": h["count"]}
+                else:
+                    acc["sum"] += h["sum"]
+                    acc["count"] += h["count"]
+                    if acc["bounds"] == list(h["bounds"]):
+                        acc["counts"] = [a + b for a, b in
+                                         zip(acc["counts"], h["counts"])]
+        return out
+
+    def prometheus_text(self) -> str:
+        return prometheus_text_of(self.snapshot())
+
+    def to_proto(self):
+        return proto_of(self.snapshot())
+
+
+# ---------------------------------------------------------------------------
+# exposition set: the process default + every registry expose()d later
+# ---------------------------------------------------------------------------
+
+REGISTRY = MetricsRegistry("default")
+_exposed: "weakref.WeakSet[MetricsRegistry]" = weakref.WeakSet()
+
+
+def expose(registry: MetricsRegistry) -> MetricsRegistry:
+    """Include ``registry`` in this process's merged exposition (http
+    endpoint, default ``metrics`` rpc).  Held weakly: a dropped
+    subsystem disappears from the scrape instead of leaking."""
+    _exposed.add(registry)
+    return registry
+
+
+def merged_snapshot() -> dict:
+    snaps = [REGISTRY.snapshot()] + [r.snapshot() for r in list(_exposed)]
+    return MetricsRegistry.merge(snaps)
+
+
+def prometheus_text_all() -> str:
+    return prometheus_text_of(merged_snapshot())
+
+
+def merged_to_proto():
+    return proto_of(merged_snapshot())
+
+
+# ---------------------------------------------------------------------------
+# formatters (off any snapshot, local or merged)
+# ---------------------------------------------------------------------------
+
+def _base_name(flat: str) -> str:
+    return flat.split("{", 1)[0]
+
+
+def _sanitize(name: str) -> str:
+    return "".join(ch if (ch.isalnum() or ch in "_:") else "_"
+                   for ch in name)
+
+
+def prometheus_text_of(snap: dict, prefix: str = "egtpu_") -> str:
+    """Prometheus text exposition format 0.0.4 of one snapshot."""
+    lines: list[str] = []
+    typed: set[str] = set()
+
+    def emit(flat: str, value, kind: str) -> None:
+        base = _sanitize(prefix + _base_name(flat))
+        if base not in typed:
+            typed.add(base)
+            lines.append(f"# TYPE {base} {kind}")
+        labels = flat[len(_base_name(flat)):]
+        lines.append(f"{base}{labels} {value}")
+
+    for k in sorted(snap.get("counters", {})):
+        emit(k, snap["counters"][k], "counter")
+    for k in sorted(snap.get("gauges", {})):
+        emit(k, snap["gauges"][k], "gauge")
+    for k in sorted(snap.get("histograms", {})):
+        h = snap["histograms"][k]
+        base = _sanitize(prefix + _base_name(k))
+        if base not in typed:
+            typed.add(base)
+            lines.append(f"# TYPE {base} histogram")
+        labels = k[len(_base_name(k)):]
+        inner = labels[1:-1] if labels else ""
+        cum = 0
+        for bound, c in zip(h["bounds"], h["counts"]):
+            cum += c
+            le = ",".join(x for x in (inner, f'le="{bound}"') if x)
+            lines.append(f"{base}_bucket{{{le}}} {cum}")
+        le = ",".join(x for x in (inner, 'le="+Inf"') if x)
+        lines.append(f"{base}_bucket{{{le}}} {h['count']}")
+        lines.append(f"{base}_sum{labels} {h['sum']}")
+        lines.append(f"{base}_count{labels} {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def proto_of(snap: dict):
+    """A ``MetricsResponse`` (counters map + histogram snapshots) of one
+    snapshot; gauges ride in the counters map like the serving plane
+    always did (the map is "counters AND point-in-time gauges")."""
+    from electionguard_tpu.publish import pb
+    counters = {k: int(v) for k, v in snap.get("counters", {}).items()}
+    counters.update({k: int(v) for k, v in snap.get("gauges", {}).items()})
+    resp = pb.msg("MetricsResponse")(counters=counters)
+    for k in sorted(snap.get("histograms", {})):
+        h = snap["histograms"][k]
+        resp.histograms.add(name=k, bounds=h["bounds"], counts=h["counts"],
+                            sum=h["sum"], count=h["count"])
+    return resp

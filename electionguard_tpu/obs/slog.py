@@ -1,0 +1,84 @@
+"""Structured JSONL logging carrying the active trace context.
+
+A ``logging.Handler`` that mirrors every log record as one JSON line —
+``{"ts", "level", "logger", "msg", "pid", "proc", "trace_id",
+"span_id"}`` — so library logs from all processes of a run can be joined
+against the span timeline post-hoc (same ids, same files-per-process
+layout as the span export).
+
+Installed on the ROOT logger, so no call sites change: every module
+keeps using stdlib ``logging`` and the JSONL mirror appears whenever
+``EGTPU_OBS_LOG=<dir>`` is set (``obs.init_from_env``).  This — plus the
+no-bare-print lint (tests/test_lint_print.py) — is the structured
+replacement for ad-hoc ``print()`` telemetry in library code.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Optional
+
+from electionguard_tpu.obs import trace
+
+_lock = threading.Lock()
+_handler: Optional["JsonlHandler"] = None
+
+
+class JsonlHandler(logging.Handler):
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        self._f = open(path, "a")
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            tid, sid = trace.current_ids()
+            line = {"ts": round(record.created, 6),
+                    "level": record.levelname,
+                    "logger": record.name,
+                    "msg": record.getMessage(),
+                    "pid": os.getpid(),
+                    "proc": trace.proc_name()}
+            if tid:
+                line["trace_id"] = tid
+                line["span_id"] = sid
+            if record.exc_info and record.exc_info[0] is not None:
+                line["exc"] = record.exc_info[0].__name__
+            self._f.write(json.dumps(line, separators=(",", ":")) + "\n")
+            self._f.flush()
+        except Exception:  # noqa: BLE001 — logging must never raise
+            self.handleError(record)
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        finally:
+            super().close()
+
+
+def install(dir_path: str) -> JsonlHandler:
+    """Attach the JSONL mirror to the root logger (idempotent)."""
+    global _handler
+    with _lock:
+        if _handler is not None:
+            return _handler
+        os.makedirs(dir_path, exist_ok=True)
+        path = os.path.join(
+            dir_path, f"log-{trace.proc_name()}-{os.getpid()}.jsonl")
+        _handler = JsonlHandler(path)
+    logging.getLogger().addHandler(_handler)
+    return _handler
+
+
+def install_from_env() -> Optional[JsonlHandler]:
+    """``EGTPU_OBS_LOG=<dir>`` mirrors logs there; falls back to the
+    trace dir so one env var lights up the whole obs surface."""
+    d = os.environ.get("EGTPU_OBS_LOG")
+    if not d and os.environ.get("EGTPU_OBS_TRACE"):
+        d = os.environ["EGTPU_OBS_TRACE"]
+    if not d:
+        return None
+    return install(d)

@@ -1,0 +1,350 @@
+"""Distributed trace spans with gRPC metadata propagation.
+
+One e2e run is one **trace**; every timed operation (a workflow phase, an
+rpc leg, a device batch, a backend compile) is a **span** with a
+trace_id/span_id/parent_id.  Spans export as one JSONL file per process
+(``spans-<proc>-<pid>.jsonl`` under the trace dir) and
+``tools/assemble_trace.py`` merges every process's file into a single
+Chrome-trace/Perfetto timeline.
+
+Propagation:
+
+* across **threads/frames**: a ``contextvars`` stack — ``span()`` parents
+  to the innermost active span (or the process root span);
+* across **processes over gRPC**: the client interceptor stamps
+  ``egtpu-trace-id``/``egtpu-span-id`` metadata on every outgoing rpc and
+  the server wrapper adopts them, so the server-side span is a child of
+  the caller's client-side span (hooked at the same
+  ``rpc_util.make_channel``/``generic_service`` points as the fault
+  harness — zero call-site changes);
+* across **spawned subprocesses**: the workflow driver exports
+  ``EGTPU_OBS_TRACE`` (dir), ``EGTPU_OBS_TRACE_ID`` and
+  ``EGTPU_OBS_PARENT_SPAN`` so every child joins the driver's trace.
+
+Tracing is **off by default** and free when off: ``span()`` returns a
+module-level no-op singleton (no allocation), ``intercept_channel`` /
+``wrap_server_method`` return their input untouched, so the disabled hot
+path is exactly the pre-obs code path.  Enable with
+``EGTPU_OBS_TRACE=<dir>`` (read by ``obs.init_from_env`` at CLI startup)
+or programmatically with ``enable(dir)`` *before* channels/servers are
+built.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextvars
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+import grpc
+
+MD_TRACE_ID = "egtpu-trace-id"
+MD_SPAN_ID = "egtpu-span-id"
+
+_lock = threading.Lock()
+_enabled = False
+_dir: Optional[str] = None
+_trace_id = ""
+_proc = ""
+_file = None
+_root: Optional["Span"] = None
+#: (trace_id, span_id) of the innermost active span in this context
+_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "egtpu_trace_ctx", default=None)
+
+
+def _now_us() -> int:
+    return time.time_ns() // 1000
+
+
+def _new_id(nbytes: int = 8) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def trace_id() -> str:
+    return _trace_id
+
+
+def proc_name() -> str:
+    if _proc:
+        return _proc
+    return _default_proc()
+
+
+def _default_proc() -> str:
+    name = os.environ.get("EGTPU_OBS_PROC")
+    if name:
+        return name
+    argv0 = os.path.basename(sys.argv[0]) if sys.argv and sys.argv[0] \
+        else "python"
+    return argv0[:-3] if argv0.endswith(".py") else argv0
+
+
+def current_ids() -> tuple[str, str]:
+    """(trace_id, span_id) of the active context — ("", "") when
+    tracing is off and no rpc context was adopted."""
+    ctx = _ctx.get()
+    if ctx is not None:
+        return ctx
+    if _enabled:
+        return _trace_id, _root.span_id if _root is not None else ""
+    return "", ""
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+def enable(dir_path: str, trace_id_hex: Optional[str] = None,
+           proc: Optional[str] = None) -> None:
+    """Start exporting spans to ``dir_path``.  Idempotent; the first call
+    wins.  The process root span opens now and closes at interpreter
+    exit, so every other span nests inside a per-process envelope."""
+    global _enabled, _dir, _trace_id, _proc, _file, _root
+    with _lock:
+        if _enabled:
+            return
+        os.makedirs(dir_path, exist_ok=True)
+        _dir = dir_path
+        _trace_id = (trace_id_hex
+                     or os.environ.get("EGTPU_OBS_TRACE_ID")
+                     or _new_id(16))
+        _proc = proc or _default_proc()
+        _file = open(os.path.join(
+            dir_path, f"spans-{_proc}-{os.getpid()}.jsonl"), "a")
+        _enabled = True
+    root = Span("process", {"argv": " ".join(sys.argv[:4])})
+    root.parent_override = os.environ.get("EGTPU_OBS_PARENT_SPAN", "")
+    _root = root
+    root.__enter__()
+    atexit.register(_shutdown)
+
+
+def enable_from_env() -> bool:
+    """Enable when ``EGTPU_OBS_TRACE=<dir>`` is set; returns enabled."""
+    d = os.environ.get("EGTPU_OBS_TRACE")
+    if d:
+        enable(d)
+    return _enabled
+
+
+def shutdown() -> None:
+    """Close the root span and the export file (idempotent).  Runs at
+    interpreter exit; a driver that wants to MERGE its own spans before
+    exiting (workflow/e2e.py) calls it explicitly first."""
+    global _file, _root
+    root = _root
+    _root = None
+    if root is not None:
+        root.__exit__(None, None, None)
+    with _lock:
+        if _file is not None:
+            try:
+                _file.close()
+            except OSError:
+                pass
+            _file = None
+
+
+_shutdown = shutdown
+
+
+def _reset_for_tests() -> None:
+    """Return the module to the disabled state (tests only — production
+    processes enable once and never disable)."""
+    global _enabled, _dir, _trace_id, _proc, _file, _root
+    shutdown()
+    with _lock:
+        _enabled = False
+        _dir = None
+        _trace_id = ""
+        _proc = ""
+
+
+def _export(line: dict) -> None:
+    with _lock:
+        if _file is None:
+            return
+        _file.write(json.dumps(line, separators=(",", ":")) + "\n")
+        _file.flush()
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+class Span:
+    """One timed operation.  Context manager; on exit one JSONL line is
+    exported.  ``set(k, v)`` attaches attributes mid-span."""
+
+    __slots__ = ("name", "attrs", "trace_id", "span_id", "parent_id",
+                 "parent_override", "t0", "_token", "_tid")
+
+    def __init__(self, name: str, attrs: Optional[dict] = None):
+        self.name = name
+        self.attrs = attrs
+        self.parent_override = None
+
+    def __enter__(self) -> "Span":
+        parent = _ctx.get()
+        if parent is not None:
+            self.trace_id, self.parent_id = parent
+        else:
+            self.trace_id = _trace_id
+            root = _root
+            self.parent_id = (root.span_id
+                              if root is not None and root is not self
+                              else "")
+        if self.parent_override is not None:
+            self.parent_id = self.parent_override
+        self.span_id = _new_id()
+        self._tid = threading.get_native_id()
+        self._token = _ctx.set((self.trace_id, self.span_id))
+        self.t0 = _now_us()
+        return self
+
+    def set(self, key: str, value) -> None:
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs[key] = value
+
+    def __exit__(self, et, ev, tb) -> bool:
+        _ctx.reset(self._token)
+        if et is not None:
+            self.set("error", et.__name__)
+        line = {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_id": self.parent_id, "name": self.name,
+                "ts": self.t0, "dur": _now_us() - self.t0,
+                "pid": os.getpid(), "tid": self._tid, "proc": _proc}
+        if self.attrs:
+            line["attrs"] = self.attrs
+        _export(line)
+        return False
+
+
+class _NoopSpan:
+    """The disabled-path singleton: zero allocation per ``span()`` call."""
+
+    __slots__ = ()
+    trace_id = ""
+    span_id = ""
+    parent_id = ""
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, et, ev, tb) -> bool:
+        return False
+
+    def set(self, key: str, value) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+def span(name: str, attrs: Optional[dict] = None):
+    """A new child span of the active context — or the shared no-op when
+    tracing is off.  Callers on true hot paths should guard the attrs
+    dict construction behind ``trace.enabled()``."""
+    if not _enabled:
+        return _NOOP
+    return Span(name, attrs)
+
+
+def export_event(name: str, ts_us: int, dur_us: int,
+                 attrs: Optional[dict] = None) -> None:
+    """Export a retroactive span (e.g. a compile duration reported by a
+    jax.monitoring listener after the fact), parented to the active
+    context of the calling thread."""
+    if not _enabled:
+        return
+    trace, parent = current_ids()
+    line = {"trace_id": trace, "span_id": _new_id(),
+            "parent_id": parent, "name": name, "ts": ts_us, "dur": dur_us,
+            "pid": os.getpid(), "tid": threading.get_native_id(),
+            "proc": _proc}
+    if attrs:
+        line["attrs"] = attrs
+    _export(line)
+
+
+# ---------------------------------------------------------------------------
+# gRPC propagation
+# ---------------------------------------------------------------------------
+
+class _CallDetails(grpc.ClientCallDetails):
+    __slots__ = ("method", "timeout", "metadata", "credentials",
+                 "wait_for_ready", "compression")
+
+    def __init__(self, base, metadata):
+        self.method = base.method
+        self.timeout = base.timeout
+        self.metadata = metadata
+        self.credentials = base.credentials
+        self.wait_for_ready = getattr(base, "wait_for_ready", None)
+        self.compression = getattr(base, "compression", None)
+
+
+class TraceClientInterceptor(grpc.UnaryUnaryClientInterceptor):
+    """Opens a ``rpc.client.<method>`` span around every outgoing rpc and
+    stamps its ids onto the call metadata for the server to adopt."""
+
+    def intercept_unary_unary(self, continuation, client_call_details,
+                              request):
+        method = client_call_details.method.rsplit("/", 1)[-1]
+        with Span(f"rpc.client.{method}") as s:
+            md = list(client_call_details.metadata or ())
+            md.append((MD_TRACE_ID, s.trace_id))
+            md.append((MD_SPAN_ID, s.span_id))
+            outcome = continuation(
+                _CallDetails(client_call_details, md), request)
+            try:
+                code = outcome.code()
+            except Exception:  # noqa: BLE001 — status is best-effort
+                code = None
+            if code is not None and code != grpc.StatusCode.OK:
+                s.set("status", code.name)
+            return outcome
+
+
+def intercept_channel(channel: grpc.Channel) -> grpc.Channel:
+    """Wrap ``channel`` with the trace interceptor (identity when
+    tracing is off — the disabled path adds nothing)."""
+    if not _enabled:
+        return channel
+    return grpc.intercept_channel(channel, TraceClientInterceptor())
+
+
+def wrap_server_method(service: str, method: str, fn):
+    """Wrap one ``fn(request, context)`` impl in a ``rpc.server.<method>``
+    span that adopts the caller's trace context from the rpc metadata
+    (identity when tracing is off)."""
+    if not _enabled:
+        return fn
+
+    def traced(request, context):
+        tid = sid = ""
+        for k, v in (context.invocation_metadata() or ()):
+            if k == MD_TRACE_ID:
+                tid = v
+            elif k == MD_SPAN_ID:
+                sid = v
+        token = _ctx.set((tid, sid)) if tid else None
+        try:
+            with Span(f"rpc.server.{method}", {"service": service}):
+                return fn(request, context)
+        finally:
+            if token is not None:
+                _ctx.reset(token)
+
+    return traced

@@ -22,6 +22,8 @@ from typing import Callable, Optional
 import grpc
 from google.protobuf import message_factory
 
+from electionguard_tpu.obs import registry as obs_registry
+from electionguard_tpu.obs import trace as obs_trace
 from electionguard_tpu.publish import pb
 from electionguard_tpu.testing import faults
 
@@ -134,18 +136,66 @@ def _method_classes(method_desc):
     return req, resp
 
 
+def _default_get_metrics(request, context):
+    """Registry-backed ``getMetrics`` every server answers unless it
+    brings its own impl: the process's merged exposition (default
+    registry + every expose()d subsystem registry)."""
+    return obs_registry.merged_to_proto()
+
+
+def _observe_server(service_name: str, method: str, fn: Callable) -> Callable:
+    """Per-rpc server metrics into the default registry: call/error
+    counts and a latency histogram per (service, method).  Always on —
+    same order of cost as the serving plane's existing per-request
+    metrics."""
+    labels = {"service": service_name, "method": method}
+    calls = obs_registry.REGISTRY.counter("rpc_server_calls_total", labels)
+    errors = obs_registry.REGISTRY.counter("rpc_server_errors_total", labels)
+    latency = obs_registry.REGISTRY.histogram("rpc_server_latency_ms",
+                                              labels=labels)
+
+    def observed(request, context):
+        calls.inc()
+        t0 = time.monotonic()
+        try:
+            return fn(request, context)
+        except BaseException:   # includes context.abort's control flow
+            errors.inc()
+            raise
+        finally:
+            latency.observe((time.monotonic() - t0) * 1e3)
+
+    return observed
+
+
 def generic_service(service_name: str,
                     impls: dict[str, Callable]) -> grpc.GenericRpcHandler:
     """Build a generic handler for ``service_name`` from ``{method: fn}``
-    where fn(request_msg, context) -> response_msg."""
+    where fn(request_msg, context) -> response_msg.
+
+    Every impl is wrapped (inside-out) with the fault-injection hook,
+    per-rpc server metrics, and — when tracing is on — a server span
+    that adopts the caller's trace context from the rpc metadata.  A
+    service that declares ``getMetrics`` but brings no impl gets the
+    registry-backed default, so every server answers the metrics rpc.
+    """
     svc = pb.service_descriptor(service_name)
     handlers = {}
     for m in svc.methods:
-        if m.name not in impls:
-            raise ValueError(f"missing impl for {service_name}.{m.name}")
+        fn = impls.get(m.name)
+        if fn is None:
+            if m.name == "getMetrics":
+                fn = _default_get_metrics
+            else:
+                raise ValueError(
+                    f"missing impl for {service_name}.{m.name}")
         req_cls, _ = _method_classes(m)
+        wrapped = obs_trace.wrap_server_method(
+            service_name, m.name,
+            _observe_server(service_name, m.name,
+                            faults.wrap_server_impl(m.name, fn)))
         handlers[m.name] = grpc.unary_unary_rpc_method_handler(
-            faults.wrap_server_impl(m.name, impls[m.name]),
+            wrapped,
             request_deserializer=req_cls.FromString,
             response_serializer=lambda msg: msg.SerializeToString())
     return grpc.method_handlers_generic_handler(svc.full_name, handlers)
@@ -168,12 +218,24 @@ class Stub:
         svc = pb.service_descriptor(service_name)
         self._methods = {}
         self._retry_spent = 0.0   # cumulative backoff sleep (retry budget)
+        self._metrics = {}   # per-method (calls, retries, backoff_s)
+        reg = obs_registry.REGISTRY
         for m in svc.methods:
             req_cls, resp_cls = _method_classes(m)
             self._methods[m.name] = channel.unary_unary(
                 f"/{svc.full_name}/{m.name}",
                 request_serializer=lambda msg: msg.SerializeToString(),
                 response_deserializer=resp_cls.FromString)
+            # retries were invisible unless a fault-plan audit log was
+            # active; now every Stub records per-method call/retry/
+            # backoff counts, labeled with the deadline class (bound
+            # once here — the call hot path only touches Counter.inc)
+            labels = {"method": m.name,
+                      "class": _DEADLINE_CLASS_OF.get(m.name, "exchange")}
+            self._metrics[m.name] = (
+                reg.counter("rpc_client_calls_total", labels),
+                reg.counter("rpc_client_retries_total", labels),
+                reg.counter("rpc_client_backoff_seconds_total", labels))
 
     def call(self, method: str, request, timeout: Optional[float] = None,
              policy: Optional[RetryPolicy] = None):
@@ -195,6 +257,8 @@ class Stub:
         pol = policy if policy is not None else retry_policy()
         if timeout is None:
             timeout = deadline_for(method)
+        calls, retries, backoff_s = self._metrics[method]
+        calls.inc()
         deadline = time.monotonic() + timeout
         attempt = 0
         while True:
@@ -214,11 +278,21 @@ class Stub:
                 transient = _is_transient(code, wfr=wfr, per_try=per_try,
                                           remaining=remaining)
                 if not transient or attempt >= pol.attempts:
+                    obs_registry.REGISTRY.counter(
+                        "rpc_client_failures_total",
+                        {"method": method,
+                         "code": code.name if code else "UNKNOWN"}).inc()
                     raise
                 wait = pol.backoff(attempt)
                 if (deadline - time.monotonic() <= wait
                         or self._retry_spent + wait > pol.budget):
+                    obs_registry.REGISTRY.counter(
+                        "rpc_client_failures_total",
+                        {"method": method,
+                         "code": code.name if code else "UNKNOWN"}).inc()
                     raise
+                retries.inc()
+                backoff_s.inc(wait)
                 self._retry_spent += wait
                 _sleep(wait)
 
@@ -267,12 +341,16 @@ def make_channel(url: str, max_message: int = MAX_TRUSTEE_MESSAGE,
                  keepalive_ms: int = 60_000) -> grpc.Channel:
     """Plaintext channel with the reference's size/keepalive settings.
     When a fault plan is active (EGTPU_FAULT_PLAN / faults.install), the
-    channel is wrapped with the plan's client interceptor."""
-    return faults.intercept_channel(grpc.insecure_channel(url, options=[
-        ("grpc.max_receive_message_length", max_message),
-        ("grpc.max_send_message_length", max_message),
-        ("grpc.keepalive_time_ms", keepalive_ms),
-    ]))
+    channel is wrapped with the plan's client interceptor; when tracing
+    is on (EGTPU_OBS_TRACE / obs.trace.enable), the trace interceptor
+    wraps OUTSIDE the fault one, so client spans see injected faults as
+    the real rpc outcomes they simulate.  Both are identity when off."""
+    return obs_trace.intercept_channel(
+        faults.intercept_channel(grpc.insecure_channel(url, options=[
+            ("grpc.max_receive_message_length", max_message),
+            ("grpc.max_send_message_length", max_message),
+            ("grpc.keepalive_time_ms", keepalive_ms),
+        ])))
 
 
 def make_server(port: int, max_message: int = MAX_TRUSTEE_MESSAGE,

@@ -1,22 +1,28 @@
-"""Serving metrics: counters + fixed-bucket histograms + compile tracking.
+"""Serving metrics — a thin client of the obs metrics registry.
 
-Exposed two ways: over the ``getMetrics`` rpc (``to_proto``) and as a
-one-line drain log (``summary``).  Everything is lock-protected and cheap
-enough to update per request on the hot path.
+Every ``EncryptionService`` owns one ``MetricsRegistry`` (so per-service
+counts never bleed between instances in tests or multi-service
+processes) and registers it for process-wide exposition: the Prometheus
+endpoint (``obs.httpd``) and the default ``metrics`` rpc serve the merged
+view automatically.  Exposed three ways: the ``getMetrics`` rpc
+(``to_proto``), the Prometheus text endpoint, and the one-line drain log
+(``summary``).
 
 ``device_compiles`` counts actual backend compilations process-wide via
-``jax.monitoring`` — the live twin of the ``compile_cache_entries``
-accounting bench.py does against the persistent cache dir.  A serving
-process that buckets its batch shapes correctly shows this counter flat
-after warmup: one compile per (program, bucket shape) and never again
-under load.
+the ``jax.monitoring`` listener in ``obs.jaxmon`` — the live twin of the
+``compile_cache_entries`` accounting bench.py does against the
+persistent cache dir.  A serving process that buckets its batch shapes
+correctly shows this counter flat after warmup: one compile per
+(program, bucket shape) and never again under load.
 """
 
 from __future__ import annotations
 
-import bisect
-import threading
-from typing import Callable, Optional, Sequence
+from typing import Callable, Optional
+
+from electionguard_tpu.obs import jaxmon
+from electionguard_tpu.obs.registry import (Histogram,  # noqa: F401
+                                            MetricsRegistry, expose)
 
 # default latency edges (ms): log-ish spacing from sub-ms to minutes
 _LATENCY_MS_BOUNDS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
@@ -25,81 +31,14 @@ _OCCUPANCY_BOUNDS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
 _DEPTH_BOUNDS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
                  512.0, 1024.0)
 
-# -- process-wide backend-compile counter (jax.monitoring) -------------
-_compile_lock = threading.Lock()
-_compile_count = 0
-_listener_installed = False
-
-
-def _on_event_duration(event: str, duration: float, **kw) -> None:
-    global _compile_count
-    if event == "/jax/core/compile/backend_compile_duration":
-        with _compile_lock:
-            _compile_count += 1
-
 
 def install_compile_listener() -> None:
-    """Idempotently hook jax.monitoring so every backend compile in this
-    process is counted (works on every platform and group, unlike the
-    persistent-cache dir count, which only sees compiles ≥ the persist
-    threshold)."""
-    global _listener_installed
-    with _compile_lock:
-        if _listener_installed:
-            return
-        _listener_installed = True
-    import jax.monitoring
-    jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+    """Back-compat alias: the listener now lives in obs.jaxmon."""
+    jaxmon.install()
 
 
 def device_compile_count() -> int:
-    with _compile_lock:
-        return _compile_count
-
-
-class Histogram:
-    """Fixed-bound histogram: counts[i] observations ≤ bounds[i], last
-    bucket is overflow.  Snapshot-able without stopping writers."""
-
-    def __init__(self, name: str, bounds: Sequence[float]):
-        self.name = name
-        self.bounds = tuple(float(b) for b in bounds)
-        self._counts = [0] * (len(self.bounds) + 1)
-        self._sum = 0.0
-        self._n = 0
-        self._lock = threading.Lock()
-
-    def observe(self, value: float) -> None:
-        i = bisect.bisect_left(self.bounds, value)
-        with self._lock:
-            self._counts[i] += 1
-            self._sum += value
-            self._n += 1
-
-    def snapshot(self) -> dict:
-        with self._lock:
-            return dict(name=self.name, bounds=list(self.bounds),
-                        counts=list(self._counts), sum=self._sum,
-                        count=self._n)
-
-    def mean(self) -> float:
-        with self._lock:
-            return self._sum / self._n if self._n else 0.0
-
-    def quantile(self, q: float) -> float:
-        """Upper bucket-bound estimate of the q-quantile (q in [0,1])."""
-        with self._lock:
-            n, counts = self._n, list(self._counts)
-        if n == 0:
-            return 0.0
-        target = q * n
-        seen = 0
-        for i, c in enumerate(counts):
-            seen += c
-            if seen >= target:
-                return (self.bounds[i] if i < len(self.bounds)
-                        else self.bounds[-1])
-        return self.bounds[-1]
+    return jaxmon.compile_count()
 
 
 class ServiceMetrics:
@@ -110,27 +49,34 @@ class ServiceMetrics:
                 "ballots_encrypted", "ballots_invalid", "ballots_spoiled",
                 "ballots_recovered", "batches_flushed", "padded_slots")
 
-    def __init__(self, queue_depth: Optional[Callable[[], int]] = None):
-        self._lock = threading.Lock()
-        self._counters = {name: 0 for name in self.COUNTERS}
+    def __init__(self, queue_depth: Optional[Callable[[], int]] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        self.registry = expose(registry if registry is not None
+                               else MetricsRegistry("serve"))
+        self._counters = {name: self.registry.counter(name)
+                          for name in self.COUNTERS}
         self._queue_depth = queue_depth
-        self.latency_ms = Histogram("request_latency_ms",
-                                    _LATENCY_MS_BOUNDS)
-        self.batch_occupancy = Histogram("batch_occupancy",
-                                         _OCCUPANCY_BOUNDS)
-        self.queue_depth_at_flush = Histogram("queue_depth_at_flush",
-                                              _DEPTH_BOUNDS)
+        self.latency_ms = self.registry.histogram("request_latency_ms",
+                                                  _LATENCY_MS_BOUNDS)
+        self.batch_occupancy = self.registry.histogram("batch_occupancy",
+                                                       _OCCUPANCY_BOUNDS)
+        self.queue_depth_at_flush = self.registry.histogram(
+            "queue_depth_at_flush", _DEPTH_BOUNDS)
         install_compile_listener()
         self._compiles_at_start = device_compile_count()
+        if queue_depth is not None:
+            self.registry.gauge("queue_depth", fn=queue_depth)
+        self.registry.gauge("device_compiles", fn=device_compile_count)
+        self.registry.gauge(
+            "device_compiles_since_start",
+            fn=lambda: device_compile_count() - self._compiles_at_start)
 
     # ---- writers -----------------------------------------------------
     def inc(self, name: str, by: int = 1) -> None:
-        with self._lock:
-            self._counters[name] += by
+        self._counters[name].inc(by)
 
     def get(self, name: str) -> int:
-        with self._lock:
-            return self._counters[name]
+        return self._counters[name].value
 
     def observe_flush(self, n_real: int, bucket: int,
                       queue_depth: int) -> None:
@@ -142,8 +88,7 @@ class ServiceMetrics:
     # ---- readers -----------------------------------------------------
     def counters(self) -> dict:
         """Counters + point-in-time gauges, as one flat map."""
-        with self._lock:
-            out = dict(self._counters)
+        out = {name: c.value for name, c in self._counters.items()}
         out["queue_depth"] = (self._queue_depth()
                               if self._queue_depth else 0)
         out["device_compiles"] = device_compile_count()
@@ -167,7 +112,9 @@ class ServiceMetrics:
         return (f"admitted={c['requests_admitted']} "
                 f"encrypted={c['ballots_encrypted']} "
                 f"invalid={c['ballots_invalid']} "
+                f"failed={c['requests_failed']} "
                 f"rejected={c['requests_rejected_queue_full']} "
+                f"recovered={c['ballots_recovered']} "
                 f"batches={c['batches_flushed']} "
                 f"occupancy_mean={self.batch_occupancy.mean():.2f} "
                 f"latency_p50={self.latency_ms.quantile(0.5):.0f}ms "

@@ -66,7 +66,8 @@ class EncryptionService:
                  mesh=None,
                  max_workers: int = 16,
                  hold: Optional[threading.Event] = None,
-                 hold_after: Optional[int] = None):
+                 hold_after: Optional[int] = None,
+                 metrics_http_port: Optional[int] = None):
         self.init = init
         self.group = group if group is not None else \
             init.joint_public_key.group
@@ -117,6 +118,15 @@ class EncryptionService:
              "getMetrics": self._get_metrics,
              "health": self._health}),))
         self.server.start()
+        self.metrics_http_port: Optional[int] = None
+        self._metrics_httpd = None
+        if metrics_http_port is not None:
+            # Prometheus text endpoint (0 = ephemeral); the scrape serves
+            # this service's registry merged with the process default
+            # (rpc server counters, compile counters, ...)
+            from electionguard_tpu.obs import httpd
+            self._metrics_httpd, self.metrics_http_port = \
+                httpd.start(metrics_http_port)
         self._drained = threading.Event()
         self._status = "SERVING"
         log.info("encryption service on port %d (max_batch=%d "
@@ -293,6 +303,9 @@ class EncryptionService:
         # request threads blocked in _resolve still hold completed
         # futures; give them `grace` to serialize their responses
         self.server.stop(grace=grace).wait(grace)
+        if self._metrics_httpd is not None:
+            self._metrics_httpd.shutdown()
+            self._metrics_httpd = None
         log.info("drained: %s", self.metrics.summary())
 
     def shutdown(self) -> None:

@@ -34,6 +34,7 @@ from electionguard_tpu.ballot.plaintext import (PlaintextBallot,
                                                 PlaintextBallotSelection)
 from electionguard_tpu.core.group import ElementModQ
 from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+from electionguard_tpu.obs import trace
 from electionguard_tpu.serve.batcher import DynamicBatcher, PendingRequest
 from electionguard_tpu.serve.metrics import ServiceMetrics
 
@@ -154,7 +155,15 @@ class EncryptionWorker(threading.Thread):
         bucket = self.batcher.bucket_for(len(batch))
         depth = self.batcher.depth()
         try:
-            real_encrypted, invalid, spoiled = self._encrypt(batch, bucket)
+            # the device leg of one flush: compile time inside this span
+            # is attributed to it by the obs.jaxmon listener; when
+            # tracing is off this is the shared no-op (zero allocation
+            # beyond the guarded attrs dict)
+            attrs = ({"bucket": bucket, "n_real": len(batch)}
+                     if trace.enabled() else None)
+            with trace.span("worker.batch", attrs):
+                real_encrypted, invalid, spoiled = \
+                    self._encrypt(batch, bucket)
         except BaseException as e:
             for p in batch:
                 if not p.future.set_running_or_notify_cancel():

@@ -31,9 +31,35 @@ from electionguard_tpu.ballot.manifest import (BallotStyle, Candidate,
                                                Party, SelectionDescription)
 from electionguard_tpu.ballot.plaintext import RandomBallotProvider
 from electionguard_tpu.cli.common import setup_logging
+from electionguard_tpu.obs import trace as obs_trace
 from electionguard_tpu.publish.publisher import Publisher
 from electionguard_tpu.remote.rpc_util import find_free_port
 from electionguard_tpu.workflow.run_command import RunCommand, wait_all
+
+
+class _PhaseTracer:
+    """Driver-side phase spans.  ``begin`` closes the previous phase,
+    opens the next, and exports the new span id as
+    ``EGTPU_OBS_PARENT_SPAN`` so every subprocess launched during the
+    phase roots its own span tree under that phase.  No-op (and env
+    untouched) when tracing is off."""
+
+    def __init__(self):
+        self._cur = None
+
+    def begin(self, name: str) -> None:
+        self.end()
+        if not obs_trace.enabled():
+            return
+        self._cur = obs_trace.span(name)
+        self._cur.__enter__()
+        os.environ["EGTPU_OBS_PARENT_SPAN"] = self._cur.span_id
+
+    def end(self) -> None:
+        if self._cur is not None:
+            self._cur.__exit__(None, None, None)
+            self._cur = None
+            os.environ.pop("EGTPU_OBS_PARENT_SPAN", None)
 
 
 def sample_manifest(ncontests: int = 1, nselections: int = 2) -> Manifest:
@@ -78,6 +104,11 @@ def main(argv=None) -> int:
                          "verifier V13 in phase 5")
     ap.add_argument("-keep", action="store_true",
                     help="keep going past failures and dump all output")
+    ap.add_argument("-trace", action="store_true",
+                    help="trace the whole run: every process exports "
+                         "spans under <out>/trace (EGTPU_OBS_TRACE), "
+                         "and the driver merges them into <out>/"
+                         "trace.json (Chrome-trace/Perfetto) at the end")
     ap.add_argument("-chaosRestartGuardian", dest="chaos_guardian",
                     type=int, default=-1,
                     help="chaos hook: this guardian hard-crashes "
@@ -95,6 +126,22 @@ def main(argv=None) -> int:
     os.makedirs(record_dir, exist_ok=True)
     os.makedirs(ballots_dir, exist_ok=True)
     group_flags = ["-group", args.group]
+
+    # one trace for the whole run: the driver enables tracing on itself
+    # and exports the trace dir + trace id so every subprocess of every
+    # phase joins the same timeline (see obs.trace)
+    trace_dir = os.environ.get("EGTPU_OBS_TRACE", "")
+    if args.trace and not trace_dir:
+        trace_dir = os.path.join(out, "trace")
+        os.environ["EGTPU_OBS_TRACE"] = trace_dir
+    if trace_dir:
+        os.environ.setdefault("EGTPU_OBS_TRACE_ID", os.urandom(16).hex())
+        os.environ.setdefault("EGTPU_OBS_PROC", "workflow-driver")
+        obs_trace.enable_from_env()
+        log.info("tracing to %s (trace_id=%s)", trace_dir,
+                 obs_trace.trace_id())
+    phases = _PhaseTracer()
+
     t_all = time.time()
     procs: list[RunCommand] = []
 
@@ -113,6 +160,7 @@ def main(argv=None) -> int:
 
     # ---- phase 1: key ceremony (multi-process) ---------------------------
     t0 = time.time()
+    phases.begin("phase.key-ceremony")
     if args.chaos_guardian >= 0:
         # the COORDINATOR (launched next) needs a retry window wide
         # enough to bridge the guardian's kill→restart gap
@@ -170,6 +218,7 @@ def main(argv=None) -> int:
 
     # ---- phase 2: fake ballots + batch encryption ------------------------
     t0 = time.time()
+    phases.begin("phase.encrypt")
     pub = Publisher(out)
     for b in RandomBallotProvider(manifest, args.nballots, seed=11).ballots():
         pub.write_plaintext_ballot("plaintext_ballots", b)
@@ -186,6 +235,7 @@ def main(argv=None) -> int:
 
     # ---- phase 3: accumulate --------------------------------------------
     t0 = time.time()
+    phases.begin("phase.tally")
     acc = RunCommand.python_module(
         "accumulate", "electionguard_tpu.cli.run_accumulate_tally",
         ["-in", record_dir, "-out", record_dir] + group_flags, cmd_out)
@@ -195,6 +245,7 @@ def main(argv=None) -> int:
 
     # ---- phase 4: remote decryption (multi-process) ----------------------
     t0 = time.time()
+    phases.begin("phase.decrypt")
     dec_port = find_free_port()
     decryptor = RunCommand.python_module(
         "decryptor", "electionguard_tpu.cli.run_remote_decryptor",
@@ -218,6 +269,7 @@ def main(argv=None) -> int:
 
     # ---- phase 5: verify --------------------------------------------------
     t0 = time.time()
+    phases.begin("phase.verify")
     ver = RunCommand.python_module(
         "verifier", "electionguard_tpu.cli.run_verifier",
         ["-in", record_dir] + group_flags, cmd_out)
@@ -226,6 +278,22 @@ def main(argv=None) -> int:
     if code != 0:
         return phase_fail("verify", [ver])
     log.info("[5] verification took %.1fs", time.time() - t0)
+
+    phases.end()
+    if obs_trace.enabled():
+        # close the driver's own span file first so its spans (phases,
+        # root) land in the merge, then assemble everything into one
+        # Perfetto-openable timeline
+        obs_trace.shutdown()
+        from electionguard_tpu.obs import assemble
+        report = assemble.merge_dir(trace_dir,
+                                    os.path.join(out, "trace.json"))
+        log.info("TRACE: %d spans / %d processes / trace_ids=%s "
+                 "rpc_pairs=%d orphans=%d gaps=%d -> %s",
+                 report["n_spans"], len(report["processes"]),
+                 report["trace_ids"], report["rpc_pairs"],
+                 len(report["orphans"]), len(report["gaps"]),
+                 report["out"])
 
     log.info("WORKFLOW PASS: 5 phases, %d ballots, %.1fs total",
              args.nballots, time.time() - t_all)

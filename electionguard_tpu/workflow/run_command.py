@@ -36,7 +36,12 @@ class RunCommand:
     def python_module(name: str, module: str, flags: list[str],
                       output_dir: str, env: Optional[dict] = None
                       ) -> "RunCommand":
-        """Launch ``python -m module flags...`` (the fatJar equivalent)."""
+        """Launch ``python -m module flags...`` (the fatJar equivalent).
+        The child's obs process name defaults to its RunCommand name, so
+        a traced run exports one span/log file per ROLE (guardian-1,
+        decryptor, ...) instead of one per interpreter path."""
+        env = dict(env or {})
+        env.setdefault("EGTPU_OBS_PROC", name)
         return RunCommand(name, [sys.executable, "-m", module] + flags,
                           output_dir, env)
 

@@ -63,6 +63,56 @@ def test_five_phase_workflow_chaos_guardian_restart(tmp_path):
     assert "RESUMED mid-ceremony" in log
 
 
+def test_five_phase_workflow_traced(tmp_path):
+    """Observability acceptance: one traced e2e run yields a merged
+    Chrome-trace timeline with spans from every spawned process under a
+    single trace_id, rpc client/server pairs nested across process
+    boundaries, device compile spans attributed to their batches, and a
+    gap-free (every span inside its process envelope) structure that
+    assemble_trace -strict signs off on."""
+    import json
+    import subprocess as sp
+
+    proc = _run_workflow(tmp_path, "tiny", nballots=6, timeout=600,
+                         extra_flags=["-trace"])
+    assert "TRACE:" in proc.stdout + proc.stderr
+
+    from electionguard_tpu.obs import assemble
+    trace_dir = os.path.join(str(tmp_path), "trace")
+    spans = assemble.load_spans(trace_dir)
+    report = assemble.validate(spans)
+    # one trace id across every process of the run
+    assert len(report["trace_ids"]) == 1
+    assert len(report["processes"]) >= 3
+    # well-formed and gap-free: all parents resolve, every span inside
+    # its process root envelope, every rpc.server span paired with its
+    # cross-process rpc.client parent
+    assert report["orphans"] == [] and report["gaps"] == []
+    assert report["rpc_pairs"] >= 10 and report["rpc_server_unpaired"] == 0
+    names = {s["name"] for s in spans}
+    assert {"process", "phase.key-ceremony", "phase.encrypt",
+            "phase.decrypt", "encrypt.batch", "decrypt.batch",
+            "keyceremony.exchange", "device.compile"} <= names
+    # compile spans are attributed: parented into a real span tree
+    ids = {s["span_id"] for s in spans}
+    assert all(s["parent_id"] in ids
+               for s in spans if s["name"] == "device.compile")
+
+    # the driver already merged; the standalone tool agrees (-strict)
+    merged = os.path.join(str(tmp_path), "trace.json")
+    assert os.path.exists(merged)
+    with open(merged) as f:
+        events = json.load(f)["traceEvents"]
+    assert len([e for e in events if e["ph"] == "X"]) == len(spans)
+    tool = sp.run(
+        [sys.executable, "tools/assemble_trace.py", "-dir", trace_dir,
+         "-out", os.path.join(str(tmp_path), "trace_tool.json"),
+         "-strict"],
+        capture_output=True, text=True, timeout=120, env=_cpu_env(),
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert tool.returncode == 0, tool.stdout + tool.stderr
+
+
 def test_five_phase_workflow_production(tmp_path):
     """The reference's full scenario on the REAL group over real gRPC:
     3 guardians, quorum 2, 2 available -> compensated decryption, spoiled

@@ -1,0 +1,54 @@
+"""Lint: no bare ``print()`` in electionguard_tpu/ library code.
+
+Library telemetry goes through ``logging`` (mirrored as structured JSONL
+with trace context by ``obs.slog``) — a bare ``print()`` is invisible to
+the observability plane and unattributable to a trace.  CLI entry points
+(``electionguard_tpu/cli/``) are exempt: their stdout IS their user
+interface.  A ``print(..., file=...)`` writing to an explicitly chosen
+stream (e.g. ``RunCommand.show(stream=...)`` dumping captured subprocess
+output) is display plumbing, not telemetry, and stays allowed.
+
+AST-based, so ``print`` inside string literals (subprocess ``-c``
+snippets in utils/platform.py) never false-positives.
+"""
+
+import ast
+import os
+
+import electionguard_tpu
+
+PKG_DIR = os.path.dirname(os.path.abspath(electionguard_tpu.__file__))
+EXEMPT_DIRS = ("cli",)   # entry points: stdout is the interface
+
+
+def _bare_prints(path: str) -> list[int]:
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    lines = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+                and not any(kw.arg == "file" for kw in node.keywords)):
+            lines.append(node.lineno)
+    return lines
+
+
+def test_no_bare_print_in_library_code():
+    offenders = []
+    for root, dirs, files in os.walk(PKG_DIR):
+        rel = os.path.relpath(root, PKG_DIR)
+        top = rel.split(os.sep)[0]
+        if top in EXEMPT_DIRS or "__pycache__" in root:
+            continue
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            for lineno in _bare_prints(path):
+                offenders.append(
+                    f"{os.path.relpath(path, PKG_DIR)}:{lineno}")
+    assert not offenders, (
+        "bare print() in library code (use logging — obs.slog mirrors "
+        "it as structured JSONL with trace context):\n  "
+        + "\n  ".join(offenders))

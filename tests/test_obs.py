@@ -1,0 +1,292 @@
+"""Observability plane: metrics registry, Prometheus exposition, trace
+spans, cross-process propagation over gRPC, and the client retry metrics
+the rpc plane now records.
+
+The subprocess twin — a full traced 5-phase workflow merged into one
+Chrome-trace timeline — lives in tests/test_e2e_subprocess.py; here the
+same machinery is pinned in-process so the non-slow tier covers it.
+"""
+
+import json
+import logging
+import os
+import urllib.request
+
+import grpc
+import pytest
+
+from electionguard_tpu.obs import assemble, httpd
+from electionguard_tpu.obs import registry as reg
+from electionguard_tpu.obs import slog, trace
+from electionguard_tpu.publish import pb
+from electionguard_tpu.remote import rpc_util
+from electionguard_tpu.testing import faults
+
+
+@pytest.fixture()
+def clean_trace():
+    """Each trace test starts and ends with tracing OFF (enable() is
+    once-per-process in production; tests reset explicitly)."""
+    trace._reset_for_tests()
+    yield
+    trace._reset_for_tests()
+
+
+# =====================================================================
+# registry
+# =====================================================================
+
+
+def test_registry_counter_gauge_histogram():
+    r = reg.MetricsRegistry()
+    c = r.counter("reqs_total", {"method": "foo"})
+    c.inc()
+    c.inc(4)
+    # same (name, labels) -> same object
+    assert r.counter("reqs_total", {"method": "foo"}) is c
+    assert r.counter("reqs_total", {"method": "bar"}) is not c
+    r.gauge("depth", fn=lambda: 7)
+    h = r.histogram("lat_ms", (1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    snap = r.snapshot()
+    assert snap["counters"]['reqs_total{method="foo"}'] == 5
+    assert snap["gauges"]["depth"] == 7
+    hs = snap["histograms"]["lat_ms"]
+    assert hs["counts"] == [1, 1, 1, 1] and hs["count"] == 4
+    assert h.quantile(0.5) == 10.0 and h.mean() == pytest.approx(138.875)
+
+
+def test_registry_merge_sums_across_processes():
+    a = {"counters": {"x": 2, "y": 1}, "gauges": {"d": 3},
+         "histograms": {"h": {"name": "h", "bounds": [1.0, 2.0],
+                              "counts": [1, 0, 2], "sum": 5.0, "count": 3}}}
+    b = {"counters": {"x": 5}, "gauges": {"d": 4},
+         "histograms": {"h": {"name": "h", "bounds": [1.0, 2.0],
+                              "counts": [0, 1, 1], "sum": 4.0, "count": 2}}}
+    m = reg.MetricsRegistry.merge([a, b])
+    assert m["counters"] == {"x": 7, "y": 1}
+    assert m["gauges"] == {"d": 7}
+    assert m["histograms"]["h"]["counts"] == [1, 1, 3]
+    assert m["histograms"]["h"]["count"] == 5
+    assert m["histograms"]["h"]["sum"] == 9.0
+
+
+def test_prometheus_text_format():
+    r = reg.MetricsRegistry()
+    r.counter("reqs_total", {"method": "foo"}).inc(3)
+    r.histogram("lat_ms", (1.0, 10.0)).observe(5.0)
+    text = r.prometheus_text()
+    assert "# TYPE egtpu_reqs_total counter" in text
+    assert 'egtpu_reqs_total{method="foo"} 3' in text
+    assert "# TYPE egtpu_lat_ms histogram" in text
+    assert 'egtpu_lat_ms_bucket{le="10.0"} 1' in text
+    assert 'egtpu_lat_ms_bucket{le="+Inf"} 1' in text
+    assert "egtpu_lat_ms_count 1" in text
+
+
+def test_http_endpoint_scrape():
+    marker = reg.REGISTRY.counter("obs_test_scrape_total")
+    marker.inc(11)
+    server, port = httpd.start(0)
+    try:
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert "egtpu_obs_test_scrape_total 11" in text
+        ok = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read()
+        assert ok == b"ok\n"
+    finally:
+        server.shutdown()
+
+
+def test_metrics_response_proto_roundtrip():
+    r = reg.MetricsRegistry()
+    r.counter("a_total").inc(2)
+    r.gauge("g", fn=lambda: 9)
+    r.histogram("h", (1.0,)).observe(0.5)
+    resp = r.to_proto()
+    assert resp.counters["a_total"] == 2
+    assert resp.counters["g"] == 9
+    assert resp.histograms[0].name == "h"
+    assert list(resp.histograms[0].counts) == [1, 0]
+
+
+# =====================================================================
+# trace spans
+# =====================================================================
+
+
+def test_span_disabled_is_shared_noop(clean_trace):
+    s1 = trace.span("anything")
+    s2 = trace.span("else")
+    assert s1 is s2  # the zero-allocation singleton
+    with s1 as s:
+        s.set("k", "v")   # must be inert, not raise
+    assert trace.current_ids() == ("", "")
+
+
+def test_span_export_and_parenting(clean_trace, tmp_path):
+    trace.enable(str(tmp_path), trace_id_hex="ab" * 16, proc="t1")
+    with trace.span("outer", {"k": 1}):
+        with trace.span("inner"):
+            pass
+    trace.shutdown()
+    spans = assemble.load_spans(str(tmp_path))
+    by_name = {s["name"]: s for s in spans}
+    assert set(by_name) == {"process", "outer", "inner"}
+    assert all(s["trace_id"] == "ab" * 16 for s in spans)
+    assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+    assert by_name["outer"]["parent_id"] == by_name["process"]["span_id"]
+    assert by_name["outer"]["attrs"] == {"k": 1}
+    report = assemble.validate(spans)
+    assert report["orphans"] == [] and report["gaps"] == []
+    # chrome trace is well-formed: one X event per span + process name
+    ct = assemble.chrome_trace(spans)
+    xs = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 3 and all(e["dur"] >= 1 for e in xs)
+
+
+def test_rpc_trace_propagation_and_default_metrics_rpc(clean_trace,
+                                                       tmp_path):
+    """Client and server spans of one rpc share the trace id, nest
+    client->server across the wire, and a service with no explicit
+    getMetrics impl still answers it from the registry."""
+    trace.enable(str(tmp_path), proc="rpc-test")
+
+    def impl(request, context):
+        return pb.msg("RegisterKeyCeremonyTrusteeResponse")(
+            guardian_id=request.guardian_id, x_coordinate=1, quorum=1)
+
+    server, port = rpc_util.make_server(0)
+    server.add_generic_rpc_handlers((rpc_util.generic_service(
+        "RemoteKeyCeremonyService", {"registerTrustee": impl}),))
+    server.start()
+    channel = rpc_util.make_channel(f"localhost:{port}")
+    stub = rpc_util.Stub(channel, "RemoteKeyCeremonyService")
+    try:
+        resp = stub.call("registerTrustee",
+                         pb.msg("RegisterKeyCeremonyTrusteeRequest")(
+                             guardian_id="g"))
+        assert resp.x_coordinate == 1
+        m = stub.call("getMetrics", pb.msg("MetricsRequest")())
+        calls = {k: v for k, v in m.counters.items()
+                 if k.startswith("rpc_server_calls_total")}
+        assert any("registerTrustee" in k for k in calls)
+    finally:
+        channel.close()
+        server.stop(grace=0)
+    trace.shutdown()
+    spans = assemble.load_spans(str(tmp_path))
+    report = assemble.validate(spans)
+    assert len(report["trace_ids"]) == 1
+    assert report["orphans"] == [] and report["gaps"] == []
+    assert report["rpc_pairs"] == 2 and report["rpc_server_unpaired"] == 0
+    client = [s for s in spans
+              if s["name"] == "rpc.client.registerTrustee"][0]
+    srv = [s for s in spans
+           if s["name"] == "rpc.server.registerTrustee"][0]
+    assert srv["parent_id"] == client["span_id"]
+    # server span nests inside the client span's window
+    assert (client["ts"] <= srv["ts"]
+            and srv["ts"] + srv["dur"] <= client["ts"] + client["dur"] + 1)
+
+
+def test_stub_call_records_retry_metrics():
+    """Satellite: retries/backoff are visible in the registry even
+    without a fault-plan audit log."""
+    def d(name, labels):
+        return reg.REGISTRY.counter(name, labels).value
+
+    labels = {"method": "registerTrustee", "class": "registration"}
+    before = (d("rpc_client_calls_total", labels),
+              d("rpc_client_retries_total", labels),
+              d("rpc_client_backoff_seconds_total", labels))
+
+    def impl(request, context):
+        return pb.msg("RegisterKeyCeremonyTrusteeResponse")(
+            guardian_id="g", x_coordinate=1, quorum=1)
+
+    plan = faults.install(faults.FaultPlan(rules=[
+        faults.FaultRule(method="registerTrustee", kind="unavailable",
+                         on_calls=(1, 2))]))
+    server, port = rpc_util.make_server(0)
+    server.add_generic_rpc_handlers((rpc_util.generic_service(
+        "RemoteKeyCeremonyService", {"registerTrustee": impl}),))
+    server.start()
+    channel = rpc_util.make_channel(f"localhost:{port}")
+    stub = rpc_util.Stub(channel, "RemoteKeyCeremonyService")
+    pol = rpc_util.RetryPolicy(attempts=3, base_wait=0.01, max_wait=0.02,
+                               connect_window=1.0, budget=10.0)
+    try:
+        resp = stub.call("registerTrustee",
+                         pb.msg("RegisterKeyCeremonyTrusteeRequest")(
+                             guardian_id="g"),
+                         timeout=30, policy=pol)
+        assert resp.x_coordinate == 1
+        assert len(plan.injected) == 2
+    finally:
+        faults.clear()
+        channel.close()
+        server.stop(grace=0)
+    assert d("rpc_client_calls_total", labels) == before[0] + 1
+    assert d("rpc_client_retries_total", labels) == before[1] + 2
+    assert d("rpc_client_backoff_seconds_total", labels) > before[2]
+
+
+def test_stub_call_records_failures():
+    before = None
+    port = rpc_util.find_free_port()
+    channel = rpc_util.make_channel(f"localhost:{port}")
+    stub = rpc_util.Stub(channel, "RemoteKeyCeremonyService")
+    labels = {"method": "registerTrustee", "code": "UNAVAILABLE"}
+    before = reg.REGISTRY.counter("rpc_client_failures_total", labels).value
+    pol = rpc_util.RetryPolicy(attempts=1, base_wait=0.01, max_wait=0.01,
+                               connect_window=0.05, budget=1.0)
+    try:
+        with pytest.raises(grpc.RpcError):
+            stub.call("registerTrustee",
+                      pb.msg("RegisterKeyCeremonyTrusteeRequest")(
+                          guardian_id="x"), timeout=5, policy=pol)
+    finally:
+        channel.close()
+    after = reg.REGISTRY.counter("rpc_client_failures_total", labels).value
+    assert after == before + 1
+
+
+# =====================================================================
+# structured log mirror + serving summary
+# =====================================================================
+
+
+def test_slog_jsonl_carries_trace_context(clean_trace, tmp_path):
+    trace.enable(str(tmp_path), trace_id_hex="cd" * 16, proc="slogt")
+    handler = slog.JsonlHandler(str(tmp_path / "log.jsonl"))
+    logger = logging.getLogger("egtpu.test.slog")
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
+        with trace.span("op") as sp:
+            logger.info("hello %s", "world")
+            span_id = sp.span_id
+    finally:
+        logger.removeHandler(handler)
+        handler.close()
+    rows = [json.loads(ln) for ln in open(tmp_path / "log.jsonl")]
+    assert rows[0]["msg"] == "hello world"
+    assert rows[0]["trace_id"] == "cd" * 16
+    assert rows[0]["span_id"] == span_id
+    assert rows[0]["pid"] == os.getpid()
+
+
+def test_service_metrics_summary_surfaces_failed_and_recovered():
+    """Satellite: requests_failed and ballots_recovered were counted but
+    never surfaced in the drain log."""
+    from electionguard_tpu.serve.metrics import ServiceMetrics
+    m = ServiceMetrics(queue_depth=lambda: 2)
+    m.inc("requests_failed", 3)
+    m.inc("ballots_recovered", 5)
+    s = m.summary()
+    assert "failed=3" in s
+    assert "recovered=5" in s
+    assert "queue_depth=2" in s

@@ -358,25 +358,47 @@ def test_bucket_shape_stability_no_recompile(serve_init, tgroup):
 def test_loadgen_smoke_occupancy_and_compile_stability(
         serve_init, tgroup, tmp_path):
     """Acceptance: under the loadgen smoke run, compile count is stable
-    after warmup, mean batch occupancy ≥ 50% at saturation, and the
-    metrics rpc reports queue depth, occupancy, and latency histograms."""
+    after warmup, mean batch occupancy ≥ 50% at saturation, the metrics
+    rpc reports queue depth/occupancy/latency histograms, the Prometheus
+    endpoint scrapes live counters, and the per-request latency JSONL is
+    well-formed."""
+    import json
     import sys
+    import urllib.request
     sys.path.insert(0, "tools")
     from loadgen_encrypt import run_loadgen
     from electionguard_tpu.serve.metrics import device_compile_count
 
     svc = _make_service(serve_init, tgroup, tmp_path, max_batch=8,
-                        max_wait_ms=30, max_queue=32)
+                        max_wait_ms=30, max_queue=32,
+                        metrics_http_port=0)
     try:
         url = f"localhost:{svc.port}"
+        lat_path = str(tmp_path / "latency.jsonl")
         report = run_loadgen(url, tiny_manifest(), tgroup, nclients=4,
-                             nballots=4, seed=1)
+                             nballots=4, seed=1, latency_out=lat_path)
         assert report["errors"] == 0
         assert report["completed"] == 16
         assert report["ballots_per_s"] > 0
         # occupancy ≥ 50% at saturation: structural with power-of-two
         # buckets, and the metrics rpc must agree
         assert report["batch_occupancy_mean"] >= 0.5
+        # the per-request latency JSONL joins client-observed latency
+        # to the request ids the server side saw
+        rows = [json.loads(ln) for ln in open(lat_path)]
+        assert len(rows) == 16 and all(r["ok"] for r in rows)
+        assert all(r["latency_ms"] > 0 for r in rows)
+        # curl-style scrape of the live Prometheus endpoint shows the
+        # service counters that just moved
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.metrics_http_port}/metrics",
+            timeout=10).read().decode()
+        assert "# TYPE egtpu_ballots_encrypted counter" in text
+        enc_line = [ln for ln in text.splitlines()
+                    if ln.startswith("egtpu_ballots_encrypted ")][0]
+        assert int(enc_line.split()[-1]) >= 16
+        assert "egtpu_rpc_server_calls_total" in text
+        assert "egtpu_request_latency_ms_bucket" in text
         # warmup done: a second identical wave adds ZERO compiles
         warm = device_compile_count()
         report2 = run_loadgen(url, tiny_manifest(), tgroup, nclients=4,

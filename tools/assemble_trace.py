@@ -1,0 +1,51 @@
+"""Merge the per-process span files of a traced run into one timeline.
+
+Every process of a run (workflow driver, coordinators, guardians, the
+encryption service, loadgen) exports ``spans-<proc>-<pid>.jsonl`` into
+the shared ``EGTPU_OBS_TRACE`` dir; this tool merges them into a single
+Chrome-trace JSON that Perfetto (https://ui.perfetto.dev) or
+``chrome://tracing`` opens directly, and prints a validation report
+(span/process counts, trace ids, orphan parents, envelope gaps, rpc
+client/server pairing).
+
+Usage::
+
+    python tools/assemble_trace.py -dir /tmp/eg/trace [-out trace.json]
+    python tools/assemble_trace.py -dir /tmp/eg/trace -strict   # CI gate
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("assemble_trace")
+    ap.add_argument("-dir", dest="trace_dir", required=True,
+                    help="span dir (the run's EGTPU_OBS_TRACE)")
+    ap.add_argument("-out", dest="output", default=None,
+                    help="merged Chrome-trace JSON path "
+                         "(default <dir>/trace.json)")
+    ap.add_argument("-strict", action="store_true",
+                    help="exit 1 unless the trace is clean: one trace "
+                         "id, no orphans, no envelope gaps")
+    args = ap.parse_args(argv)
+
+    from electionguard_tpu.obs import assemble
+
+    out = args.output or os.path.join(args.trace_dir, "trace.json")
+    report = assemble.merge_dir(args.trace_dir, out)
+    print(json.dumps(report, indent=2, sort_keys=True))
+    if args.strict and (len(report["trace_ids"]) != 1
+                        or report["orphans"] or report["gaps"]):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

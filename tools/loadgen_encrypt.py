@@ -46,10 +46,19 @@ def _percentile(sorted_vals, q: float) -> float:
 def run_loadgen(url: str, manifest, group, nclients: int = 4,
                 nballots: int = 32, seed: int = 0,
                 retry_backoff_s: float = 0.05,
-                max_retries: int = 200) -> dict:
+                max_retries: int = 200,
+                latency_out: str = None) -> dict:
     """Fire ``nclients`` threads × ``nballots`` single-ballot rpcs at
-    ``url``; returns the report dict (also printed by main)."""
+    ``url``; returns the report dict (also printed by main).
+
+    ``latency_out``: optional JSONL path — one line per request with the
+    client-observed latency AND the request's trace/span ids (when
+    tracing is on, every rpc carries them to the service), so
+    client-side and server-side latency can be joined post-hoc against
+    the span timeline.
+    """
     from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+    from electionguard_tpu.obs import trace
     from electionguard_tpu.serve.service import EncryptionClient
 
     lock = threading.Lock()
@@ -57,6 +66,7 @@ def run_loadgen(url: str, manifest, group, nclients: int = 4,
     errors: list[str] = []
     rejected = 0
     codes: dict[str, bytes] = {}
+    lat_f = open(latency_out, "w") if latency_out else None
 
     def one_client(idx: int):
         nonlocal rejected
@@ -69,28 +79,51 @@ def run_loadgen(url: str, manifest, group, nclients: int = 4,
                 # (ballot ids are unique election-wide)
                 b = dataclasses.replace(
                     b, ballot_id=f"c{idx}s{seed}-{b.ballot_id}")
-                for attempt in range(max_retries):
-                    t0 = time.monotonic()
-                    try:
-                        enc = client.encrypt(b)
-                    except grpc.RpcError as e:
-                        if (e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
-                                and attempt < max_retries - 1):
-                            with lock:
-                                rejected += 1
-                            time.sleep(retry_backoff_s * (1 + attempt % 5))
-                            continue
-                        with lock:
-                            errors.append(f"{b.ballot_id}: {e.code()}")
+                ts_us = time.time_ns() // 1000
+                ok, err, lat, attempts = False, None, None, 0
+                sp = trace.span("loadgen.request",
+                                {"ballot_id": b.ballot_id}
+                                if trace.enabled() else None)
+                with sp:
+                    for attempt in range(max_retries):
+                        attempts = attempt + 1
+                        t0 = time.monotonic()
+                        try:
+                            enc = client.encrypt(b)
+                        except grpc.RpcError as e:
+                            if (e.code()
+                                    == grpc.StatusCode.RESOURCE_EXHAUSTED
+                                    and attempt < max_retries - 1):
+                                with lock:
+                                    rejected += 1
+                                time.sleep(retry_backoff_s
+                                           * (1 + attempt % 5))
+                                continue
+                            err = str(e.code())
+                            break
+                        except ValueError as e:  # in-band invalid ballot
+                            err = str(e)
+                            break
+                        lat = time.monotonic() - t0
+                        ok = True
                         break
-                    except ValueError as e:  # in-band invalid ballot
-                        with lock:
-                            errors.append(f"{b.ballot_id}: {e}")
-                        break
-                    with lock:
-                        latencies.append(time.monotonic() - t0)
+                with lock:
+                    if ok:
+                        latencies.append(lat)
                         codes[b.ballot_id] = enc.code
-                    break
+                    else:
+                        errors.append(f"{b.ballot_id}: {err}")
+                    if lat_f is not None:
+                        lat_f.write(json.dumps(
+                            {"ballot_id": b.ballot_id,
+                             "trace_id": sp.trace_id,
+                             "span_id": sp.span_id,
+                             "ts": ts_us,
+                             "latency_ms": (round(lat * 1e3, 3)
+                                            if lat is not None else None),
+                             "attempts": attempts, "ok": ok,
+                             "error": err},
+                            separators=(",", ":")) + "\n")
         finally:
             client.close()
 
@@ -114,6 +147,9 @@ def run_loadgen(url: str, manifest, group, nclients: int = 4,
         occupancy_mean = (occ.sum / occ.count) if occ and occ.count else 0.0
     finally:
         client.close()
+
+    if lat_f is not None:
+        lat_f.close()
 
     lat_sorted = sorted(latencies)
     report = {
@@ -151,6 +187,10 @@ def main(argv=None) -> int:
     ap.add_argument("-seed", type=int, default=0)
     ap.add_argument("-json", dest="json_out", default=None,
                     help="also write the report to this path")
+    ap.add_argument("-latencyOut", dest="latency_out", default=None,
+                    help="per-request latency JSONL (ballot_id, trace/"
+                         "span ids, latency_ms, attempts) for post-hoc "
+                         "joins against the server span timeline")
     add_group_flag(ap)
     args = ap.parse_args(argv)
 
@@ -158,7 +198,7 @@ def main(argv=None) -> int:
     init = Consumer(args.input, group).read_election_initialized()
     report = run_loadgen(args.url, init.config.manifest, group,
                          nclients=args.clients, nballots=args.nballots,
-                         seed=args.seed)
+                         seed=args.seed, latency_out=args.latency_out)
     report.pop("_codes", None)
     print(json.dumps(report, indent=2, sort_keys=True))
     if args.json_out:
