@@ -1,0 +1,81 @@
+"""Mixnet binary: run K sequential re-encryption mix stages over the
+record's cast ballots (between tally accumulation and decryption in the
+workflow — the ballot-anonymization stage of the egk-mixnet ecosystem).
+
+Each stage shuffles + re-encrypts all cast ballots' ciphertext rows and
+publishes the output rows plus a Terelius–Wikström proof of shuffle as
+``mix_stage_NNN.pb`` in the record dir; ``run_verifier`` then checks the
+whole cascade (V15 family) as part of record verification.
+
+Run:  python -m electionguard_tpu.cli.run_mixnet -in record -out record \
+          -stages 2 -group tiny
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from electionguard_tpu.cli.common import (Stopwatch, add_group_flag,
+                                          resolve_group, setup_logging)
+from electionguard_tpu.mixnet.shuffle import Shuffler
+from electionguard_tpu.mixnet.stage import rows_from_ballots, run_stage
+from electionguard_tpu.publish.publisher import Consumer, Publisher
+from electionguard_tpu.utils import maybe_profile
+
+
+def main(argv=None) -> int:
+    log = setup_logging("RunMixnet")
+    ap = argparse.ArgumentParser("RunMixnet")
+    ap.add_argument("-in", dest="input", required=True,
+                    help="record dir with encrypted_ballots.pb")
+    ap.add_argument("-out", dest="output", required=True)
+    ap.add_argument("-stages", type=int, default=2,
+                    help="number of sequential mix stages")
+    ap.add_argument("-seed", default=None,
+                    help="pin the mix randomness (tests/reproducible "
+                         "runs); omit for fresh secret randomness")
+    add_group_flag(ap)
+    args = ap.parse_args(argv)
+    if args.stages < 1:
+        log.error("-stages must be >= 1")
+        return 1
+
+    group = resolve_group(args)
+    consumer = Consumer(args.input, group)
+    init = consumer.read_election_initialized()
+    publisher = Publisher(args.output)
+
+    sw = Stopwatch()
+    pads, datas = rows_from_ballots(consumer.iterate_encrypted_ballots())
+    if not pads:
+        log.error("no cast ballots in %s — nothing to mix", args.input)
+        return 1
+    n, w = len(pads), len(pads[0])
+    log.info("mixing %d cast ballots x %d ciphertexts through %d stages",
+             n, w, args.stages)
+
+    shuffler = Shuffler(group, init.joint_public_key.value)
+    qbar = init.extended_base_hash
+    with maybe_profile("mixnet"):
+        for k in range(args.stages):
+            t0 = time.time()
+            seed = (f"{args.seed}-stage-{k}".encode()
+                    if args.seed is not None else None)
+            stage = run_stage(group, init.joint_public_key.value, qbar,
+                              k, pads, datas, seed=seed, shuffler=shuffler)
+            path = publisher.write_mix_stage(group, stage)
+            dt = time.time() - t0
+            log.info("stage %d: shuffled+proved %d rows in %.2fs "
+                     "(%.1f rows/s) -> %s", k, n, dt, n / max(dt, 1e-9),
+                     path)
+            pads, datas = stage.pads, stage.datas
+
+    log.info("%s; %d stages over %d ballots published",
+             sw.took("mixnet", max(n * args.stages, 1)), args.stages, n)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

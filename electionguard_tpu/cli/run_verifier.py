@@ -55,13 +55,15 @@ def _feeder_worker(wargs):
     return res, agg
 
 
-def _verify_with_feeders(args, group, consumer, record, log):
+def _verify_with_feeders(args, group, consumer, record, log,
+                         mix_input_fn=None):
     """Fan the ballot stream out over ``args.feeders`` processes."""
     import multiprocessing as mp
 
     shards = consumer.ballot_shards(args.feeders)
     if not shards:  # empty/absent ballot stream: nothing to fan out
-        v = Verifier(record, group, chunk_size=args.chunk_size)
+        v = Verifier(record, group, chunk_size=args.chunk_size,
+                     mix_input_fn=mix_input_fn)
         from electionguard_tpu.verify.verifier import (VerificationResult,
                                                        _BallotAggregates)
         return v.finalize(VerificationResult(), _BallotAggregates()), 0
@@ -87,8 +89,8 @@ def _verify_with_feeders(args, group, consumer, record, log):
     res, agg = Verifier.merge_partials(parts)
     log.info("merged %d feeder partials (%d ballots)", len(parts),
              n_ballots)
-    return Verifier(record, group,
-                    chunk_size=args.chunk_size).finalize(res, agg), \
+    return Verifier(record, group, chunk_size=args.chunk_size,
+                    mix_input_fn=mix_input_fn).finalize(res, agg), \
         n_ballots
 
 
@@ -117,6 +119,12 @@ def main(argv=None) -> int:
             record.decryption_result = consumer.read_decryption_result()
         record.spoiled_ballot_tallies = list(
             consumer.iterate_spoiled_ballot_tallies())
+        if consumer.has_mix_stages():
+            # mix stages are O(cast ballots) resident by design — the
+            # cascade's working set IS the row matrix
+            record.mix_stages = consumer.read_mix_stages()
+            log.info("record carries %d mix stages",
+                     len(record.mix_stages))
 
         def counting_ballots():
             nonlocal n_seen
@@ -130,15 +138,22 @@ def main(argv=None) -> int:
         log.error("record unreadable (corrupt or truncated): %s", e)
         return 1
 
+    def mix_input_fn():
+        # second streaming pass: the mix plane needs the cast ballots'
+        # ciphertext rows resident (same O(N) as one published stage)
+        from electionguard_tpu.mixnet.verify_mix import rows_from_ballots
+        return rows_from_ballots(consumer.iterate_encrypted_ballots())
+
     sw = Stopwatch()
     try:
         with maybe_profile("verify"):
             if args.feeders > 1:
                 res, n_seen = _verify_with_feeders(args, group, consumer,
-                                                   record, log)
+                                                   record, log,
+                                                   mix_input_fn)
             else:
-                res = Verifier(record, group,
-                               chunk_size=args.chunk_size).verify()
+                res = Verifier(record, group, chunk_size=args.chunk_size,
+                               mix_input_fn=mix_input_fn).verify()
     except Exception as e:  # truncated ballot stream surfaces mid-iteration
         log.error("record unreadable (corrupt or truncated): %s", e)
         return 1

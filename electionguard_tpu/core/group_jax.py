@@ -179,8 +179,10 @@ class JaxGroupOps:
         self._multi_powmod_j = jax.jit(self._multi_powmod_impl)
         self._mulmod_j = jax.jit(self._mulmod_impl)
         self._fixed_pow_j = jax.jit(self._fixed_pow_impl)
+        self._fixed_multi_pow_j = jax.jit(self._fixed_multi_pow_impl)
         self._prod_reduce_j = jax.jit(self._prod_reduce_impl)
         self._verify_residue_j = jax.jit(self._verify_residue_impl)
+        self._cofactor_j = None  # built lazily by cofactor_pow
 
     # ------------------------------------------------------------------
     # codecs
@@ -257,6 +259,26 @@ class JaxGroupOps:
             digit = ((limb >> ((w % 2) * 8)) & jnp.uint32(0xFF)).astype(jnp.int32)
             sel = table[w][digit]          # (B, n) gather over 256 rows
             acc = sel if acc is None else self._mm(acc, sel)
+        return bn.from_mont_via(self._mm, acc)
+
+    def _fixed_multi_pow_impl(self, tables: jax.Array,
+                              exps: jax.Array) -> jax.Array:
+        """∏_j tables[j]^{exps[:, j]} for k host-known bases in ONE fused
+        program: tables (k, nwin8, 256, n) stacked fixed-base tables,
+        exps (B, k, ne) -> (B, n) canonical.  k·nwin8 gathers plus
+        k·nwin8 - 1 Montgomery multiplies — a k-base PowRadix ladder, vs
+        ~k·335 multiplies for k variable-base ladders plus the combining
+        mulmods.  The mixnet's bridging commitments ĉ_i = g^{R_i} h^{U_i}
+        and their sigma commitments are exactly this shape."""
+        k = tables.shape[0]
+        acc = None
+        for j in range(k):
+            for w in range(self.nwin8):
+                limb = exps[:, j, w // 2]
+                digit = ((limb >> ((w % 2) * 8))
+                         & jnp.uint32(0xFF)).astype(jnp.int32)
+                sel = tables[j, w][digit]      # (B, n) gather
+                acc = sel if acc is None else self._mm(acc, sel)
         return bn.from_mont_via(self._mm, acc)
 
     # ------------------------------------------------------------------
@@ -338,6 +360,32 @@ class JaxGroupOps:
         table = self.fixed_table(base)
         return run_tiled(
             lambda e: self._fixed_pow_j(table, e), [exp], [False])
+
+    def fixed_multi_pow(self, bases: Sequence[int], exps):
+        """∏_j bases[j]^{exps[:, j]} for k host-known bases via cached
+        tables, one fused ladder per dispatch: exps (B, k, ne) -> (B, n).
+        The shared/fixed-base multi-exp behind the mixnet's permutation
+        proof commitments (tools/bench_bignum.py 'fixedmulti' compares it
+        against k variable-base ladders)."""
+        tables = jnp.stack([self.fixed_table(b) for b in bases])
+        return run_tiled(
+            lambda e: self._fixed_multi_pow_j(tables, e), [exps], [False])
+
+    def cofactor_pow(self, x):
+        """x^((p-1)/q) batched: project arbitrary nonzero residues into
+        the order-q subgroup (independent-generator derivation for the
+        mixnet's Pedersen bases; hash-to-group, dlog-free)."""
+        if self._cofactor_j is None:
+            r = (self.group.p - 1) // self.group.q
+            bits = r.bit_length()
+            r_l = jnp.asarray(bn.int_to_limbs(r, (bits + 15) // 16))
+
+            def impl(xt):
+                e = jnp.broadcast_to(r_l, xt.shape[:-1] + r_l.shape)
+                return bn.powmod(self.ctx, xt, e, bits,
+                                 montmul_fn=self._mm, montsqr_fn=self._ms)
+            self._cofactor_j = jax.jit(impl)
+        return run_tiled(self._cofactor_j, [x], [True])  # 1^r = 1 padding
 
     def prod_reduce(self, x):
         """Product over axis 0: (M, B, n) -> (B, n).  Both the reduced M

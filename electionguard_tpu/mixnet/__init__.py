@@ -1,0 +1,34 @@
+"""Verifiable re-encryption mixnet plane (Terelius–Wikström).
+
+The ballot-anonymization stage that companions an ElectionGuard record
+(PAPERS.md: "A Generalised and Optimised Variant of Wikström's Mixnet",
+arxiv 1901.08371): each mix stage re-encrypts and permutes the cast
+ballots' ciphertext rows and publishes a proof of shuffle, so the link
+between a ballot's position in the record and its position in the mixed
+output is destroyed while anyone can verify no ciphertext was dropped,
+duplicated, or substituted.
+
+Modules:
+
+* ``generators``  — independent Pedersen bases h, h_0..h_{N-1}
+  (hash-to-subgroup via one batched cofactor exponentiation);
+* ``shuffle``     — the batched re-encryption shuffle (one fused device
+  program per power-of-two bucket, same dispatch discipline as the
+  serving plane);
+* ``proof``       — the Terelius–Wikström proof of shuffle (permutation
+  commitment, Fiat–Shamir challenges via ``core.hash``, commitment-
+  consistency and product-argument responses), all commitment
+  exponentiations batched on device;
+* ``stage``       — the ``MixStage`` record artifact + per-stage
+  orchestration (``run_stage``), rows-from-ballots extraction;
+* ``verify_mix``  — batched proof verification with layered, DISTINCT
+  failure classes (structure / chain / membership / binding /
+  permutation / re-encryption), wired into ``verify.verifier`` as the
+  V15 check family.
+
+The mixnet is almost entirely batched modexp/multi-exp — the workload
+shape SZKP-style ZK accelerators target (arxiv 2408.05890); here the
+accelerator is the same fused bignum pipeline the rest of the workflow
+drives.  Everything is instrumented with ``obs`` spans (``mix.shuffle``,
+``mix.prove``, ``mix.verify``) and registry counters.
+"""

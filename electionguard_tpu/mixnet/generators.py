@@ -1,0 +1,66 @@
+"""Independent Pedersen commitment bases for the shuffle proof.
+
+The Terelius–Wikström permutation commitment is binding only if nobody
+knows discrete logs between the bases, so they cannot be ``g^{x_i}`` for
+known ``x_i``.  Standard construction: hash a public seed to candidate
+residues and project them into the order-q subgroup with one cofactor
+exponentiation ``h_i = t_i^{(p-1)/q} mod p`` — a dlog-free
+hash-to-group.  The projection is the only heavy step (a full-width
+exponent ladder) and runs as ONE batched device dispatch over all N+1
+candidates (``JaxGroupOps.cofactor_pow``); results are cached per
+(group, seed, count), so the K stages of one election derive them once.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from electionguard_tpu.core.group import GroupContext
+from electionguard_tpu.core.group_jax import jax_ops
+from electionguard_tpu.core.hash import hash_digest
+
+_lock = threading.Lock()
+#: (group spec name, seed, count) -> [h, h_0, ..., h_{count-1}]
+_cache: dict[tuple, list[int]] = {}
+_CACHE_MAX = 8
+
+
+def generator_seed(extended_base_hash) -> bytes:
+    """The per-election generator seed: every stage of one election uses
+    the same bases, derived from the extended base hash."""
+    return hash_digest("mix-generators", extended_base_hash)
+
+
+def derive_generators(group: GroupContext, seed: bytes,
+                      count: int) -> list[int]:
+    """``count + 1`` independent subgroup generators [h, h_0..h_{count-1}]
+    for ``seed``: candidates t_i = H(seed, i, retry) mod p, projected by
+    one batched cofactor exponentiation; candidates that project to the
+    identity (probability ~1/q per draw) are re-derived host-side."""
+    key = (group.spec.name, seed, count)
+    with _lock:
+        got = _cache.get(key)
+    if got is not None:
+        return got
+    ops = jax_ops(group)
+    p, q = group.p, group.q
+    cand = []
+    for i in range(count + 1):
+        t = int.from_bytes(hash_digest(seed, i, 0), "big") % p
+        cand.append(t if t > 1 else t + 2)
+    out = ops.from_limbs(np.asarray(ops.cofactor_pow(ops.to_limbs_p(cand))))
+    r = (p - 1) // q
+    for i, h in enumerate(out):
+        retry = 1
+        while h == 1:  # negligible-probability path; rehash until useful
+            t = int.from_bytes(hash_digest(seed, i, retry), "big") % p
+            h = pow(t if t > 1 else t + 2, r, p)
+            retry += 1
+        out[i] = h
+    with _lock:
+        while len(_cache) >= _CACHE_MAX:
+            _cache.pop(next(iter(_cache)))
+        _cache[key] = out
+    return out

@@ -1,0 +1,117 @@
+"""Batched re-encryption shuffle: the mixnet's data plane.
+
+One mix stage takes N rows of W ElGamal ciphertexts (one row per cast
+ballot, one column per selection), samples a permutation π and fresh
+re-encryption randomness r̃ on the host, and computes
+
+    Ã_{i,w} = A_{π(i),w} · g^{r̃_{i,w}}      B̃_{i,w} = B_{π(i),w} · K^{r̃_{i,w}}
+
+for every element in ONE fused device program per dispatch: both
+fixed-base ladders (g and K PowRadix tables) plus the two Montgomery
+combines, compiled once per power-of-two bucket shape via the shared
+``run_tiled`` policy — the same one-compile-per-bucket discipline the
+serving batcher enforces (serve/batcher.py), so K sequential stages of
+the same record never recompile after the first.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from electionguard_tpu.core.group import GroupContext
+from electionguard_tpu.core.group_jax import jax_exp_ops, jax_ops, \
+    run_tiled_multi
+from electionguard_tpu.core.hash import hash_digest
+from electionguard_tpu.obs import REGISTRY, span
+
+
+def prf_scalars(seed: bytes, tag: str, count: int, q: int) -> list[int]:
+    """Deterministic Z_q scalars from a secret seed: H(seed, tag, i) mod q.
+    The mixer's nonce PRF — same posture as the encryptor's seed-derived
+    nonces (uniform enough mod q: 256-bit digest, q ≤ 256 bits)."""
+    return [int.from_bytes(hash_digest(seed, tag, i), "big") % q
+            for i in range(count)]
+
+
+def prf_permutation(seed: bytes, n: int) -> np.ndarray:
+    """Deterministic permutation of range(n) from the seed (Fisher–Yates
+    with PRF draws, so a seeded stage is exactly reproducible)."""
+    perm = np.arange(n, dtype=np.int64)
+    for i in range(n - 1, 0, -1):
+        j = int.from_bytes(hash_digest(seed, "perm", i), "big") % (i + 1)
+        perm[i], perm[j] = perm[j], perm[i]
+    return perm
+
+
+@functools.lru_cache(maxsize=8)
+def get_shuffler(group: GroupContext, public_key: int) -> "Shuffler":
+    """Process-wide shuffler per (group, key): the jitted re-encryption
+    program is cached on the instance, so K stages (and repeated
+    ``run_stage`` calls) share one compiled program set."""
+    return Shuffler(group, public_key)
+
+
+class Shuffler:
+    """Re-encryption engine for one (group, public key) pair."""
+
+    def __init__(self, group: GroupContext, public_key: int):
+        self.group = group
+        self.public_key = public_key
+        self.ops = jax_ops(group)
+        self.eops = jax_exp_ops(group)
+        self._k_table = self.ops.fixed_table(public_key)
+        self._reenc_j = jax.jit(self._reenc_impl)
+
+    def _reenc_impl(self, a, b, r):
+        """One fused program: (A·g^r, B·K^r) for a tile of elements."""
+        ops = self.ops
+        gr = ops._fixed_pow_impl(ops.g_table, r)
+        kr = ops._fixed_pow_impl(self._k_table, r)
+        return ops._mulmod_impl(a, gr), ops._mulmod_impl(b, kr)
+
+    def reencrypt(self, pads_l: np.ndarray, datas_l: np.ndarray,
+                  r_l: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Batched (M, n) limb re-encryption through the bucketed
+        dispatch policy (pad rows are the identity ciphertext (1,1) with
+        r = 0, so padding re-encrypts to itself)."""
+        out = run_tiled_multi(self._reenc_j, [pads_l, datas_l, r_l],
+                              [True, True, False])
+        return np.asarray(out[0]), np.asarray(out[1])
+
+    def shuffle(self, pads: Sequence[Sequence[int]],
+                datas: Sequence[Sequence[int]],
+                seed: bytes,
+                perm: Optional[np.ndarray] = None,
+                ) -> tuple[list[list[int]], list[list[int]],
+                           np.ndarray, list[list[int]]]:
+        """Shuffle N rows of W ciphertexts.  Returns
+        ``(out_pads, out_datas, perm, rand)`` where output row i is the
+        re-encryption of input row perm[i] under randomness rand[i][w].
+        ``perm`` may be injected by a (test-only) caller; honest callers
+        leave it None and get the PRF permutation for ``seed``."""
+        n = len(pads)
+        w = len(pads[0]) if n else 0
+        if any(len(r) != w for r in pads) or any(len(r) != w for r in datas):
+            raise ValueError("mix rows must have uniform width")
+        if perm is None:
+            perm = prf_permutation(seed, n)
+        flat_r = prf_scalars(seed, "reenc", n * w, self.group.q)
+        rand = [flat_r[i * w:(i + 1) * w] for i in range(n)]
+        attrs = {"n": n, "w": w}
+        with span("mix.shuffle", attrs):
+            a_in = [pads[perm[i]][j] for i in range(n) for j in range(w)]
+            b_in = [datas[perm[i]][j] for i in range(n) for j in range(w)]
+            a_out, b_out = self.reencrypt(
+                self.ops.to_limbs_p(a_in), self.ops.to_limbs_p(b_in),
+                self.eops.to_limbs(flat_r))
+            a_i = self.ops.from_limbs(a_out)
+            b_i = self.ops.from_limbs(b_out)
+        REGISTRY.counter("mix_rows_shuffled_total").inc(n)
+        REGISTRY.counter("mix_ciphertexts_reencrypted_total").inc(n * w)
+        out_pads = [a_i[i * w:(i + 1) * w] for i in range(n)]
+        out_datas = [b_i[i * w:(i + 1) * w] for i in range(n)]
+        return out_pads, out_datas, perm, rand

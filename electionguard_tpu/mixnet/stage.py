@@ -1,0 +1,77 @@
+"""The ``MixStage`` record artifact + per-stage orchestration.
+
+A stage is what gets published: the stage's output ciphertext rows, the
+binding hash of its input rows, and the full shuffle-proof transcript.
+Stage k's input is stage k-1's output; stage 0's input is the cast
+ballots' selection ciphertexts in record order (``rows_from_ballots``),
+so the whole cascade is re-verifiable from the election record alone.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+import numpy as np
+
+from electionguard_tpu.ballot.ciphertext import BallotState
+from electionguard_tpu.core.group import GroupContext
+from electionguard_tpu.mixnet.proof import MixProof, prove_shuffle, \
+    rows_digest
+from electionguard_tpu.mixnet.shuffle import Shuffler, get_shuffler
+
+
+@dataclass
+class MixStage:
+    """One published mix stage: output rows + proof transcript."""
+
+    stage_index: int
+    n_rows: int
+    width: int
+    input_hash: bytes              # rows_digest of this stage's INPUT
+    pads: list                     # N x W output α values (ints)
+    datas: list                    # N x W output β values (ints)
+    proof: MixProof
+
+
+def rows_from_ballots(ballots: Iterable) -> tuple[list, list]:
+    """Stage-0 input rows: one row per CAST ballot (record order), one
+    column per selection ciphertext in serialized contest/selection
+    order (placeholders included — the mixnet permutes whole ballots)."""
+    pads: list = []
+    datas: list = []
+    for b in ballots:
+        if b.state != BallotState.CAST:
+            continue
+        row_a, row_b = [], []
+        for c in b.contests:
+            for s in c.selections:
+                row_a.append(s.ciphertext.pad.value)
+                row_b.append(s.ciphertext.data.value)
+        pads.append(row_a)
+        datas.append(row_b)
+    return pads, datas
+
+
+def run_stage(group: GroupContext, public_key: int, qbar,
+              stage_index: int, in_pads, in_datas,
+              seed: Optional[bytes] = None,
+              shuffler: Optional[Shuffler] = None,
+              perm: Optional[np.ndarray] = None) -> MixStage:
+    """Shuffle + prove one stage.  ``seed`` pins the stage (tests,
+    reproducible runs); None draws a fresh secret.  ``perm`` is a
+    test-only injection point for adversarial permutations."""
+    if not in_pads:
+        raise ValueError("mix stage needs at least one input row")
+    seed = seed if seed is not None else secrets.token_bytes(32)
+    sh = shuffler if shuffler is not None else get_shuffler(group,
+                                                            public_key)
+    out_pads, out_datas, perm, rand = sh.shuffle(
+        in_pads, in_datas, seed, perm=perm)
+    input_hash = rows_digest(group, in_pads, in_datas)
+    proof = prove_shuffle(group, public_key, qbar, stage_index,
+                          in_pads, in_datas, out_pads, out_datas,
+                          perm, rand, seed, input_hash=input_hash)
+    return MixStage(stage_index, len(in_pads), len(in_pads[0]),
+                    input_hash, out_pads, out_datas, proof)

@@ -33,6 +33,16 @@ from jax.sharding import PartitionSpec as P
 from electionguard_tpu.parallel.mesh import DP_AXIS, WP_AXIS
 
 
+def _is_initialized() -> bool:
+    """jax.distributed.is_initialized where it exists (>= 0.5); older
+    releases expose only the internal global_state client handle."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    state = getattr(jax.distributed, "global_state", None)
+    return state is not None and getattr(state, "client", None) is not None
+
+
 def distributed_init(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None) -> None:
@@ -42,7 +52,7 @@ def distributed_init(coordinator_address: Optional[str] = None,
     EGTPU_PROCESS_ID environment variables; on TPU pods all three may be
     None and jax discovers the topology itself.
     """
-    if jax.distributed.is_initialized():  # idempotent
+    if _is_initialized():  # idempotent
         return
     coordinator_address = coordinator_address or os.environ.get(
         "EGTPU_COORDINATOR")

@@ -33,14 +33,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map as _shard_map
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
 # check_vma=False: kernel bodies create fresh zero-carries inside lax.scan
 # (bignum_jax.montmul), which the varying-manual-axes checker would reject
-# even though every output is honestly dp-varying.
-shard_map = functools.partial(_shard_map, check_vma=False)
+# even though every output is honestly dp-varying.  Older jax releases
+# (< 0.6) ship shard_map under jax.experimental with the same checker
+# spelled check_rep — accept either so the sharded plane runs on both.
+try:
+    from jax import shard_map as _shard_map
+    shard_map = functools.partial(_shard_map, check_vma=False)
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+    shard_map = functools.partial(_shard_map, check_rep=False)
 
 from electionguard_tpu.core import bignum_jax as bn
 from electionguard_tpu.parallel.mesh import DP_AXIS, WP_AXIS

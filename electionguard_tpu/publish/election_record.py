@@ -105,3 +105,4 @@ class ElectionRecord:
     tally_result: Optional[TallyResult] = None
     decryption_result: Optional[DecryptionResult] = None
     spoiled_ballot_tallies: list = field(default_factory=list)
+    mix_stages: list = field(default_factory=list)  # mixnet.stage.MixStage

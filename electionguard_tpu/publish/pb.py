@@ -99,3 +99,6 @@ PlaintextTallyContest = msg("PlaintextTallyContest")
 PlaintextTally = msg("PlaintextTally")
 DecryptingGuardian = msg("DecryptingGuardian")
 DecryptionResult = msg("DecryptionResult")
+MixRow = msg("MixRow")
+MixProof = msg("MixProof")
+MixStageHeader = msg("MixStageHeader")

@@ -47,6 +47,7 @@ _BALLOTS = "encrypted_ballots.pb"
 _TALLY = "tally_result.pb"
 _DECRYPTION = "decryption_result.pb"
 _SPOILED = "spoiled_ballot_tallies.pb"
+_MIX_FMT = "mix_stage_{:03d}.pb"   # framed: header frame + n_rows MixRow
 
 
 def _write_frame(f, data: bytes):
@@ -172,6 +173,23 @@ class Publisher:
         os.makedirs(d, exist_ok=True)
         with open(os.path.join(d, f"{ballot.ballot_id}.json"), "w") as f:
             f.write(ballot.to_json())
+
+    def write_mix_stage(self, group: GroupContext, stage) -> str:
+        """Publish one mix stage as a framed, fsync'd stream: frame 0 is
+        the MixStageHeader (binding hash + proof transcript), then
+        ``n_rows`` MixRow frames — the same durable framing discipline
+        as the encrypted-ballot stream, so stages survive a crash with
+        at worst a truncated (detectable) tail."""
+        path = self._path(_MIX_FMT.format(stage.stage_index))
+        with open(path, "wb") as f:
+            _write_frame(f, serialize.publish_mix_header(
+                group, stage).SerializeToString())
+            for row_a, row_b in zip(stage.pads, stage.datas):
+                _write_frame(f, serialize.publish_mix_row(
+                    group, row_a, row_b).SerializeToString())
+            f.flush()
+            os.fsync(f.fileno())
+        return path
 
 
 def repair_frame_stream(path: str) -> tuple[int, Optional[bytes]]:
@@ -307,6 +325,43 @@ class Consumer:
             m = pb.PlaintextTally()
             m.ParseFromString(frame)
             yield serialize.import_plaintext_tally(self.group, m)
+
+    def mix_stage_count(self) -> int:
+        """Contiguous published mix stages (stage files must be densely
+        numbered from 0; a gap ends the cascade)."""
+        n = 0
+        while os.path.exists(self._path(_MIX_FMT.format(n))):
+            n += 1
+        return n
+
+    def has_mix_stages(self) -> bool:
+        return self.mix_stage_count() > 0
+
+    def read_mix_stage(self, k: int):
+        """Decode one published stage (header + all rows resident — a
+        stage is O(cast ballots), the mix plane's working set)."""
+        from electionguard_tpu.mixnet.stage import MixStage
+        path = self._path(_MIX_FMT.format(k))
+        frames = _read_frames(path)
+        hm = pb.MixStageHeader()
+        hm.ParseFromString(next(frames))
+        proof = serialize.import_mix_proof(self.group, hm.proof)
+        pads, datas = [], []
+        for frame in frames:
+            rm = pb.MixRow()
+            rm.ParseFromString(frame)
+            row_a, row_b = serialize.import_mix_row(self.group, rm)
+            pads.append(row_a)
+            datas.append(row_b)
+        if len(pads) != int(hm.n_rows):
+            raise IOError(f"mix stage {k}: {len(pads)} row frames != "
+                          f"header n_rows {int(hm.n_rows)}")
+        return MixStage(int(hm.stage_index), int(hm.n_rows),
+                        int(hm.width), serialize.import_u256(hm.input_hash),
+                        pads, datas, proof)
+
+    def read_mix_stages(self) -> list:
+        return [self.read_mix_stage(k) for k in range(self.mix_stage_count())]
 
     def iterate_plaintext_ballots(self, subdir: str) -> Iterator[PlaintextBallot]:
         d = self._path(subdir)

@@ -366,6 +366,85 @@ def import_decryption_result(g: GroupContext, m) -> DecryptionResult:
 
 
 # ---------------------------------------------------------------------------
+# mixnet plane (publish/consume MixStage streams — mixnet/stage.py)
+# ---------------------------------------------------------------------------
+
+
+def _pub_p_int(g: GroupContext, v: int):
+    """Int-valued ElementModP (the mixnet plane works in plain ints)."""
+    return pb.ElementModP(value=v.to_bytes(g.spec.p_bytes, "big"))
+
+
+def _imp_p_int(g: GroupContext, m) -> int:
+    return import_p(g, m).value  # width + range validated
+
+
+def _pub_q_int(g: GroupContext, v: int):
+    return pb.ElementModQ(value=v.to_bytes(g.spec.q_bytes, "big"))
+
+
+def _imp_q_int(g: GroupContext, m) -> int:
+    return import_q(g, m).value
+
+
+def publish_mix_proof(g: GroupContext, pr):
+    return pb.MixProof(
+        permutation_commitments=[_pub_p_int(g, v)
+                                 for v in pr.permutation_commitments],
+        chain_commitments=[_pub_p_int(g, v) for v in pr.chain_commitments],
+        t1=_pub_p_int(g, pr.t1), t2=_pub_p_int(g, pr.t2),
+        t3=_pub_p_int(g, pr.t3),
+        t41=[_pub_p_int(g, v) for v in pr.t41],
+        t42=[_pub_p_int(g, v) for v in pr.t42],
+        that=[_pub_p_int(g, v) for v in pr.that],
+        challenge=_pub_q_int(g, pr.challenge),
+        v1=_pub_q_int(g, pr.v1), v2=_pub_q_int(g, pr.v2),
+        v3=_pub_q_int(g, pr.v3),
+        v4=[_pub_q_int(g, v) for v in pr.v4],
+        vhat=[_pub_q_int(g, v) for v in pr.vhat],
+        vprime=[_pub_q_int(g, v) for v in pr.vprime])
+
+
+def import_mix_proof(g: GroupContext, m):
+    from electionguard_tpu.mixnet.proof import MixProof
+    return MixProof(
+        permutation_commitments=tuple(_imp_p_int(g, v)
+                                      for v in m.permutation_commitments),
+        chain_commitments=tuple(_imp_p_int(g, v)
+                                for v in m.chain_commitments),
+        t1=_imp_p_int(g, m.t1), t2=_imp_p_int(g, m.t2),
+        t3=_imp_p_int(g, m.t3),
+        t41=tuple(_imp_p_int(g, v) for v in m.t41),
+        t42=tuple(_imp_p_int(g, v) for v in m.t42),
+        that=tuple(_imp_p_int(g, v) for v in m.that),
+        challenge=_imp_q_int(g, m.challenge),
+        v1=_imp_q_int(g, m.v1), v2=_imp_q_int(g, m.v2),
+        v3=_imp_q_int(g, m.v3),
+        v4=tuple(_imp_q_int(g, v) for v in m.v4),
+        vhat=tuple(_imp_q_int(g, v) for v in m.vhat),
+        vprime=tuple(_imp_q_int(g, v) for v in m.vprime))
+
+
+def publish_mix_header(g: GroupContext, stage):
+    return pb.MixStageHeader(
+        stage_index=stage.stage_index, n_rows=stage.n_rows,
+        width=stage.width, input_hash=publish_u256(stage.input_hash),
+        proof=publish_mix_proof(g, stage.proof))
+
+
+def publish_mix_row(g: GroupContext, row_pads, row_datas):
+    return pb.MixRow(ciphertexts=[
+        pb.ElGamalCiphertext(pad=_pub_p_int(g, a), data=_pub_p_int(g, b))
+        for a, b in zip(row_pads, row_datas)])
+
+
+def import_mix_row(g: GroupContext, m) -> tuple[list, list]:
+    pads = [_imp_p_int(g, c.pad) for c in m.ciphertexts]
+    datas = [_imp_p_int(g, c.data) for c in m.ciphertexts]
+    return pads, datas
+
+
+# ---------------------------------------------------------------------------
 # serving plane (plaintext ballots over the wire — serve/service.py)
 # ---------------------------------------------------------------------------
 

@@ -88,13 +88,20 @@ class Verifier:
 
     def __init__(self, record: ElectionRecord,
                  group: Optional[GroupContext] = None,
-                 chunk_size: int = 4096, mesh=None):
+                 chunk_size: int = 4096, mesh=None,
+                 mix_input_fn=None):
         """``mesh``: an ``electionguard_tpu.parallel.mesh`` device mesh —
         when given (and the group supports the fused path), the V4/V5
         device programs shard the selection/contest batch axis over the
         mesh's dp axis, scaling verification across chips the way the
         reference scales it across 11 CPU threads
-        (RunRemoteWorkflowTest.java:180)."""
+        (RunRemoteWorkflowTest.java:180).
+
+        ``mix_input_fn``: zero-arg callable returning the mixnet's
+        stage-0 input ``(pads, datas)`` rows when the record carries mix
+        stages and the ballot stream is not re-iterable (run_verifier
+        passes a fresh Consumer iteration); with an in-memory ballot
+        list the rows are derived directly."""
         self.record = record
         self.group = group if group is not None else \
             record.election_init.joint_public_key.group
@@ -103,6 +110,7 @@ class Verifier:
         self.init = record.election_init
         self.chunk_size = chunk_size
         self.mesh = mesh
+        self.mix_input_fn = mix_input_fn
 
     def _fused(self):
         """The fused on-device V4/V5 checker for this verifier's batch
@@ -202,6 +210,8 @@ class Verifier:
             self._v8_to_v12_decryption(res)
         self._v13_spoiled(res, agg)
         self._v14_coherence(res)
+        if self.record.mix_stages:
+            self._v15_mixnet(res)
         return res
 
     # ==================================================================
@@ -871,6 +881,24 @@ class Verifier:
                                    f"contest {c.contest_id}")
             self._verify_tally_shares(res, t, avail, labels)
         res.record("V13.spoiled", True)
+
+    def _v15_mixnet(self, res):
+        """Mix cascade verification (mixnet/verify_mix.py): stage 0 must
+        re-encrypt exactly the record's cast ballots, every stage must
+        chain, and every Terelius–Wikström transcript must verify."""
+        from electionguard_tpu.mixnet import verify_mix
+        fn = self.mix_input_fn
+        if fn is None:
+            ballots = self.record.encrypted_ballots
+            if isinstance(ballots, (list, tuple)):
+                fn = lambda: verify_mix.rows_from_ballots(ballots)  # noqa: E731
+        if fn is None:
+            res.record("V15.mix_structure", False,
+                       "mix stages present but the ballot stream is not "
+                       "re-iterable and no mix_input_fn was given")
+            return
+        verify_mix.verify_stages(self.group, self.init,
+                                 self.record.mix_stages, res, fn)
 
     def _v14_coherence(self, res):
         msgs = validate_manifest(self.init.config.manifest)

@@ -98,6 +98,11 @@ def main(argv=None) -> int:
     ap.add_argument("-nselections", type=int, default=2)
     ap.add_argument("-group", choices=["production", "tiny"],
                     default="tiny")
+    ap.add_argument("-mix", type=int, default=0,
+                    help="run N re-encryption mix stages between tally "
+                         "accumulation and decryption (0 = none); the "
+                         "published mix cascade is checked by the "
+                         "verifier's V15 family in phase 5")
     ap.add_argument("-spoilEvery", dest="spoil_every", type=int, default=5,
                     help="spoil every Nth ballot (0 = none); spoiled "
                          "ballots are decrypted in phase 4 and checked by "
@@ -242,6 +247,19 @@ def main(argv=None) -> int:
     if not wait_all([acc], timeout=300):
         return phase_fail("accumulate", [acc])
     log.info("[3] tally accumulation took %.1fs", time.time() - t0)
+
+    # ---- phase 3.5: mixnet (optional) -------------------------------------
+    if args.mix > 0:
+        t0 = time.time()
+        phases.begin("phase.mix")
+        mix = RunCommand.python_module(
+            "mixnet", "electionguard_tpu.cli.run_mixnet",
+            ["-in", record_dir, "-out", record_dir,
+             "-stages", str(args.mix)] + group_flags, cmd_out)
+        if not wait_all([mix], timeout=600):
+            return phase_fail("mixnet", [mix])
+        log.info("[3.5] %d mix stages took %.1fs", args.mix,
+                 time.time() - t0)
 
     # ---- phase 4: remote decryption (multi-process) ----------------------
     t0 = time.time()

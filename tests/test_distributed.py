@@ -34,7 +34,8 @@ from electionguard_tpu.core.group import tiny_group
 from electionguard_tpu.core.group_jax import JaxGroupOps
 from electionguard_tpu.core import bignum_jax as bn
 import jax.numpy as jnp
-from jax import shard_map as _sm
+# version-portable shard_map (check_vma on new jax, check_rep on old)
+from electionguard_tpu.parallel.sharded import shard_map as _sm
 assert jax.process_count() == 2, jax.process_count()
 assert len(jax.devices()) == 8, len(jax.devices())
 
@@ -51,8 +52,7 @@ E = ops.to_limbs_q(exps)
 
 mapped = _sm(
     ops._powmod_impl, mesh=mesh,
-    in_specs=(P(DP_AXIS), P(DP_AXIS)), out_specs=P(DP_AXIS),
-    check_vma=False)
+    in_specs=(P(DP_AXIS), P(DP_AXIS)), out_specs=P(DP_AXIS))
 
 
 @jax.jit

@@ -63,6 +63,24 @@ def test_five_phase_workflow_chaos_guardian_restart(tmp_path):
     assert "RESUMED mid-ceremony" in log
 
 
+def test_five_phase_workflow_mixed(tmp_path):
+    """The workflow with the optional mixnet phase: 2 re-encryption mix
+    stages run between tally accumulation and decryption, the published
+    cascade rides in the record dir, and phase-5 verification checks the
+    V15 family as part of the same run."""
+    proc = _run_workflow(tmp_path, "tiny", nballots=8, timeout=600,
+                         extra_flags=["-mix", "2"])
+    out = proc.stdout + proc.stderr
+    assert "2 mix stages took" in out
+    # the verifier's summary (dumped by ver.show()) is green for the
+    # whole V15 family
+    for check in ("mix_structure", "mix_chain", "mix_membership",
+                  "mix_binding", "mix_permutation", "mix_reencryption"):
+        assert f"PASS V15.{check}" in out, out
+    assert os.path.exists(os.path.join(
+        str(tmp_path), "record", "mix_stage_001.pb"))
+
+
 def test_five_phase_workflow_traced(tmp_path):
     """Observability acceptance: one traced e2e run yields a merged
     Chrome-trace timeline with spans from every spawned process under a

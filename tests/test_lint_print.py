@@ -36,11 +36,13 @@ def _bare_prints(path: str) -> list[int]:
 
 def test_no_bare_print_in_library_code():
     offenders = []
+    scanned_pkgs = set()
     for root, dirs, files in os.walk(PKG_DIR):
         rel = os.path.relpath(root, PKG_DIR)
         top = rel.split(os.sep)[0]
         if top in EXEMPT_DIRS or "__pycache__" in root:
             continue
+        scanned_pkgs.add(top)
         for name in sorted(files):
             if not name.endswith(".py"):
                 continue
@@ -48,6 +50,9 @@ def test_no_bare_print_in_library_code():
             for lineno in _bare_prints(path):
                 offenders.append(
                     f"{os.path.relpath(path, PKG_DIR)}:{lineno}")
+    # the walk is recursive by construction; pin the newer packages so a
+    # future layout change can't silently drop them from the lint
+    assert {"mixnet", "obs", "serve"} <= scanned_pkgs
     assert not offenders, (
         "bare print() in library code (use logging — obs.slog mirrors "
         "it as structured JSONL with trace context):\n  "

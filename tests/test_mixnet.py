@@ -1,0 +1,303 @@
+"""Mixnet plane tests (tiny group, non-slow).
+
+Covers the full vertical slice: the batched re-encryption shuffle
+preserves plaintexts, the Terelius–Wikström proof round-trips through
+the published record format, an honest multi-stage cascade re-verifies
+green through the real ``run_verifier`` binary path, the three
+adversarial cases (tampered output ciphertext, wrong permutation,
+replayed transcript) each fail with a DISTINCT error class, and the
+bucketed dispatch discipline holds (a second same-shape stage compiles
+nothing new — the ``device_compiles`` acceptance assertion).
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+from electionguard_tpu.core.group import tiny_group
+from electionguard_tpu.crypto.elgamal import ElGamalKeypair, elgamal_encrypt
+from electionguard_tpu.mixnet import verify_mix
+from electionguard_tpu.mixnet.generators import derive_generators, \
+    generator_seed
+from electionguard_tpu.mixnet.proof import prove_shuffle, rows_digest
+from electionguard_tpu.mixnet.shuffle import Shuffler, prf_permutation
+from electionguard_tpu.mixnet.stage import MixStage, rows_from_ballots, \
+    run_stage
+from electionguard_tpu.verify.verifier import VerificationResult
+
+
+@pytest.fixture(scope="module")
+def mixkey():
+    g = tiny_group()
+    return ElGamalKeypair.from_secret(g.int_to_q(987654321))
+
+
+def _encrypt_rows(g, K, n, w, seed=1000):
+    pads, datas = [], []
+    for i in range(n):
+        row_a, row_b = [], []
+        for j in range(w):
+            ct = elgamal_encrypt(g, (i + j) % 2,
+                                 g.int_to_q(seed + i * w + j), K)
+            row_a.append(ct.pad.value)
+            row_b.append(ct.data.value)
+        pads.append(row_a)
+        datas.append(row_b)
+    return pads, datas
+
+
+class _Init:
+    """The two ElectionInitialized fields the mix plane reads."""
+
+    def __init__(self, K, qbar):
+        self.joint_public_key = K
+        self.extended_base_hash = qbar
+
+
+def _qbar(g):
+    return g.int_to_q(424242)
+
+
+# ---------------------------------------------------------------------------
+# shuffle data plane
+# ---------------------------------------------------------------------------
+
+def test_shuffle_preserves_plaintexts(mixkey):
+    g = tiny_group()
+    K, s = mixkey.public_key, mixkey.secret_key
+    pads, datas = _encrypt_rows(g, K, 12, 2)
+    sh = Shuffler(g, K.value)
+    out_p, out_d, perm, rand = sh.shuffle(pads, datas, b"seed")
+    assert sorted(perm) == list(range(12))
+
+    def decrypt_row(row_a, row_b):
+        from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
+        from electionguard_tpu.core.group import ElementModP
+        return tuple(
+            ElGamalCiphertext(ElementModP(a, g),
+                              ElementModP(b, g)).decrypt(s)
+            for a, b in zip(row_a, row_b))
+
+    before = sorted(decrypt_row(a, b) for a, b in zip(pads, datas))
+    after = sorted(decrypt_row(a, b) for a, b in zip(out_p, out_d))
+    assert before == after
+    # every ciphertext actually re-encrypted (fresh randomness)
+    assert all(out_p[i][j] != pads[perm[i]][j]
+               for i in range(12) for j in range(2))
+    # output row i re-encrypts input row perm[i] with the returned rand
+    i = 3
+    assert out_p[i][0] == pads[perm[i]][0] * pow(g.g, rand[i][0],
+                                                 g.p) % g.p
+
+
+def test_shuffle_rejects_ragged_rows(mixkey):
+    g = tiny_group()
+    pads, datas = _encrypt_rows(g, mixkey.public_key, 4, 2)
+    pads[2] = pads[2][:1]
+    with pytest.raises(ValueError, match="uniform width"):
+        Shuffler(g, mixkey.public_key.value).shuffle(pads, datas, b"s")
+
+
+def test_prf_permutation_deterministic():
+    assert list(prf_permutation(b"x", 50)) == list(prf_permutation(b"x", 50))
+    assert list(prf_permutation(b"x", 50)) != list(prf_permutation(b"y", 50))
+
+
+# ---------------------------------------------------------------------------
+# generators + core multi-exp
+# ---------------------------------------------------------------------------
+
+def test_generators_in_subgroup_and_cached():
+    g = tiny_group()
+    seed = generator_seed(_qbar(g))
+    hs = derive_generators(g, seed, 8)
+    assert len(hs) == 9
+    assert len(set(hs)) == 9
+    for h in hs:
+        assert h != 1 and pow(h, g.q, g.p) == 1
+    assert derive_generators(g, seed, 8) is hs  # cache hit
+
+
+def test_fixed_multi_pow_matches_host(mixkey):
+    g = tiny_group()
+    from electionguard_tpu.core.group_jax import jax_ops
+    ops = jax_ops(g)
+    K = mixkey.public_key.value
+    es = [(i * 7919 + 13, i * 104729 + 5) for i in range(9)]
+    exps = np.stack([ops.to_limbs_q([a for a, _ in es]),
+                     ops.to_limbs_q([b for _, b in es])], axis=1)
+    got = ops.from_limbs(np.asarray(ops.fixed_multi_pow([g.g, K], exps)))
+    want = [pow(g.g, a, g.p) * pow(K, b, g.p) % g.p for a, b in es]
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# proof: honest cascade + the three distinct adversarial rejections
+# ---------------------------------------------------------------------------
+
+def _two_stage_cascade(g, K, qbar, n=16, w=2):
+    pads, datas = _encrypt_rows(g, K, n, w)
+    s0 = run_stage(g, K.value, qbar, 0, pads, datas, seed=b"stage0")
+    s1 = run_stage(g, K.value, qbar, 1, s0.pads, s0.datas, seed=b"stage1")
+    return pads, datas, [s0, s1]
+
+
+def test_honest_cascade_verifies(mixkey):
+    g = tiny_group()
+    K, qbar = mixkey.public_key, _qbar(g)
+    pads, datas, stages = _two_stage_cascade(g, K, qbar)
+    res = VerificationResult()
+    ok = verify_mix.verify_stages(g, _Init(K, qbar), stages, res,
+                                  lambda: (pads, datas))
+    assert ok and res.ok, res.summary()
+    for name in verify_mix.CHECKS:
+        assert res.checks[f"V15.{name}"]
+
+
+def test_tampered_output_ciphertext_rejected(mixkey):
+    """An output ciphertext modified after proving fails the BINDING
+    layer (the Fiat–Shamir challenge no longer re-derives) — and only
+    that layer is reported."""
+    g = tiny_group()
+    K, qbar = mixkey.public_key, _qbar(g)
+    pads, datas, stages = _two_stage_cascade(g, K, qbar)
+    bad = copy.deepcopy(stages[1])
+    bad.pads[0][0] = bad.pads[0][0] * g.g % g.p  # stays in the subgroup
+    res = VerificationResult()
+    ok = verify_mix.verify_stages(g, _Init(K, qbar), [stages[0], bad],
+                                  res, lambda: (pads, datas))
+    assert not ok and not res.ok
+    assert not res.checks["V15.mix_binding"]
+    assert all("mix_binding" in e for e in res.errors), res.errors
+
+
+def test_wrong_permutation_rejected(mixkey):
+    """A cheating mixer whose outputs do not follow its committed
+    permutation (rows swapped relative to the proof's secrets) produces
+    a transcript that BINDS (it hashed what it published) but fails the
+    RE-ENCRYPTION consistency equations — a distinct error class."""
+    g = tiny_group()
+    K, qbar = mixkey.public_key, _qbar(g)
+    pads, datas = _encrypt_rows(g, K, 16, 2)
+    sh = Shuffler(g, K.value)
+    out_p, out_d, perm, rand = sh.shuffle(pads, datas, b"cheat")
+    out_p[0], out_p[1] = out_p[1], out_p[0]
+    out_d[0], out_d[1] = out_d[1], out_d[0]
+    ih = rows_digest(g, pads, datas)
+    proof = prove_shuffle(g, K.value, qbar, 0, pads, datas, out_p, out_d,
+                          perm, rand, b"cheat", input_hash=ih)
+    cheat = MixStage(0, 16, 2, ih, out_p, out_d, proof)
+    res = VerificationResult()
+    ok = verify_mix.verify_stages(g, _Init(K, qbar), [cheat], res,
+                                  lambda: (pads, datas))
+    assert not ok and not res.ok
+    assert not res.checks["V15.mix_reencryption"]
+    assert all("mix_reencryption" in e for e in res.errors), res.errors
+    assert res.checks["V15.mix_binding"]  # transcript DID bind
+
+
+def test_replayed_transcript_rejected(mixkey):
+    """A proof transcript replayed from a different input fails the
+    CHAIN layer (stage input hash does not match its predecessor's
+    output) before any crypto runs — the third distinct error class."""
+    g = tiny_group()
+    K, qbar = mixkey.public_key, _qbar(g)
+    pads, datas, stages = _two_stage_cascade(g, K, qbar)
+    other_pads, other_datas = _encrypt_rows(g, K, 16, 2, seed=9999)
+    replay = run_stage(g, K.value, qbar, 1, other_pads, other_datas,
+                       seed=b"replay")
+    res = VerificationResult()
+    ok = verify_mix.verify_stages(g, _Init(K, qbar),
+                                  [stages[0], replay], res,
+                                  lambda: (pads, datas))
+    assert not ok and not res.ok
+    assert not res.checks["V15.mix_chain"]
+    assert all("mix_chain" in e for e in res.errors), res.errors
+
+
+def test_stage_index_mismatch_rejected(mixkey):
+    g = tiny_group()
+    K, qbar = mixkey.public_key, _qbar(g)
+    pads, datas, stages = _two_stage_cascade(g, K, qbar)
+    res = VerificationResult()
+    ok = verify_mix.verify_stages(g, _Init(K, qbar),
+                                  [stages[1], stages[0]], res,
+                                  lambda: (pads, datas))
+    assert not ok and not res.checks["V15.mix_structure"]
+
+
+# ---------------------------------------------------------------------------
+# bucketed dispatch: one compile per bucket shape
+# ---------------------------------------------------------------------------
+
+def test_second_stage_compiles_nothing(mixkey):
+    """The acceptance assertion: after stage 0 has warmed every bucket
+    shape (shuffle, prove, verify), a second same-shape stage — shuffle,
+    prove, AND verify — adds ZERO backend compiles (the
+    ``device_compiles`` counter stays flat, like the serving plane under
+    load)."""
+    from electionguard_tpu.obs import jaxmon
+    jaxmon.install()
+    g = tiny_group()
+    K, qbar = mixkey.public_key, _qbar(g)
+    pads, datas = _encrypt_rows(g, K, 16, 2, seed=5000)
+    s0 = run_stage(g, K.value, qbar, 0, pads, datas, seed=b"warm")
+    res = VerificationResult()
+    assert verify_mix.verify_stages(g, _Init(K, qbar), [s0], res,
+                                    lambda: (pads, datas))
+    before = jaxmon.compile_count()
+    s1 = run_stage(g, K.value, qbar, 1, s0.pads, s0.datas, seed=b"hot")
+    res2 = VerificationResult()
+    assert verify_mix.verify_stages(
+        g, _Init(K, qbar), [s0, s1], res2, lambda: (pads, datas))
+    assert jaxmon.compile_count() == before, \
+        "a same-shape mix stage must not recompile any device program"
+
+
+# ---------------------------------------------------------------------------
+# published record: serialization + the real verifier binary path
+# ---------------------------------------------------------------------------
+
+def test_stage_serialization_roundtrip(tmp_path, mixkey):
+    g = tiny_group()
+    K, qbar = mixkey.public_key, _qbar(g)
+    pads, datas = _encrypt_rows(g, K, 8, 2)
+    stage = run_stage(g, K.value, qbar, 0, pads, datas, seed=b"ser")
+    from electionguard_tpu.publish.publisher import Consumer, Publisher
+    Publisher(str(tmp_path)).write_mix_stage(g, stage)
+    consumer = Consumer(str(tmp_path), g)
+    assert consumer.mix_stage_count() == 1
+    back = consumer.read_mix_stage(0)
+    assert back.proof == stage.proof
+    assert (back.pads, back.datas) == (stage.pads, stage.datas)
+    assert back.input_hash == stage.input_hash
+    assert (back.n_rows, back.width) == (8, 2)
+
+
+def test_mixnet_record_e2e(tmp_path, election):
+    """The acceptance e2e, tiny group: 256 ballots encrypted, shuffled
+    through 2 mix stages via the real ``run_mixnet`` binary, and the
+    published record re-verified green by the real ``run_verifier``
+    binary (V15 family included)."""
+    from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+    from electionguard_tpu.cli import run_mixnet, run_verifier
+    from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+    from electionguard_tpu.publish.publisher import Publisher
+
+    g = election["group"]
+    init = election["init"]
+    ballots = list(RandomBallotProvider(
+        election["manifest"], 256, seed=21).ballots())
+    enc = BatchEncryptor(init, g)
+    encrypted, invalid = enc.encrypt_ballots(ballots, seed=g.int_to_q(77))
+    assert not invalid and len(encrypted) == 256
+    pub = Publisher(str(tmp_path))
+    pub.write_election_initialized(init)
+    pub.write_encrypted_ballots(encrypted)
+    rc = run_mixnet.main(["-in", str(tmp_path), "-out", str(tmp_path),
+                          "-stages", "2", "-group", "tiny",
+                          "-seed", "e2e"])
+    assert rc == 0
+    rc = run_verifier.main(["-in", str(tmp_path), "-group", "tiny"])
+    assert rc == 0
