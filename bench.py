@@ -452,6 +452,17 @@ def run_workload(nballots: int, n_chips: int) -> None:
         RESULT["mixnet_error"] = f"{type(e).__name__}: {e}"
     flush_partial()
 
+    # ---- mixfed phase: federated stages/s over 2 real server processes ---
+    # measures the PLANE (gRPC transport, chunked row streaming,
+    # pre-forward verification, publish + checkpoint), not modexp
+    # throughput — so it runs on the tiny group and stays best-effort
+    try:
+        _bench_mixfed()
+    except Exception as e:  # noqa: BLE001 — diagnostics
+        note(f"mixfed phase failed: {type(e).__name__}: {e}")
+        RESULT["mixfed_error"] = f"{type(e).__name__}: {e}"
+    flush_partial()
+
     import jax
     if jax.devices()[0].platform != "cpu":
         # the NTT-vs-CIOS shootout only means something on the chip; on
@@ -522,6 +533,88 @@ def _bench_mixnet(g, init, record, n_chips: int) -> None:
     note(f"mixnet n={n} w={w}: shuffle={t_sh:.2f}s "
          f"({n / max(t_sh, 1e-9):.1f}/s) prove={t_pr:.2f}s "
          f"verify={t_ve:.2f}s ({n / max(t_ve, 1e-9):.1f}/s)")
+
+
+def _bench_mixfed(n_stages: int = 2, n_rows: int = 64,
+                  width: int = 2) -> None:
+    """Federated mixing throughput: an in-process coordinator drives
+    ``n_stages`` stages over 2 REAL mix-server OS processes (reverse
+    registration, chunked row push/pull over gRPC, shuffle + TW proof,
+    pre-forward verification, framed publish, checkpoint fsync).  The
+    headline number is stages/s — the per-stage overhead ceiling of the
+    federated plane itself; modexp throughput is _bench_mixnet's job, so
+    this phase pins the tiny group and CPU servers on purpose."""
+    import shutil
+    import tempfile
+
+    from electionguard_tpu.core.group import tiny_group
+    from electionguard_tpu.crypto.elgamal import (ElGamalKeypair,
+                                                  elgamal_encrypt)
+    from electionguard_tpu.mixfed.coordinator import MixCoordinator
+    from electionguard_tpu.obs import trace as obs_trace
+    from electionguard_tpu.utils.platform import detach_axon
+
+    g = tiny_group()
+    key = ElGamalKeypair.from_secret(g.int_to_q(987654321))
+    K, qbar = key.public_key, g.int_to_q(424242)
+    pads, datas = [], []
+    for i in range(n_rows):
+        row_a, row_b = [], []
+        for j in range(width):
+            ct = elgamal_encrypt(g, (i + j) % 2,
+                                 g.int_to_q(5000 + i * width + j), K)
+            row_a.append(ct.pad.value)
+            row_b.append(ct.data.value)
+        pads.append(row_a)
+        datas.append(row_b)
+
+    out = tempfile.mkdtemp(prefix="bench_mixfed_")
+    env = dict(os.environ)
+    detach_axon(env)          # servers never contend for the bench chip
+    env["JAX_PLATFORMS"] = "cpu"
+    procs: list = []
+    shut = False
+    coord = MixCoordinator(g, out, port=0)
+    try:
+        for i in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "electionguard_tpu.cli.run_mix_server",
+                 "-name", f"bench-mix-{i}",
+                 "-serverPort", str(coord.port), "-group", "tiny"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
+        if not coord.wait_for_servers(2, timeout=120):
+            raise RuntimeError("mix servers failed to register in 120s")
+        t0 = time.time()
+        with obs_trace.span("bench.mixfed",
+                            {"n": n_rows, "w": width, "stages": n_stages}):
+            published = coord.run_mix(K.value, qbar, n_stages, pads, datas)
+        dt = time.time() - t0
+        assert published == n_stages, f"published {published}/{n_stages}"
+        coord.shutdown(all_ok=True)
+        shut = True
+        for p in procs:
+            p.wait(timeout=30)
+        RESULT.update(
+            mixfed_stages_per_s=round(n_stages / max(dt, 1e-9), 2),
+            mixfed_stage_s=round(dt / n_stages, 3),
+            mixfed_rows=n_rows, mixfed_servers=2,
+        )
+        RESULT["phases_done"] = RESULT.get("phases_done", "") + " mixfed"
+        note(f"mixfed {n_stages} stages x {n_rows} rows over 2 server "
+             f"processes: {dt:.2f}s ({n_stages / max(dt, 1e-9):.2f} "
+             f"stages/s)")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if not shut:
+            try:
+                coord.shutdown(all_ok=False)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        shutil.rmtree(out, ignore_errors=True)
 
 
 def _cpu_fallback(tpu_error: str) -> bool:

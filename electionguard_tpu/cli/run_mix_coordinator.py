@@ -1,0 +1,93 @@
+"""Federated mix-coordinator binary.
+
+Waits for ``-servers`` mix-server registrations (start at least
+``-stages``; extras are hot spares), then drives the cascade over the
+record's cast ballots, verifying every stage before publishing it
+(mixfed/coordinator.py).  The published artifact is the standard
+``mix_stage_NNN.pb`` set, verifiable by ``run_verifier`` exactly like a
+single-process ``run_mixnet`` record.
+
+Run:  python -m electionguard_tpu.cli.run_mix_coordinator -in record \
+          -out record -stages 3 -servers 3 -port 17141 -group tiny
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from electionguard_tpu.cli.common import (Stopwatch, add_group_flag,
+                                          resolve_group, setup_logging)
+from electionguard_tpu.mixfed.coordinator import MixCoordinator, MixFedError
+from electionguard_tpu.mixnet.stage import rows_from_ballots
+from electionguard_tpu.publish.publisher import Consumer
+from electionguard_tpu.utils import maybe_profile
+
+
+def main(argv=None) -> int:
+    log = setup_logging("RunMixCoordinator")
+    ap = argparse.ArgumentParser("RunMixCoordinator")
+    ap.add_argument("-in", dest="input", required=True,
+                    help="record dir with encrypted_ballots.pb")
+    ap.add_argument("-out", dest="output", required=True)
+    ap.add_argument("-stages", type=int, default=2,
+                    help="number of sequential mix stages")
+    ap.add_argument("-servers", dest="servers", type=int, default=0,
+                    help="mix-server registrations to wait for "
+                         "(default: -stages; start more for hot spares)")
+    ap.add_argument("-port", type=int, default=17141,
+                    help="registration service port")
+    ap.add_argument("-registrationTimeout", dest="reg_timeout",
+                    type=float, default=300.0)
+    ap.add_argument("-checkpointFile", dest="checkpoint_file", default=None,
+                    help="journal of the last verified stage; a relaunch "
+                         "pointed at the same file (and -out) resumes at "
+                         "the first unpublished stage")
+    add_group_flag(ap)
+    args = ap.parse_args(argv)
+    if args.stages < 1:
+        log.error("-stages must be >= 1")
+        return 1
+    n_servers = args.servers or args.stages
+
+    group = resolve_group(args)
+    consumer = Consumer(args.input, group)
+    init = consumer.read_election_initialized()
+
+    sw = Stopwatch()
+    pads, datas = rows_from_ballots(consumer.iterate_encrypted_ballots())
+    if not pads:
+        log.error("no cast ballots in %s — nothing to mix", args.input)
+        return 1
+    log.info("federated mix: %d cast ballots x %d ciphertexts through "
+             "%d stages over %d server(s)", len(pads), len(pads[0]),
+             args.stages, n_servers)
+
+    coord = MixCoordinator(group, args.output, port=args.port,
+                           checkpoint_file=args.checkpoint_file)
+    try:
+        if not coord.wait_for_servers(n_servers, timeout=args.reg_timeout):
+            log.error("only %d of %d mix servers registered within %.0fs",
+                      coord.ready(), n_servers, args.reg_timeout)
+            return 1
+        t0 = time.time()
+        with maybe_profile("mixfed"):
+            published = coord.run_mix(init.joint_public_key.value,
+                                      init.extended_base_hash,
+                                      args.stages, pads, datas)
+        dt = time.time() - t0
+        log.info("%d mix stages took %.2fs (%.2f stages/s)",
+                 published, dt, published / max(dt, 1e-9))
+    except MixFedError as e:
+        log.error("federated mix FAILED: %s", e)
+        coord.shutdown(all_ok=False)
+        return 1
+    coord.shutdown(all_ok=True)
+    log.info("%s; %d stages published", sw.took(
+        "mixfed", max(len(pads) * args.stages, 1)), args.stages)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,50 @@
+"""Federated mix-server binary: one process, one shuffle stage.
+
+Binds a free port, reverse-registers with the mix coordinator, then
+serves the stage rpcs (mixfed/server.py) and blocks until the
+coordinator calls finish.  ``-shards N`` spreads the shuffle and proof
+dispatches over an in-process device mesh.
+
+Run:  python -m electionguard_tpu.cli.run_mix_server -name mix1 \
+          -serverPort 17141 -group tiny
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from electionguard_tpu.cli.common import (add_group_flag, resolve_group,
+                                          setup_logging)
+from electionguard_tpu.mixfed.server import MixServerServer
+
+
+def main(argv=None) -> int:
+    log = setup_logging("RunMixServer")
+    ap = argparse.ArgumentParser("RunMixServer")
+    ap.add_argument("-name", required=True, help="mix server id")
+    ap.add_argument("-port", type=int, default=0,
+                    help="listen port (0 = random free port)")
+    ap.add_argument("-serverPort", dest="server_port", type=int,
+                    default=17141, help="coordinator port")
+    ap.add_argument("-serverHost", dest="server_host", default="localhost")
+    ap.add_argument("-shards", type=int, default=0,
+                    help="shard the shuffle/proof over N local devices "
+                         "(0 = single device; also EGTPU_MIX_SHARDS)")
+    ap.add_argument("-wp", type=int, default=1,
+                    help="within-element mesh axis for -shards")
+    add_group_flag(ap)
+    args = ap.parse_args(argv)
+
+    group = resolve_group(args)
+    server = MixServerServer(
+        group, f"{args.server_host}:{args.server_port}", args.name,
+        port=args.port, shards=args.shards or None, wp=args.wp)
+    log.info("mix server %s serving on %s", args.name, server.url)
+    ok = server.wait_until_finished()
+    log.info("mix server %s finished: all_ok=%s", args.name, ok)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

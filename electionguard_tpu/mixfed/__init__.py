@@ -1,0 +1,20 @@
+"""Federated mix plane: one gRPC process per shuffle stage.
+
+The single-process mixnet (cli/run_mixnet) holds EVERY stage's
+permutation and re-encryption randomness in one address space, so one
+compromised process can unwind the whole cascade.  This plane restores
+the mixnet's actual trust model: each ``MixServerServer`` process mixes
+exactly ONE stage (it structurally refuses a second assignment), and a
+``MixCoordinator`` streams rows between servers, verifying each stage's
+Terelius–Wikström proof BEFORE forwarding its output downstream —
+a cheating or crashed server costs one requeue, never a tainted record.
+
+Same published artifact, same verifier: the coordinator writes the
+standard ``mix_stage_NNN.pb`` streams, so ``run_verifier`` checks a
+federated record exactly like a single-process one.
+"""
+
+from electionguard_tpu.mixfed.coordinator import MixCoordinator, MixFedError
+from electionguard_tpu.mixfed.server import MixServerServer
+
+__all__ = ["MixCoordinator", "MixFedError", "MixServerServer"]

@@ -1,0 +1,353 @@
+"""The federated mix coordinator: assigns stages, moves rows, verifies.
+
+Drives K mix stages over K registered ``MixServerServer`` processes
+(extra registrations are spares).  Per stage: assign a fresh server —
+never one that already holds a stage, so the one-stage-per-process
+trust boundary also holds from this side — push the input rows in
+chunks, request the shuffle keyed to the coordinator's own input
+digest, pull the output rows back, and verify the stage's full
+Terelius–Wikström proof LOCALLY before anything is forwarded: a bad
+proof or a dead server costs one requeue onto a spare, and a tampered
+stage can never reach the published record or the next server's input.
+
+Every chunk rides ``rpc_util.Stub`` (full-jitter retries, per-class
+deadlines) and the fault/trace interceptors, so the PR-2 chaos drills
+and PR-3 cross-process traces cover this plane for free.
+
+Crash recovery is journal-style: a stage is published (framed, fsync'd
+``mix_stage_NNN.pb``) only AFTER it verifies, and a checkpoint file
+records the last verified stage + its output digest, so a restarted
+coordinator resumes at the first unpublished stage, re-chaining off the
+record instead of re-mixing verified work.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+import grpc
+
+from electionguard_tpu.core.group import GroupContext
+from electionguard_tpu.mixnet.proof import rows_digest
+from electionguard_tpu.mixnet.stage import MixStage
+from electionguard_tpu.mixnet.verify_mix import verify_stage
+from electionguard_tpu.obs import REGISTRY, span
+from electionguard_tpu.publish import pb, serialize
+from electionguard_tpu.publish.publisher import Consumer, Publisher
+from electionguard_tpu.remote import rpc_util
+
+log = logging.getLogger("mixfed.coordinator")
+
+
+def _chunk_rows() -> int:
+    try:
+        return max(1, int(os.environ.get("EGTPU_MIX_CHUNK_ROWS", "64")))
+    except ValueError:
+        return 64
+
+
+class MixFedError(RuntimeError):
+    """A stage could not be completed on ANY available server.  ``check``
+    names the verification class that failed ("" for transport-only
+    failures), so chaos tests can assert a tampered stage died as
+    ``mix_binding`` and not as some generic error."""
+
+    def __init__(self, msg: str, check: str = ""):
+        super().__init__(msg)
+        self.check = check
+
+
+class _StageFailed(Exception):
+    """Internal: this server failed the stage (transport or in-band);
+    requeue on a spare."""
+
+    def __init__(self, msg: str, check: str = ""):
+        super().__init__(msg)
+        self.check = check
+
+
+class _MixServer:
+    """Coordinator-side handle for one registered mix server."""
+
+    def __init__(self, server_id: str, url: str, nonce: bytes):
+        self.id = server_id
+        self.url = url
+        self.reg_nonce = nonce
+        self.stage: Optional[int] = None   # assigned stage, if any
+        self.failed = False
+        self._channel = None
+        self._stub: Optional[rpc_util.Stub] = None
+
+    def stub(self) -> rpc_util.Stub:
+        if self._stub is None:
+            self._channel = rpc_util.make_channel(self.url)
+            self._stub = rpc_util.Stub(self._channel, "MixServerService")
+        return self._stub
+
+    def close(self):
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+            self._stub = None
+
+
+class _Recorder:
+    """Minimal VerificationResult stand-in for the pre-forward check."""
+
+    def __init__(self):
+        self.failures: list[tuple[str, str]] = []
+
+    def record(self, name: str, ok: bool, msg: str = ""):
+        if not ok:
+            self.failures.append((name, msg))
+
+
+class MixCoordinator:
+    """Registration service + stage driver; see module docstring."""
+
+    def __init__(self, group: GroupContext, out_dir: str, port: int = 0,
+                 checkpoint_file: Optional[str] = None):
+        self.group = group
+        self.out_dir = out_dir
+        self.publisher = Publisher(out_dir)
+        self._checkpoint_file = checkpoint_file
+        self._lock = threading.Lock()
+        self.servers: list[_MixServer] = []
+        self.server, self.port = rpc_util.make_server(
+            port, rpc_util.MAX_REGISTRATION_MESSAGE)
+        self.url = f"localhost:{self.port}"
+        self.server.add_generic_rpc_handlers((rpc_util.generic_service(
+            "MixRegistrationService",
+            {"registerMixServer": self._register}),))
+        self.server.start()
+        log.info("mix coordinator listening on %d", self.port)
+
+    # ---- registration rpc --------------------------------------------
+
+    def _register(self, request, context):
+        with self._lock:
+            sid = request.server_id
+            err = rpc_util.check_group_fingerprint(
+                self.group, request.group_fingerprint)
+            if err:
+                return pb.RegisterMixServerResponse(
+                    error=err,
+                    constants=rpc_util.group_constants_msg(self.group))
+            for s in self.servers:
+                if s.id == sid:
+                    if (s.url == request.remote_url and s.reg_nonce
+                            == bytes(request.registration_nonce)):
+                        # lost-response retry: replay idempotently
+                        return pb.RegisterMixServerResponse(
+                            server_id=sid,
+                            constants=rpc_util.group_constants_msg(
+                                self.group))
+                    return pb.RegisterMixServerResponse(
+                        error=f"duplicate mix server id {sid}")
+            self.servers.append(_MixServer(
+                sid, request.remote_url,
+                bytes(request.registration_nonce)))
+            log.info("registered mix server %s at %s", sid,
+                     request.remote_url)
+            return pb.RegisterMixServerResponse(
+                server_id=sid,
+                constants=rpc_util.group_constants_msg(self.group))
+
+    def ready(self) -> int:
+        with self._lock:
+            return len(self.servers)
+
+    def wait_for_servers(self, n: int, timeout: float = 300.0,
+                         poll: float = 0.25) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.ready() >= n:
+                return True
+            time.sleep(poll)
+        return False
+
+    # ---- stage driver ------------------------------------------------
+
+    def _next_server(self) -> Optional[_MixServer]:
+        with self._lock:
+            for s in self.servers:
+                if s.stage is None and not s.failed:
+                    return s
+        return None
+
+    def _write_checkpoint(self, stage_index: int, output_hash: bytes):
+        if not self._checkpoint_file:
+            return
+        tmp = self._checkpoint_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"verified_stages": stage_index + 1,
+                       "output_hash": output_hash.hex()}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._checkpoint_file)
+
+    def _resume_point(self, in_pads, in_datas):
+        """(next_stage, rows, input_hash) from the published record.
+        Published stages were verified before being written, so resume
+        trusts the record; the checkpoint file cross-checks the chain
+        head so a diverged/stale output dir fails loudly, not subtly."""
+        consumer = Consumer(self.out_dir, self.group)
+        done = consumer.mix_stage_count()
+        if done == 0:
+            return 0, in_pads, in_datas, rows_digest(self.group, in_pads,
+                                                     in_datas)
+        last = consumer.read_mix_stage(done - 1)
+        head = rows_digest(self.group, last.pads, last.datas)
+        if self._checkpoint_file and os.path.exists(self._checkpoint_file):
+            with open(self._checkpoint_file) as f:
+                cp = json.load(f)
+            if int(cp.get("verified_stages", -1)) == done \
+                    and cp.get("output_hash") != head.hex():
+                raise MixFedError(
+                    f"checkpoint output hash diverges from published "
+                    f"stage {done - 1} — output dir and checkpoint are "
+                    f"from different runs")
+        log.info("resuming after %d published stage(s)", done)
+        return done, last.pads, last.datas, head
+
+    def _run_stage_on(self, srv: _MixServer, k: int, pads, datas,
+                      input_hash: bytes, public_key: int, qbar,
+                      n: int, w: int) -> MixStage:
+        """Drive one stage on one server; raises _StageFailed on any
+        transport or in-band failure (caller requeues on a spare)."""
+        stub = srv.stub()
+        ready = stub.call("registerStage", pb.MixStageRequest(
+            stage_index=k,
+            joint_public_key=serialize._pub_p_int(self.group, public_key),
+            extended_base_hash=serialize.publish_q(qbar),
+            n_rows=n, width=w,
+            group_fingerprint=self.group.fingerprint()))
+        if ready.error:
+            raise _StageFailed(f"registerStage: {ready.error}")
+        chunk = _chunk_rows()
+        for start in range(0, n, chunk):
+            rows = [serialize.publish_mix_row(self.group, pads[i], datas[i])
+                    for i in range(start, min(start + chunk, n))]
+            resp = stub.call("pushRows", pb.MixRowChunk(
+                stage_index=k, chunk_start=start, rows=rows))
+            if not resp.ok:
+                raise _StageFailed(f"pushRows@{start}: {resp.error}")
+        result = stub.call("shuffleStage", pb.MixShuffleRequest(
+            stage_index=k, input_hash=input_hash))
+        if result.error:
+            raise _StageFailed(f"shuffleStage: {result.error}")
+        out_pads: list = []
+        out_datas: list = []
+        while len(out_pads) < n:
+            got = stub.call("pullRows", pb.MixRowRequest(
+                stage_index=k, chunk_start=len(out_pads), max_rows=chunk))
+            if got.error:
+                raise _StageFailed(f"pullRows: {got.error}")
+            if not got.rows:
+                raise _StageFailed(
+                    f"pullRows: server returned {len(out_pads)} of {n} "
+                    f"rows then went empty")
+            for rm in got.rows:
+                row_a, row_b = serialize.import_mix_row(self.group, rm)
+                out_pads.append(row_a)
+                out_datas.append(row_b)
+        if rows_digest(self.group, out_pads, out_datas) \
+                != bytes(result.output_hash):
+            raise _StageFailed(
+                f"stage {k}: pulled rows do not digest to the server's "
+                f"output hash (corrupted transfer?)")
+        hdr = result.header
+        if (int(hdr.stage_index) != k or int(hdr.n_rows) != n
+                or int(hdr.width) != w
+                or serialize.import_u256(hdr.input_hash) != input_hash):
+            raise _StageFailed(
+                f"stage {k}: result header does not describe the "
+                f"requested stage")
+        proof = serialize.import_mix_proof(self.group, hdr.proof)
+        return MixStage(k, n, w, input_hash, out_pads, out_datas, proof)
+
+    def run_mix(self, public_key: int, qbar, n_stages: int,
+                in_pads, in_datas) -> int:
+        """Mix ``n_stages`` stages starting from the given input rows
+        (the record's cast-ballot ciphertexts for a fresh run); returns
+        the number of stages published THIS call (resume skips verified
+        ones).  Raises ``MixFedError`` when a stage cannot be completed
+        on any remaining server."""
+        if not in_pads:
+            raise MixFedError("no input rows to mix")
+        n, w = len(in_pads), len(in_pads[0])
+        k, pads, datas, input_hash = self._resume_point(in_pads, in_datas)
+        published = 0
+        while k < n_stages:
+            srv = self._next_server()
+            if srv is None:
+                raise MixFedError(
+                    f"stage {k}: no registered mix server left to run it "
+                    f"(all assigned or failed)")
+            srv.stage = k
+            with span("mixfed.forward", {"stage": k, "server": srv.id}):
+                try:
+                    stage = self._run_stage_on(srv, k, pads, datas,
+                                               input_hash, public_key,
+                                               qbar, n, w)
+                except (grpc.RpcError, _StageFailed) as e:
+                    detail = (f"{e.code().name}: {e.details()}"
+                              if isinstance(e, grpc.RpcError) else str(e))
+                    log.warning("stage %d failed on server %s (%s); "
+                                "requeueing on a spare", k, srv.id, detail)
+                    srv.failed = True
+                    srv.close()
+                    REGISTRY.counter("mixfed_stage_requeues_total").inc()
+                    if self._next_server() is None:
+                        raise MixFedError(
+                            f"stage {k} failed on server {srv.id} "
+                            f"({detail}) and no spare server remains")
+                    continue
+                # ---- verify BEFORE forwarding ------------------------
+                rec = _Recorder()
+                ok = verify_stage(self.group, public_key, qbar, stage,
+                                  pads, datas, input_hash, rec)
+                if not ok:
+                    check, msg = (rec.failures[0] if rec.failures
+                                  else ("mix_verify", "unknown"))
+                    check = check.split(".")[-1]
+                    log.error("stage %d from server %s FAILED pre-forward "
+                              "verification [%s]: %s — requeueing", k,
+                              srv.id, check, msg)
+                    srv.failed = True
+                    srv.close()
+                    REGISTRY.counter("mixfed_bad_proofs_total").inc()
+                    REGISTRY.counter("mixfed_stage_requeues_total").inc()
+                    if self._next_server() is None:
+                        raise MixFedError(
+                            f"stage {k} from server {srv.id} failed "
+                            f"verification ({check}: {msg}) and no spare "
+                            f"server remains", check=check)
+                    continue
+            path = self.publisher.write_mix_stage(self.group, stage)
+            output_hash = rows_digest(self.group, stage.pads, stage.datas)
+            self._write_checkpoint(k, output_hash)
+            log.info("stage %d verified on server %s and published -> %s",
+                     k, srv.id, path)
+            pads, datas = stage.pads, stage.datas
+            input_hash = output_hash
+            published += 1
+            k += 1
+        return published
+
+    def shutdown(self, all_ok: bool):
+        with self._lock:
+            servers = list(self.servers)
+        for s in servers:
+            try:
+                s.stub().call("finish", pb.msg("FinishRequest")(
+                    all_ok=all_ok), timeout=5.0)
+            except grpc.RpcError:
+                pass   # a crashed server has nothing to finish
+            s.close()
+        self.server.stop(grace=1)

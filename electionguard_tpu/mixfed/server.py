@@ -1,0 +1,277 @@
+"""One federated mix server: the node that holds ONE stage's secrets.
+
+Lifecycle mirrors the trustee plane (remote/keyceremony_remote.py):
+listen first, then reverse-dial the coordinator's registration service
+with a per-process nonce (lost-response retries replay idempotently; a
+relaunched process — fresh secrets — registers as a new server).  The
+coordinator then drives the stage over four rpcs:
+
+  registerStage   assign THIS server its one stage (index, key, qbar)
+  pushRows        stream the stage's input ciphertext rows in chunks
+  shuffleStage    shuffle + prove, keyed to the coordinator's input hash
+  pullRows        stream the shuffled output rows back in chunks
+
+The trust boundary is structural, not behavioural: ``registerStage``
+for a second, different stage is refused in-band, so no process ever
+sees two stages' permutations or randomness — the property the
+federated topology exists to provide (and tests/test_mixfed.py asserts
+by inspecting server state).  Every rpc is idempotent: chunks overwrite
+by ``chunk_start``, and a repeated ``shuffleStage`` with the same input
+hash returns the cached result instead of re-shuffling (a retried rpc
+must not mint a second permutation for the same stage).
+
+Sharding: ``shards``/EGTPU_MIX_SHARDS spreads the row axis of the
+shuffle AND the N-wide proof dispatches over an in-process device mesh
+(parallel/sharded.ShardedGroupOps) — bit-identical transcript, see
+tests/test_sharded_fused.py's differential coverage.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+from electionguard_tpu.core.group import GroupContext
+from electionguard_tpu.mixnet.proof import rows_digest
+from electionguard_tpu.mixnet.shuffle import Shuffler
+from electionguard_tpu.mixnet.stage import run_stage
+from electionguard_tpu.obs import REGISTRY, span
+from electionguard_tpu.publish import pb, serialize
+from electionguard_tpu.remote import rpc_util
+
+log = logging.getLogger("mixfed.server")
+
+
+def _env_shards() -> int:
+    try:
+        return max(0, int(os.environ.get("EGTPU_MIX_SHARDS", "0")))
+    except ValueError:
+        return 0
+
+
+class MixServerServer:
+    """One mix-server process; see module docstring for the protocol."""
+
+    def __init__(self, group: GroupContext, coordinator_url: str,
+                 server_id: str, port: int = 0, host: str = "localhost",
+                 shards: Optional[int] = None, wp: int = 1,
+                 tamper: bool = False, seed: Optional[bytes] = None):
+        self.group = group
+        self.server_id = server_id
+        # tamper knob (tests + drills): corrupt one output ciphertext
+        # AFTER proving, so the published transcript no longer binds —
+        # the coordinator's pre-forward verification must catch it as a
+        # V15.mix_binding failure, never publish it
+        self._tamper = tamper or os.environ.get("EGTPU_MIX_TAMPER") in (
+            "1", server_id)
+        self._pinned_seed = seed
+        shards = _env_shards() if shards is None else shards
+        self._ops = None
+        if shards:
+            from electionguard_tpu.core.group_jax import jax_ops
+            from electionguard_tpu.parallel.mesh import election_mesh
+            from electionguard_tpu.parallel.sharded import ShardedGroupOps
+            self._ops = ShardedGroupOps(jax_ops(group),
+                                        election_mesh(shards, wp=wp))
+            log.info("mix server %s sharding over %d devices (wp=%d)",
+                     server_id, shards, wp)
+
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._all_ok: Optional[bool] = None
+        # ---- the ONE stage this process may ever hold ----------------
+        self.held_stage: Optional[int] = None
+        self._public_key: Optional[int] = None
+        self._qbar: Optional[bytes] = None
+        self._n_rows = 0
+        self._width = 0
+        self._chunks: dict[int, tuple[list, list]] = {}
+        self._result = None          # cached MixStageResult message
+        self._result_input_hash: Optional[bytes] = None
+        self._out_pads: list = []
+        self._out_datas: list = []
+
+        self.server, self.port = rpc_util.make_server(port)
+        self.url = f"{host}:{self.port}"
+        self.server.add_generic_rpc_handlers((rpc_util.generic_service(
+            "MixServerService",
+            {"registerStage": self._register_stage,
+             "pushRows": self._push_rows,
+             "shuffleStage": self._shuffle_stage,
+             "pullRows": self._pull_rows,
+             "health": self._health,
+             "finish": self._finish}),))
+        self.server.start()
+
+        self._reg_nonce = os.urandom(16)
+        channel = rpc_util.make_channel(coordinator_url,
+                                        rpc_util.MAX_REGISTRATION_MESSAGE)
+        try:
+            resp = rpc_util.Stub(channel, "MixRegistrationService").call(
+                "registerMixServer", pb.RegisterMixServerRequest(
+                    server_id=server_id, remote_url=self.url,
+                    group_fingerprint=group.fingerprint(),
+                    registration_nonce=self._reg_nonce))
+        finally:
+            channel.close()
+        err = resp.error or rpc_util.check_group_constants(group,
+                                                           resp.constants)
+        if err:
+            self.server.stop(grace=0)
+            raise RuntimeError(f"mix server registration failed: {err}")
+        log.info("mix server %s registered at %s", server_id, self.url)
+
+    # ---- rpc impls ---------------------------------------------------
+
+    def _register_stage(self, request, context):
+        with self._lock:
+            k = int(request.stage_index)
+            err = rpc_util.check_group_fingerprint(
+                self.group, request.group_fingerprint)
+            if err:
+                return pb.MixStageReady(stage_index=k, error=err)
+            if self.held_stage is not None and self.held_stage != k:
+                # the trust boundary: this process already holds stage
+                # held_stage's secrets and will never hold another's
+                return pb.MixStageReady(
+                    stage_index=k,
+                    error=f"server {self.server_id} already holds stage "
+                          f"{self.held_stage}; one stage per process")
+            self.held_stage = k
+            self._public_key = serialize._imp_p_int(
+                self.group, request.joint_public_key)
+            self._qbar = serialize.import_q(self.group,
+                                            request.extended_base_hash)
+            self._n_rows = int(request.n_rows)
+            self._width = int(request.width)
+            return pb.MixStageReady(stage_index=k)
+
+    def _push_rows(self, request, context):
+        with self._lock:
+            if self.held_stage is None \
+                    or int(request.stage_index) != self.held_stage:
+                return pb.msg("BoolResponse")(
+                    ok=False, error=f"server {self.server_id} holds stage "
+                                    f"{self.held_stage}, not "
+                                    f"{int(request.stage_index)}")
+            pads, datas = [], []
+            for rm in request.rows:
+                row_a, row_b = serialize.import_mix_row(self.group, rm)
+                pads.append(row_a)
+                datas.append(row_b)
+            # idempotent by chunk_start: a retried chunk overwrites itself
+            self._chunks[int(request.chunk_start)] = (pads, datas)
+            REGISTRY.counter("mixfed_rows_pushed_total").inc(len(pads))
+            return pb.msg("BoolResponse")(ok=True)
+
+    def _assemble_rows(self):
+        """Contiguous rows from the pushed chunks, or None + error."""
+        pads: list = []
+        datas: list = []
+        for start in sorted(self._chunks):
+            if start != len(pads):
+                return None, None, (f"row chunks not contiguous at "
+                                    f"{len(pads)} (got chunk {start})")
+            p, d = self._chunks[start]
+            pads.extend(p)
+            datas.extend(d)
+        if len(pads) != self._n_rows:
+            return None, None, (f"{len(pads)} rows pushed != announced "
+                                f"{self._n_rows}")
+        return pads, datas, ""
+
+    def _shuffle_stage(self, request, context):
+        with self._lock:
+            k = int(request.stage_index)
+            if self.held_stage is None or k != self.held_stage:
+                return pb.MixStageResult(
+                    error=f"server {self.server_id} holds stage "
+                          f"{self.held_stage}, not {k}")
+            want = bytes(request.input_hash)
+            if self._result is not None:
+                # idempotent retry of a lost response — but ONLY for the
+                # same input: re-shuffling would mint a second
+                # permutation for the stage
+                if want == self._result_input_hash:
+                    return self._result
+                return pb.MixStageResult(
+                    error=f"stage {k} already shuffled for a different "
+                          f"input hash")
+            pads, datas, err = self._assemble_rows()
+            if err:
+                return pb.MixStageResult(error=f"stage {k}: {err}")
+            got = rows_digest(self.group, pads, datas)
+            if want and want != got:
+                # the coordinator and this server disagree on the input
+                # rows — refuse to mix (a proof over disputed input is
+                # unverifiable downstream anyway)
+                return pb.MixStageResult(
+                    error=f"stage {k}: input hash mismatch — coordinator "
+                          f"sent {want.hex()[:16]}…, rows digest to "
+                          f"{got.hex()[:16]}…")
+            with span("mixfed.stage",
+                      {"stage": k, "n": len(pads), "server": self.server_id}):
+                sh = Shuffler(self.group, self._public_key, ops=self._ops)
+                stage = run_stage(self.group, self._public_key, self._qbar,
+                                  k, pads, datas, seed=self._pinned_seed,
+                                  shuffler=sh)
+            if self._tamper:
+                # corrupt one output AFTER proving: digest matches the
+                # rows we hand back, but the Fiat–Shamir challenge no
+                # longer re-derives — a mix_binding failure downstream
+                log.warning("mix server %s TAMPERING with stage %d "
+                            "output (drill)", self.server_id, k)
+                stage.pads[0][0] = stage.pads[0][0] * self.group.g \
+                    % self.group.p
+            self._out_pads, self._out_datas = stage.pads, stage.datas
+            out_hash = rows_digest(self.group, stage.pads, stage.datas)
+            self._result = pb.MixStageResult(
+                header=serialize.publish_mix_header(self.group, stage),
+                output_hash=out_hash)
+            self._result_input_hash = want or got
+            REGISTRY.counter("mixfed_stages_total").inc()
+            return self._result
+
+    def _pull_rows(self, request, context):
+        with self._lock:
+            k = int(request.stage_index)
+            if self._result is None or k != self.held_stage:
+                return pb.MixRowChunk(
+                    error=f"stage {k} not shuffled on server "
+                          f"{self.server_id}")
+            start = int(request.chunk_start)
+            end = min(start + max(1, int(request.max_rows)),
+                      len(self._out_pads))
+            rows = [serialize.publish_mix_row(
+                self.group, self._out_pads[i], self._out_datas[i])
+                for i in range(start, end)]
+            REGISTRY.counter("mixfed_rows_pulled_total").inc(len(rows))
+            return pb.MixRowChunk(stage_index=k, chunk_start=start,
+                                  rows=rows)
+
+    def _health(self, request, context):
+        with self._lock:
+            shuffled = self._result is not None
+            return pb.msg("HealthResponse")(
+                status=(f"stage={self.held_stage} shuffled={shuffled}"
+                        if self.held_stage is not None else "idle"),
+                ready=True,
+                queue_depth=len(self._chunks))
+
+    def _finish(self, request, context):
+        self._all_ok = bool(request.all_ok)
+        self._done.set()
+        return pb.msg("BoolResponse")(ok=True)
+
+    # ---- process lifecycle -------------------------------------------
+
+    def wait_until_finished(self, timeout: Optional[float] = None) -> bool:
+        if not self._done.wait(timeout):
+            return False
+        self.server.stop(grace=1)
+        return bool(self._all_ok)
+
+    def stop(self):
+        self.server.stop(grace=0)

@@ -135,18 +135,22 @@ def prove_shuffle(group: GroupContext, public_key: int, qbar,
                   in_pads, in_datas, out_pads, out_datas,
                   perm: np.ndarray, rand: Sequence[Sequence[int]],
                   seed: bytes,
-                  input_hash: Optional[bytes] = None) -> MixProof:
+                  input_hash: Optional[bytes] = None,
+                  ops=None) -> MixProof:
     """Prove ``out = π(in)`` re-encrypted with ``rand`` under ``seed``-
     derived commitment randomness.  All N-wide exponentiations are
     device dispatches; ``qbar`` is the election's extended base hash
     (binds the proof to the election), ``stage_index`` + ``input_hash``
-    bind it to its place in the mix cascade."""
+    bind it to its place in the mix cascade.  ``ops`` defaults to the
+    single-device plane; a ``ShardedGroupOps`` spreads the N-wide
+    dispatches (powmod + product-reduce, fixed_multi_pow chain ladders)
+    over its mesh — same public array API, bit-identical transcript."""
     n = len(in_pads)
     w = len(in_pads[0]) if n else 0
     if n < 1:
         raise ValueError("cannot prove an empty shuffle")
     q, p, g = group.q, group.p, group.g
-    ops = jax_ops(group)
+    ops = ops if ops is not None else jax_ops(group)
     eops = jax_exp_ops(group)
     hs_all = derive_generators(group, generator_seed(qbar), n)
     h, hs = hs_all[0], hs_all[1:]

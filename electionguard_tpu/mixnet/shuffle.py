@@ -56,15 +56,23 @@ def get_shuffler(group: GroupContext, public_key: int) -> "Shuffler":
 
 
 class Shuffler:
-    """Re-encryption engine for one (group, public key) pair."""
+    """Re-encryption engine for one (group, public key) pair.
 
-    def __init__(self, group: GroupContext, public_key: int):
+    ``ops`` defaults to the single-device ``JaxGroupOps``; a mix server
+    passes a ``parallel.sharded.ShardedGroupOps`` to spread the row axis
+    over its device mesh — the sharded path composes the same fixed-base
+    ladders and Montgomery combines from the public array API (the fused
+    single-program variant closes over single-device internals), so both
+    paths are bit-identical for the same seed."""
+
+    def __init__(self, group: GroupContext, public_key: int, ops=None):
         self.group = group
         self.public_key = public_key
-        self.ops = jax_ops(group)
+        self.ops = ops if ops is not None else jax_ops(group)
         self.eops = jax_exp_ops(group)
+        self._sharded = hasattr(self.ops, "mesh")
         self._k_table = self.ops.fixed_table(public_key)
-        self._reenc_j = jax.jit(self._reenc_impl)
+        self._reenc_j = None if self._sharded else jax.jit(self._reenc_impl)
 
     def _reenc_impl(self, a, b, r):
         """One fused program: (A·g^r, B·K^r) for a tile of elements."""
@@ -78,6 +86,12 @@ class Shuffler:
         """Batched (M, n) limb re-encryption through the bucketed
         dispatch policy (pad rows are the identity ciphertext (1,1) with
         r = 0, so padding re-encrypts to itself)."""
+        if self._sharded:
+            ops = self.ops
+            gr = ops.g_pow(r_l)
+            kr = ops.base_pow(self.public_key, r_l)
+            return (np.asarray(ops.mulmod(pads_l, gr)),
+                    np.asarray(ops.mulmod(datas_l, kr)))
         out = run_tiled_multi(self._reenc_j, [pads_l, datas_l, r_l],
                               [True, True, False])
         return np.asarray(out[0]), np.asarray(out[1])

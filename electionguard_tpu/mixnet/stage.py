@@ -70,8 +70,11 @@ def run_stage(group: GroupContext, public_key: int, qbar,
     out_pads, out_datas, perm, rand = sh.shuffle(
         in_pads, in_datas, seed, perm=perm)
     input_hash = rows_digest(group, in_pads, in_datas)
+    # the proof dispatches ride the shuffler's batch plane, so a sharded
+    # shuffler (mixfed server with -shards) shards the proof too
     proof = prove_shuffle(group, public_key, qbar, stage_index,
                           in_pads, in_datas, out_pads, out_datas,
-                          perm, rand, seed, input_hash=input_hash)
+                          perm, rand, seed, input_hash=input_hash,
+                          ops=sh.ops)
     return MixStage(stage_index, len(in_pads), len(in_pads[0]),
                     input_hash, out_pads, out_datas, proof)

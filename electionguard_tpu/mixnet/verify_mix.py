@@ -76,14 +76,16 @@ def _check_structure(stage: MixStage, k: int, n_in: int, w_in: int,
 
 def verify_stage(group: GroupContext, public_key: int, qbar,
                  stage: MixStage, in_pads, in_datas, input_hash: bytes,
-                 res, pfx: str = "V15") -> bool:
+                 res, pfx: str = "V15", ops=None) -> bool:
     """Verify one stage against its (already chain-checked) input rows.
-    Records failures into ``res``; returns overall stage validity."""
+    Records failures into ``res``; returns overall stage validity.
+    ``ops`` defaults to the single-device plane; a ``ShardedGroupOps``
+    spreads the N-wide verification dispatches over its mesh."""
     n, w = len(in_pads), len(in_pads[0])
     k = stage.stage_index
     pr = stage.proof
     q, p, g = group.q, group.p, group.g
-    ops = jax_ops(group)
+    ops = ops if ops is not None else jax_ops(group)
     eops = jax_exp_ops(group)
 
     # ---- membership: every P element of outputs + transcript ----------

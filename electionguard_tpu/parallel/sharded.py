@@ -52,12 +52,34 @@ from electionguard_tpu.core import bignum_jax as bn
 from electionguard_tpu.parallel.mesh import DP_AXIS, WP_AXIS
 
 
+def _partially_replicated(x) -> bool:
+    """True iff ``x`` is committed to a sharding that leaves a >1-sized
+    mesh axis unused (dp-sharded but wp-replicated, say).  jax 0.4.37's
+    CPU backend lowers ``jnp.concatenate`` over such operands with a
+    wrong row stride — silent data corruption (tests/test_sharded.py
+    pins the repro) — so padding/concatenation must detour via host."""
+    s = getattr(x, "sharding", None)
+    mesh = getattr(s, "mesh", None)
+    spec = getattr(s, "spec", None)
+    if mesh is None or spec is None:
+        return False
+    used: set = set()
+    for part in spec:
+        if part is None:
+            continue
+        used.update((part,) if isinstance(part, str) else tuple(part))
+    return any(size > 1 and name not in used
+               for name, size in dict(mesh.shape).items())
+
+
 def _pad_rows(x: np.ndarray | jax.Array, mult: int, fill_row) -> jax.Array:
     """Pad axis 0 of ``x`` up to a multiple of ``mult`` with ``fill_row``."""
     b = x.shape[0]
     rem = (-b) % mult
     if rem == 0:
         return jnp.asarray(x)
+    if _partially_replicated(x):
+        x = np.asarray(x)   # see _partially_replicated: concat would corrupt
     pad = jnp.broadcast_to(jnp.asarray(fill_row), (rem,) + x.shape[1:])
     return jnp.concatenate([jnp.asarray(x), pad], axis=0)
 
@@ -80,6 +102,8 @@ class ShardedGroupOps:
             raise ValueError(
                 f"wp={self.nwp} must divide nwin8={ops.nwin8}")
         self.ctx = ops.ctx
+        self.n = ops.n     # limb counts: callers reshape dispatch outputs
+        self.ne = ops.ne   # (mixnet proof/verify) exactly like JaxGroupOps
         self._one_p = np.zeros(ops.n, np.uint32)
         self._one_p[0] = 1
         self._zero_q = np.zeros(ops.ne, np.uint32)
@@ -89,6 +113,7 @@ class ShardedGroupOps:
         self._mulmod_j = self._build_elementwise(ops._mulmod_impl)
         self._residue_j = self._build_elementwise(ops._verify_residue_impl)
         self._fixed_pow_j = self._build_fixed_pow()
+        self._fixed_multi_pow_j = self._build_fixed_multi_pow()
         self._prod_reduce_j = self._build_prod_reduce()
 
     # -- codecs delegate to the single-chip plane ----------------------
@@ -140,6 +165,36 @@ class ShardedGroupOps:
         mapped = shard_map(
             kernel, mesh=self.mesh,
             in_specs=(P(WP_AXIS), P(DP_AXIS, WP_AXIS)),
+            out_specs=P(DP_AXIS))
+        return jax.jit(mapped)
+
+    def _build_fixed_multi_pow(self):
+        """∏_j tables[j]^{exps[:,j]} for k host-known bases — the k-base
+        PowRadix ladder behind the mixnet's bridging-chain and t̂ sigma
+        commitments (group_jax._fixed_multi_pow_impl), with the window
+        axis of every base's table sharded over wp and the gathers'
+        batch axis over dp.  The k·local_wins per-device partials merge
+        into one Montgomery product, then the wp partials combine with
+        the same all-gather + log-tree as ``fixed_pow``."""
+        ops = self.ops
+        ctx = ops.ctx
+        local_wins = ops.nwin8 // self.nwp
+
+        def kernel(tables, digits):
+            # tables: (k, local_wins, 256, n); digits: (b_loc, k, local_wins)
+            k = tables.shape[0]
+            acc = None
+            for j in range(k):
+                for i in range(local_wins):
+                    sel = tables[j, i][digits[:, j, i]]    # (b_loc, n)
+                    acc = sel if acc is None else ops._mm(acc, sel)
+            parts = lax.all_gather(acc, WP_AXIS)           # (nwp, b_loc, n)
+            return bn.from_mont_via(
+                ops._mm, bn.mont_prod_tree(ctx, parts, montmul_fn=ops._mm))
+
+        mapped = shard_map(
+            kernel, mesh=self.mesh,
+            in_specs=(P(None, WP_AXIS), P(DP_AXIS, None, WP_AXIS)),
             out_specs=P(DP_AXIS))
         return jax.jit(mapped)
 
@@ -204,6 +259,20 @@ class ShardedGroupOps:
 
     def base_pow(self, base: int, exp):
         return self._fixed_pow(self.ops.fixed_table(base), exp)
+
+    def fixed_multi_pow(self, bases, exps):
+        """∏_j bases[j]^{exps[:, j]} for k host-known bases via cached
+        tables: exps (B, k, ne) -> (B, n), dp-sharded batch, wp-sharded
+        windows (mirrors JaxGroupOps.fixed_multi_pow; zero-exponent
+        padding rows evaluate to 1)."""
+        tables = jnp.stack([self.ops.fixed_table(b) for b in bases])
+        exps = jnp.asarray(exps)
+        b, k = exps.shape[0], exps.shape[1]
+        digits = self._digits8(exps.reshape(b * k, -1)).reshape(
+            b, k, self.ops.nwin8)
+        digits = _pad_rows(digits, self.ndp,
+                           np.zeros((k, self.ops.nwin8), np.int32))
+        return self._fixed_multi_pow_j(tables, digits)[:b]
 
     def prod_reduce(self, x):
         """Product over axis 0: (M, B, n) -> (B, n), dp-sharded over M."""

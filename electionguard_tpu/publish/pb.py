@@ -102,3 +102,11 @@ DecryptionResult = msg("DecryptionResult")
 MixRow = msg("MixRow")
 MixProof = msg("MixProof")
 MixStageHeader = msg("MixStageHeader")
+RegisterMixServerRequest = msg("RegisterMixServerRequest")
+RegisterMixServerResponse = msg("RegisterMixServerResponse")
+MixStageRequest = msg("MixStageRequest")
+MixStageReady = msg("MixStageReady")
+MixRowChunk = msg("MixRowChunk")
+MixRowRequest = msg("MixRowRequest")
+MixShuffleRequest = msg("MixShuffleRequest")
+MixStageResult = msg("MixStageResult")

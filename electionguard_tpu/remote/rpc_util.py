@@ -118,6 +118,11 @@ _DEADLINE_CLASS_OF = {
     "compensatedDecrypt": "data",
     "encryptBallot": "data",
     "encryptBallotBatch": "data",
+    "registerMixServer": "registration",
+    "registerStage": "control",
+    "pushRows": "data",
+    "shuffleStage": "data",
+    "pullRows": "data",
 }
 
 

@@ -103,6 +103,19 @@ def main(argv=None) -> int:
                          "accumulation and decryption (0 = none); the "
                          "published mix cascade is checked by the "
                          "verifier's V15 family in phase 5")
+    ap.add_argument("-mixServers", dest="mix_servers", type=int, default=0,
+                    help="run N mix stages FEDERATED: one mix-server "
+                         "subprocess per stage plus a coordinator that "
+                         "verifies every stage before forwarding it "
+                         "(mutually exclusive with -mix; same published "
+                         "artifact, same V15 checks in phase 5)")
+    ap.add_argument("-chaosKillMixServer", dest="chaos_mix",
+                    action="store_true",
+                    help="chaos hook for -mixServers: mix-server-0 "
+                         "hard-crashes (EGTPU_FAULT_PLAN crash_after) "
+                         "right after its first shuffle commits; the "
+                         "coordinator must requeue the stage on the "
+                         "extra spare this flag also launches")
     ap.add_argument("-spoilEvery", dest="spoil_every", type=int, default=5,
                     help="spoil every Nth ballot (0 = none); spoiled "
                          "ballots are decrypted in phase 4 and checked by "
@@ -122,6 +135,10 @@ def main(argv=None) -> int:
                          "restarts from its resume file; the ceremony "
                          "must still complete (fault-injection harness)")
     args = ap.parse_args(argv)
+    if args.mix > 0 and args.mix_servers > 0:
+        log.error("-mix and -mixServers are mutually exclusive (same "
+                  "artifact, different topology)")
+        return 1
 
     out = args.output
     record_dir = os.path.join(out, "record")
@@ -259,6 +276,66 @@ def main(argv=None) -> int:
         if not wait_all([mix], timeout=600):
             return phase_fail("mixnet", [mix])
         log.info("[3.5] %d mix stages took %.1fs", args.mix,
+                 time.time() - t0)
+
+    # ---- phase 3.5 (federated): one mix-server process per stage ---------
+    if args.mix_servers > 0:
+        t0 = time.time()
+        phases.begin("phase.mixfed")
+        mix_port = find_free_port()
+        n_servers = args.mix_servers + (1 if args.chaos_mix else 0)
+        mcoord = RunCommand.python_module(
+            "mix-coordinator", "electionguard_tpu.cli.run_mix_coordinator",
+            ["-in", record_dir, "-out", record_dir,
+             "-stages", str(args.mix_servers),
+             "-servers", str(n_servers), "-port", str(mix_port),
+             "-registrationTimeout", "90",
+             "-checkpointFile", os.path.join(out, "mix_checkpoint.json")]
+            + group_flags, cmd_out)
+        time.sleep(1.5)  # let the registration service bind
+
+        def launch_mix_server(i, env=None):
+            return RunCommand.python_module(
+                f"mix-server-{i}", "electionguard_tpu.cli.run_mix_server",
+                ["-name", f"mix-{i}", "-serverPort", str(mix_port)]
+                + group_flags, cmd_out, env=env)
+
+        mix_servers = []
+        if args.chaos_mix:
+            # deterministic death at a protocol point: the victim
+            # hard-exits right after its first shuffle commits (the
+            # result is lost with the process); the coordinator's
+            # bounded retries must requeue the stage on the spare.
+            # The coordinator assigns stages in REGISTRATION order, so
+            # the victim launches alone and must be registered before
+            # the honest servers start — otherwise it could end up an
+            # unused spare and the drill would silently test nothing.
+            log.info("CHAOS: mix-server-0 dies after its first shuffle "
+                     "commits; its stage must requeue on the spare")
+            victim = launch_mix_server(0, env={
+                "EGTPU_FAULT_PLAN": json.dumps({"rules": [
+                    {"method": "shuffleStage", "kind": "crash_after",
+                     "on_calls": [1]}]})})
+            mix_servers.append(victim)
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                with open(mcoord.stdout_path, "rb") as f:
+                    if b"registered mix server mix-0" in f.read():
+                        break
+                time.sleep(0.25)
+            else:
+                return phase_fail("mixfed", [mcoord, victim])
+        for i in range(len(mix_servers), n_servers):
+            mix_servers.append(launch_mix_server(i))
+        procs.extend([mcoord] + mix_servers)
+        # the chaos victim dies by design (exit 137) — don't gate the
+        # phase on its exit code
+        waited = [mcoord] + (mix_servers[1:] if args.chaos_mix
+                             else mix_servers)
+        if not wait_all(waited, timeout=600):
+            return phase_fail("mixfed", [mcoord] + mix_servers)
+        log.info("[3.5] %d federated mix stages over %d server "
+                 "processes took %.1fs", args.mix_servers, n_servers,
                  time.time() - t0)
 
     # ---- phase 4: remote decryption (multi-process) ----------------------

@@ -81,6 +81,67 @@ def test_five_phase_workflow_mixed(tmp_path):
         str(tmp_path), "record", "mix_stage_001.pb"))
 
 
+def test_five_phase_workflow_federated_mix(tmp_path):
+    """The federated twin of ``-mix K``: 2 mix stages as 2 separate
+    mix-server OS processes plus a coordinator process, traced.  The
+    published cascade must be chain-contiguous, carry the SAME verdict
+    as the single-process path (every V15 check green through the same
+    phase-5 verifier), and the whole topology must join the run's single
+    trace id."""
+    proc = _run_workflow(tmp_path, "tiny", nballots=8, timeout=600,
+                         extra_flags=["-mixServers", "2", "-trace"])
+    out = proc.stdout + proc.stderr
+    assert "2 federated mix stages over 2 server processes" in out
+    # identical verdict to the -mix path (test_five_phase_workflow_mixed):
+    # the full V15 family is green through the SAME verifier binary
+    for check in ("mix_structure", "mix_chain", "mix_membership",
+                  "mix_binding", "mix_permutation", "mix_reencryption"):
+        assert f"PASS V15.{check}" in out, out
+    # chain-contiguous published stages: densely numbered, nothing extra
+    record = os.path.join(str(tmp_path), "record")
+    assert os.path.exists(os.path.join(record, "mix_stage_000.pb"))
+    assert os.path.exists(os.path.join(record, "mix_stage_001.pb"))
+    assert not os.path.exists(os.path.join(record, "mix_stage_002.pb"))
+
+    # one trace id across the driver, the coordinator, and both servers
+    from electionguard_tpu.obs import assemble
+    spans = assemble.load_spans(os.path.join(str(tmp_path), "trace"))
+    report = assemble.validate(spans)
+    assert len(report["trace_ids"]) == 1
+    procs = {p.split(":")[0] for p in report["processes"]}
+    assert {"mix-coordinator", "mix-server-0", "mix-server-1"} <= procs
+    names = {s["name"] for s in spans}
+    assert {"phase.mixfed", "mixfed.stage", "mixfed.forward"} <= names
+    # each server span tree carries exactly its own stage
+    stage_of = {s["attrs"]["server"]: s["attrs"]["stage"]
+                for s in spans if s["name"] == "mixfed.stage"}
+    assert stage_of == {"mix-0": 0, "mix-1": 1}
+
+
+def test_five_phase_workflow_federated_mix_chaos_kill(tmp_path):
+    """Subprocess SIGKILL drill: mix-server-0 hard-exits (os._exit, no
+    drain) right after its first shuffle commits.  The coordinator's
+    bounded retries surface the death, the stage requeues on the spare
+    the chaos flag launches, and the final record still verifies green —
+    zero dropped or duplicated rows."""
+    proc = _run_workflow(tmp_path, "tiny", nballots=6, timeout=600,
+                         extra_flags=["-mixServers", "2",
+                                      "-chaosKillMixServer"])
+    out = proc.stdout + proc.stderr
+    assert "2 federated mix stages over 3 server processes" in out
+    for check in ("mix_structure", "mix_chain", "mix_membership",
+                  "mix_binding", "mix_permutation", "mix_reencryption"):
+        assert f"PASS V15.{check}" in out, out
+    with open(os.path.join(str(tmp_path), "logs",
+                           "mix-server-0.stdout")) as f:
+        victim_log = f.read()
+    assert "injected crash after shuffleStage" in victim_log
+    with open(os.path.join(str(tmp_path), "logs",
+                           "mix-coordinator.stdout")) as f:
+        coord_log = f.read()
+    assert "requeueing on a spare" in coord_log
+
+
 def test_five_phase_workflow_traced(tmp_path):
     """Observability acceptance: one traced e2e run yields a merged
     Chrome-trace timeline with spans from every spawned process under a

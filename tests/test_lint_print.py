@@ -52,7 +52,7 @@ def test_no_bare_print_in_library_code():
                     f"{os.path.relpath(path, PKG_DIR)}:{lineno}")
     # the walk is recursive by construction; pin the newer packages so a
     # future layout change can't silently drop them from the lint
-    assert {"mixnet", "obs", "serve"} <= scanned_pkgs
+    assert {"mixnet", "mixfed", "obs", "serve"} <= scanned_pkgs
     assert not offenders, (
         "bare print() in library code (use logging — obs.slog mirrors "
         "it as structured JSONL with trace context):\n  "

@@ -1,0 +1,289 @@
+"""Federated mix plane tests (tiny group, non-slow, in-process).
+
+The acceptance surface of the mixfed subsystem:
+
+* a 3-stage federated run (real gRPC between in-process servers)
+  publishes a record that the standard ``verify_stages`` path passes
+  with every V15 mix check green;
+* the trust boundary is STRUCTURAL: a server refuses a second stage
+  in-band, so no process ever holds two stages' permutations or
+  randomness (asserted by inspecting server state);
+* a tampering server is caught by the coordinator's pre-forward
+  verification as a ``mix_binding`` failure — requeued onto a spare
+  when one exists, a hard ``MixFedError`` naming the check when not,
+  and in both cases NOTHING tainted reaches the published record;
+* a server killed mid-stage (fault-plan ``crash_after``) costs one
+  requeue onto a spare and the final record still verifies with zero
+  dropped or duplicated rows;
+* a restarted coordinator resumes at the first unpublished stage
+  instead of re-mixing verified work.
+"""
+
+import threading
+
+import pytest
+
+from electionguard_tpu.core.group import tiny_group
+from electionguard_tpu.crypto.elgamal import ElGamalKeypair, elgamal_encrypt
+from electionguard_tpu.mixfed import (MixCoordinator, MixFedError,
+                                      MixServerServer)
+from electionguard_tpu.mixnet.verify_mix import verify_stages
+from electionguard_tpu.obs import REGISTRY
+from electionguard_tpu.publish import pb, serialize
+from electionguard_tpu.publish.publisher import Consumer
+from electionguard_tpu.remote import rpc_util
+from electionguard_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    yield
+    faults.clear()
+
+
+@pytest.fixture
+def fastrpc(monkeypatch):
+    """Fast deterministic retries so dead-server detection is sub-second."""
+    monkeypatch.setenv("EGTPU_RPC_RETRIES", "2")
+    monkeypatch.setenv("EGTPU_RPC_RETRY_WAIT", "0.2")
+    monkeypatch.setenv("EGTPU_RPC_RETRY_CAP", "0.4")
+    monkeypatch.setenv("EGTPU_RPC_CONNECT_WINDOW", "0.4")
+    monkeypatch.setattr(rpc_util, "_uniform", lambda lo, hi: hi)
+
+
+@pytest.fixture(scope="module")
+def mixkey():
+    g = tiny_group()
+    return ElGamalKeypair.from_secret(g.int_to_q(987654321))
+
+
+def _encrypt_rows(g, K, n, w, seed=1000):
+    pads, datas = [], []
+    for i in range(n):
+        row_a, row_b = [], []
+        for j in range(w):
+            ct = elgamal_encrypt(g, (i + j) % 2,
+                                 g.int_to_q(seed + i * w + j), K)
+            row_a.append(ct.pad.value)
+            row_b.append(ct.data.value)
+        pads.append(row_a)
+        datas.append(row_b)
+    return pads, datas
+
+
+class _Init:
+    def __init__(self, K, qbar):
+        self.joint_public_key = K
+        self.extended_base_hash = qbar
+
+
+class _Res:
+    def __init__(self):
+        self.failures = []
+
+    def record(self, name, ok, msg=""):
+        if not ok:
+            self.failures.append((name, msg))
+
+
+def _verify_record(g, K, qbar, out_dir, in_pads, in_datas, n_stages):
+    stages = Consumer(out_dir, g).read_mix_stages()
+    assert len(stages) == n_stages
+    res = _Res()
+    ok = verify_stages(g, _Init(K, qbar), stages, res,
+                       lambda: (in_pads, in_datas))
+    assert ok, f"record failed verification: {res.failures}"
+    return stages
+
+
+def _shutdown(coord, servers, all_ok=True):
+    coord.shutdown(all_ok=all_ok)
+    for s in servers:
+        s.server.stop(grace=0)
+
+
+# ---------------------------------------------------------------------------
+# happy path + trust boundary
+# ---------------------------------------------------------------------------
+
+def test_three_stage_federated_record_verifies(tmp_path, mixkey):
+    """Three stages over four servers (one spare): the published record
+    passes every V15 mix check, each stage ran on a DIFFERENT server,
+    and the spare held nothing."""
+    g = tiny_group()
+    K, qbar = mixkey.public_key, g.int_to_q(424242)
+    pads, datas = _encrypt_rows(g, K, 9, 2)
+    coord = MixCoordinator(g, str(tmp_path), port=0)
+    servers = [MixServerServer(g, coord.url, f"mix{i}") for i in range(4)]
+    try:
+        assert coord.wait_for_servers(3, timeout=30)
+        assert coord.run_mix(K.value, qbar, 3, pads, datas) == 3
+        stages = _verify_record(g, K, qbar, str(tmp_path),
+                                pads, datas, 3)
+        assert [s.stage_index for s in stages] == [0, 1, 2]
+        # ---- trust boundary: one stage per process, ever -------------
+        held = sorted(s.held_stage for s in servers
+                      if s.held_stage is not None)
+        assert held == [0, 1, 2]          # three distinct stages...
+        assert sum(s.held_stage is None for s in servers) == 1  # ...one idle
+        for s in servers:
+            # a server's entire mixing state concerns ITS stage only:
+            # the permutation/randomness seed never leaves run_stage,
+            # and the buffered rows/result are the held stage's alone
+            if s.held_stage is None:
+                assert not s._chunks and s._result is None
+            else:
+                assert int(s._result.header.stage_index) == s.held_stage
+    finally:
+        _shutdown(coord, servers)
+
+
+def test_server_refuses_second_stage(tmp_path, mixkey):
+    """The one-stage-per-process invariant is enforced by the SERVER,
+    not by coordinator bookkeeping: a second registerStage for a
+    different stage is refused in-band."""
+    g = tiny_group()
+    coord = MixCoordinator(g, str(tmp_path), port=0)
+    server = MixServerServer(g, coord.url, "mix0")
+    try:
+        channel = rpc_util.make_channel(server.url)
+        stub = rpc_util.Stub(channel, "MixServerService")
+
+        def assign(k):
+            return stub.call("registerStage", pb.MixStageRequest(
+                stage_index=k,
+                joint_public_key=serialize._pub_p_int(g, mixkey.public_key.value),
+                extended_base_hash=serialize.publish_q(g.int_to_q(1)),
+                n_rows=2, width=1, group_fingerprint=g.fingerprint()))
+
+        assert assign(0).error == ""
+        assert assign(0).error == ""          # same stage: idempotent
+        err = assign(1).error
+        assert "already holds stage 0" in err
+        assert server.held_stage == 0
+        channel.close()
+    finally:
+        _shutdown(coord, [server])
+
+
+# ---------------------------------------------------------------------------
+# adversarial: tampering server
+# ---------------------------------------------------------------------------
+
+def test_tampering_server_requeued_on_spare(tmp_path, mixkey):
+    """A server that corrupts an output ciphertext after proving is
+    caught by the coordinator's pre-forward verification (the
+    Fiat–Shamir challenge no longer re-derives → mix_binding), its
+    stage is requeued on an honest spare, and the published record is
+    clean."""
+    g = tiny_group()
+    K, qbar = mixkey.public_key, g.int_to_q(424242)
+    pads, datas = _encrypt_rows(g, K, 6, 1)
+    coord = MixCoordinator(g, str(tmp_path), port=0)
+    bad_counter = REGISTRY.counter("mixfed_bad_proofs_total")
+    before = bad_counter.value
+    # the tamperer registers FIRST, so stage 0 is assigned to it
+    cheat = MixServerServer(g, coord.url, "cheat", tamper=True)
+    honest = [MixServerServer(g, coord.url, f"mix{i}") for i in range(2)]
+    try:
+        assert coord.wait_for_servers(3, timeout=30)
+        assert coord.run_mix(K.value, qbar, 2, pads, datas) == 2
+        _verify_record(g, K, qbar, str(tmp_path), pads, datas, 2)
+        assert bad_counter.value == before + 1
+        assert next(s for s in coord.servers if s.id == "cheat").failed
+    finally:
+        _shutdown(coord, [cheat] + honest)
+
+
+def test_tamper_aborts_before_forwarding_without_spare(tmp_path, mixkey):
+    """With no spare left the coordinator ABORTS, naming the failing
+    check class — and the tainted stage never reaches the record."""
+    g = tiny_group()
+    K, qbar = mixkey.public_key, g.int_to_q(424242)
+    pads, datas = _encrypt_rows(g, K, 4, 1)
+    coord = MixCoordinator(g, str(tmp_path), port=0)
+    cheat = MixServerServer(g, coord.url, "cheat", tamper=True)
+    try:
+        assert coord.wait_for_servers(1, timeout=30)
+        with pytest.raises(MixFedError) as ei:
+            coord.run_mix(K.value, qbar, 1, pads, datas)
+        assert ei.value.check == "mix_binding"
+        # abort happened BEFORE forwarding: nothing was published
+        assert Consumer(str(tmp_path), g).mix_stage_count() == 0
+    finally:
+        _shutdown(coord, [cheat], all_ok=False)
+
+
+# ---------------------------------------------------------------------------
+# chaos: server killed mid-stage
+# ---------------------------------------------------------------------------
+
+def test_crash_mid_stage_requeues_on_spare(tmp_path, mixkey, fastrpc):
+    """The victim dies right after its first shuffleStage commits (the
+    response is lost, the process is gone).  The coordinator's bounded
+    retries surface UNAVAILABLE, the stage is requeued on the spare,
+    and the final record verifies with zero dropped or duplicated
+    rows."""
+    g = tiny_group()
+    K, qbar = mixkey.public_key, g.int_to_q(424242)
+    pads, datas = _encrypt_rows(g, K, 6, 1)
+    victim: dict = {}
+    plan = faults.FaultPlan(rules=[faults.FaultRule(
+        method="shuffleStage", kind="crash_after", on_calls=(1,))])
+    plan.crash_cb = lambda _m: threading.Timer(
+        0.05, lambda: victim["server"].server.stop(grace=0)).start()
+    faults.install(plan)
+    requeue = REGISTRY.counter("mixfed_stage_requeues_total")
+    before = requeue.value
+    coord = MixCoordinator(g, str(tmp_path), port=0)
+    servers = [MixServerServer(g, coord.url, f"mix{i}") for i in range(3)]
+    victim["server"] = servers[0]
+    try:
+        assert coord.wait_for_servers(3, timeout=30)
+        assert coord.run_mix(K.value, qbar, 2, pads, datas) == 2
+        assert requeue.value == before + 1
+        assert plan.injected, "the crash plan never fired"
+        stages = _verify_record(g, K, qbar, str(tmp_path),
+                                pads, datas, 2)
+        # zero dropped/duplicated rows, by construction and by check:
+        # verification green implies each stage is a permutation of its
+        # input; row counts pin the cardinality
+        assert all(s.n_rows == 6 for s in stages)
+    finally:
+        _shutdown(coord, servers)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume
+# ---------------------------------------------------------------------------
+
+def test_coordinator_restart_resumes_at_unpublished_stage(tmp_path, mixkey):
+    """A coordinator that dies between stages is relaunched against the
+    same output dir + checkpoint file: verified stages are NOT re-mixed,
+    the cascade continues from the published chain head, and the full
+    record verifies."""
+    g = tiny_group()
+    K, qbar = mixkey.public_key, g.int_to_q(424242)
+    pads, datas = _encrypt_rows(g, K, 5, 1)
+    cp = str(tmp_path / "mix_checkpoint.json")
+    out = str(tmp_path / "record")
+
+    coord1 = MixCoordinator(g, out, port=0, checkpoint_file=cp)
+    first = [MixServerServer(g, coord1.url, f"a{i}") for i in range(2)]
+    try:
+        assert coord1.wait_for_servers(2, timeout=30)
+        assert coord1.run_mix(K.value, qbar, 2, pads, datas) == 2
+    finally:
+        _shutdown(coord1, first)
+
+    # "restart": a fresh coordinator + fresh servers, same out/checkpoint
+    coord2 = MixCoordinator(g, out, port=0, checkpoint_file=cp)
+    second = [MixServerServer(g, coord2.url, "b0")]
+    try:
+        assert coord2.wait_for_servers(1, timeout=30)
+        # only the one unpublished stage runs — one server suffices
+        assert coord2.run_mix(K.value, qbar, 3, pads, datas) == 1
+        assert second[0].held_stage == 2
+        _verify_record(g, K, qbar, out, pads, datas, 3)
+    finally:
+        _shutdown(coord2, second)

@@ -1,0 +1,127 @@
+"""Sharded-plane pins: the jax-0.4.37 concatenate repro + the sharded
+shuffle/proof differential.
+
+Two invariants the mixfed servers' ``-shards`` plane rests on:
+
+* ``parallel/sharded._pad_rows`` must NEVER route a partially-replicated
+  operand (dp-sharded on a wp>1 mesh) through device ``jnp.concatenate``
+  — jax 0.4.37's CPU backend lowers that with a wrong row stride and
+  silently corrupts the data.  The fix is a host detour; this file pins
+  both the detour's correctness and (on affected jax builds) the raw
+  corruption that makes it necessary.  ``__graft_entry__``'s multichip
+  dryrun composes concatenate-free for the same reason.
+* a ``ShardedGroupOps``-mounted shuffle stage must be BIT-IDENTICAL to
+  the single-device stage — same permutation, same re-encryption
+  randomness, same TW proof transcript — so a federated record never
+  reveals which topology mixed it.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from electionguard_tpu.core.group import tiny_group
+from electionguard_tpu.core.group_jax import jax_ops
+from electionguard_tpu.mixnet.proof import rows_digest
+from electionguard_tpu.mixnet.shuffle import Shuffler
+from electionguard_tpu.mixnet.stage import run_stage
+from electionguard_tpu.mixnet.verify_mix import verify_stage
+from electionguard_tpu.parallel.mesh import DP_AXIS, WP_AXIS, election_mesh
+from electionguard_tpu.parallel.sharded import (ShardedGroupOps,
+                                                _pad_rows,
+                                                _partially_replicated)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device mesh")
+
+
+def _dp_sharded_on_wp2_mesh(rows: int = 8, cols: int = 6):
+    """An array committed dp-sharded on a (dp=4, wp=2) mesh — the
+    partially-replicated layout whose concatenate lowering 0.4.37
+    corrupts."""
+    mesh = election_mesh(8, wp=2)
+    x = np.arange(rows * cols, dtype=np.uint32).reshape(rows, cols)
+    return mesh, x, jax.device_put(x, NamedSharding(mesh, P(DP_AXIS)))
+
+
+def test_partially_replicated_detector():
+    mesh, _, committed = _dp_sharded_on_wp2_mesh()
+    # dp-sharded but wp-replicated: the wp axis (size 2) is unused
+    assert _partially_replicated(committed)
+    # plain numpy / uncommitted arrays: no sharding to misread
+    assert not _partially_replicated(np.zeros((4, 4), np.uint32))
+    # fully-specified placement (both axes used) is safe to concatenate
+    both = jax.device_put(np.zeros((4, 8), np.uint32),
+                          NamedSharding(mesh, P(DP_AXIS, WP_AXIS)))
+    assert not _partially_replicated(both)
+
+
+def test_pad_rows_detours_partially_replicated_operands():
+    """The fix: padding a dp-sharded-on-wp2 array up to a row multiple
+    must produce exactly the numpy reference, whatever the backend's
+    concatenate lowering does."""
+    _, x, committed = _dp_sharded_on_wp2_mesh(rows=12, cols=6)
+    fill = np.full((6,), 9, np.uint32)
+    want = np.concatenate([x, np.broadcast_to(fill, (4, 6))], axis=0)
+    got = np.asarray(_pad_rows(committed, 8, fill))
+    np.testing.assert_array_equal(got, want)
+    # no-op padding keeps the committed array untouched
+    even = np.asarray(_pad_rows(committed, 4, fill))
+    np.testing.assert_array_equal(even, x)
+
+
+def test_concatenate_corruption_repro_is_flagged():
+    """The repro pin: on jax builds where device concatenate over the
+    partially-replicated layout corrupts (0.4.37 CPU does), the operand
+    MUST be one ``_partially_replicated`` flags — i.e. the detour
+    engages exactly where the bug lives.  On fixed builds the raw path
+    matching the reference is equally green; the invariant is that no
+    corrupted layout ever goes unflagged."""
+    _, x, committed = _dp_sharded_on_wp2_mesh(rows=8, cols=6)
+    pad = jnp.zeros((2, 6), jnp.uint32)
+    raw = np.asarray(jnp.concatenate([committed, pad], axis=0))
+    want = np.concatenate([x, np.zeros((2, 6), np.uint32)], axis=0)
+    if not np.array_equal(raw, want):
+        # the 0.4.37 stride bug, live on this build
+        assert _partially_replicated(committed), \
+            "corrupting layout not flagged — _pad_rows would ship it"
+
+
+def test_sharded_stage_bit_identical_and_verifies():
+    """One TW mix stage through ``ShardedGroupOps`` on the full (dp=4,
+    wp=2) virtual mesh vs the single-device plane, same seed: identical
+    outputs, identical proof transcript, and the stage verifies green
+    through BOTH planes."""
+    g = tiny_group()
+    ops = jax_ops(g)
+    sops = ShardedGroupOps(ops, election_mesh(8, wp=2))
+    K = pow(g.g, 12345, g.p)
+    n, w = 7, 2
+    pads = [[pow(g.g, i * w + j + 1, g.p) for j in range(w)]
+            for i in range(n)]
+    datas = [[pow(K, i * w + j + 1, g.p) for j in range(w)]
+             for i in range(n)]
+    qbar, seed = g.int_to_q(424242), b"sharded-differential"
+
+    st1 = run_stage(g, K, qbar, 0, pads, datas, seed=seed,
+                    shuffler=Shuffler(g, K))
+    st2 = run_stage(g, K, qbar, 0, pads, datas, seed=seed,
+                    shuffler=Shuffler(g, K, ops=sops))
+    assert st1.pads == st2.pads and st1.datas == st2.datas
+    assert st1.proof == st2.proof
+
+    class _Res:
+        def __init__(self):
+            self.failures = []
+
+        def record(self, name, ok, msg=""):
+            if not ok:
+                self.failures.append((name, msg))
+
+    ih = rows_digest(g, pads, datas)
+    for plane in (sops, None):
+        res = _Res()
+        assert verify_stage(g, K, qbar, st2, pads, datas, ih, res,
+                            ops=plane), res.failures

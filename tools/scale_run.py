@@ -28,6 +28,16 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# --mix-sharded needs the virtual 8-device CPU mesh; XLA reads this at
+# first jax import, so it must land in the environment before ANY
+# electionguard module pulls jax in (they all import lazily, in-function)
+if any(a.startswith("--mix-sharded") for a in sys.argv):
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
 
 def rss_mb() -> float:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
@@ -174,12 +184,82 @@ def prod_phase(nballots: int) -> dict:
     }
 
 
+def mix_sharded_phase(n_rows: int, width: int = 2) -> dict:
+    """dp-scaling row for the sharded shuffle plane (ISSUE 6 satellite /
+    ADVICE item 6): one TW mix stage (shuffle + prove) at dp=1 vs the
+    row axis dp-sharded over the virtual 8-device mesh, differential-
+    asserted BIT-IDENTICAL (same seed -> same permutation, same
+    re-encryption randomness, same transcript).  On virtual CPU devices
+    all 8 'chips' share one host, so dp8_stage_s measures the sharded
+    plane's dispatch overhead, not real scaling — the row is the
+    plumbing evidence a pod run slots into."""
+    import jax
+
+    from electionguard_tpu.core.group import tiny_group
+    from electionguard_tpu.core.group_jax import jax_ops
+    from electionguard_tpu.crypto.elgamal import (ElGamalKeypair,
+                                                  elgamal_encrypt)
+    from electionguard_tpu.mixnet.shuffle import Shuffler
+    from electionguard_tpu.mixnet.stage import run_stage
+    from electionguard_tpu.parallel.mesh import election_mesh
+    from electionguard_tpu.parallel.sharded import ShardedGroupOps
+
+    n_dev = len(jax.devices())
+    assert n_dev >= 8, f"need the virtual 8-device mesh, got {n_dev}"
+    g = tiny_group()
+    key = ElGamalKeypair.from_secret(g.int_to_q(987654321))
+    K, qbar = key.public_key, g.int_to_q(424242)
+    pads, datas = [], []
+    for i in range(n_rows):
+        row_a, row_b = [], []
+        for j in range(width):
+            ct = elgamal_encrypt(g, (i + j) % 2,
+                                 g.int_to_q(9000 + i * width + j), K)
+            row_a.append(ct.pad.value)
+            row_b.append(ct.data.value)
+        pads.append(row_a)
+        datas.append(row_b)
+    seed = b"scale-mix-sharded"
+
+    def one(ops, tag):
+        sh = Shuffler(g, K.value, ops=ops)
+        run_stage(g, K.value, qbar, 0, pads, datas, seed=seed,
+                  shuffler=sh)                       # warm/compile
+        t0 = time.time()
+        st = run_stage(g, K.value, qbar, 0, pads, datas, seed=seed,
+                       shuffler=sh)
+        dt = time.time() - t0
+        print(f"  {tag}: {dt:.2f}s ({n_rows / dt:.1f} rows/s)",
+              flush=True)
+        return st, dt
+
+    st1, t1 = one(None, "dp=1 (single device)")
+    sharded = ShardedGroupOps(jax_ops(g), election_mesh(8))
+    st8, t8 = one(sharded, "dp=8 (virtual mesh)")
+    identical = (st1.pads == st8.pads and st1.datas == st8.datas
+                 and st1.proof == st8.proof)
+    assert identical, "sharded stage diverged from single-device stage"
+    return {
+        "phase": "mix_sharded", "group": "tiny",
+        "platform": jax.devices()[0].platform, "devices": n_dev,
+        "n_rows": n_rows, "width": width,
+        "dp1_stage_s": round(t1, 2), "dp8_stage_s": round(t8, 2),
+        "dp1_rows_per_s": round(n_rows / t1, 1),
+        "dp8_rows_per_s": round(n_rows / t8, 1),
+        "bit_identical": identical,
+        "peak_rss_mb": round(rss_mb(), 1),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser("scale_run")
     ap.add_argument("--stream", type=int, default=0,
                     help="streamed tiny-group ballots (e.g. 100000)")
     ap.add_argument("--prod", type=int, default=0,
                     help="production-group verify wall-clock ballots")
+    ap.add_argument("--mix-sharded", type=int, default=0,
+                    help="dp-scaling rows for the sharded shuffle on "
+                         "the virtual 8-device mesh (N = rows)")
     ap.add_argument("--chunk", type=int, default=4096)
     ap.add_argument("--workdir", default="/tmp/egtpu_scale")
     ap.add_argument("--out", default=os.path.join(
@@ -198,6 +278,10 @@ def main() -> int:
         results.append(r)
     if args.prod:
         r = prod_phase(args.prod)
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    if args.mix_sharded:
+        r = mix_sharded_phase(args.mix_sharded)
         print(json.dumps(r), flush=True)
         results.append(r)
 
