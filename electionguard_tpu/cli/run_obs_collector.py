@@ -1,0 +1,65 @@
+"""Obs collector binary: the run's telemetry sink and fleet SLO engine.
+
+Starts the ``ObsCollectorService`` gRPC server (obs/collector.py) plus
+the fleet-merged Prometheus ``/metrics`` endpoint, then blocks until a
+``finish`` rpc (the workflow driver sends one at the end of the run) or
+SIGTERM.  Every other process of the run points at it with
+``EGTPU_OBS_COLLECTOR=<host:port>``.
+
+Run:  python -m electionguard_tpu.cli.run_obs_collector -port 17171 \
+          -metricsPort 9090 -out /tmp/run-obs
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from electionguard_tpu.cli.common import setup_logging
+from electionguard_tpu.obs import collector as collector_mod
+from electionguard_tpu.obs import slo
+
+
+def main(argv=None) -> int:
+    log = setup_logging("RunObsCollector")
+    ap = argparse.ArgumentParser("RunObsCollector")
+    ap.add_argument("-port", type=int, default=17171,
+                    help="collector rpc port (0 = random free port)")
+    ap.add_argument("-metricsPort", dest="metrics_port", type=int,
+                    default=0,
+                    help="fleet /metrics http port (0 = ephemeral; "
+                         "-1 = disabled)")
+    ap.add_argument("-out", default=".",
+                    help="output dir: received spans/logs under recv/, "
+                         "live timeline at trace_live.json")
+    ap.add_argument("-slo", default="",
+                    help="SLO config: inline JSON or @file, deep-merged "
+                         "over obs.slo.DEFAULT_SLO (also EGTPU_OBS_SLO)")
+    args = ap.parse_args(argv)
+
+    config = slo.load_config(args.slo or None)
+    http_port = None if args.metrics_port < 0 else args.metrics_port
+    collector, server, bound, http_bound = collector_mod.serve(
+        args.port, args.out, slo_config=config, http_port=http_port)
+    log.info("obs collector serving on :%d; fleet scrape on :%s; "
+             "out dir %s", bound, http_bound, args.out)
+
+    done = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: done.set())
+    while not done.is_set() and not collector._stop.is_set():
+        done.wait(0.25)
+    collector.stop()
+    server.stop(grace=2.0).wait()
+    report = collector.live_report
+    log.info("obs collector done: %d spans from %d processes, "
+             "%d slo evals, timeline %s",
+             report.get("n_spans", 0), len(report.get("processes", [])),
+             collector.engine.evals, collector.live_path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

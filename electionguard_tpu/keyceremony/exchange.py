@@ -85,12 +85,14 @@ def key_ceremony_exchange(
 def _key_ceremony_exchange(
         trustees: Sequence[KeyCeremonyTrusteeIF],
         group: GroupContext) -> Union[KeyCeremonyResults, Result]:
+    from electionguard_tpu.obs import set_phase
     if len({t.id for t in trustees}) != len(trustees):
         return Result.Err("duplicate trustee ids")
     if len({t.x_coordinate for t in trustees}) != len(trustees):
         return Result.Err("duplicate x coordinates")
 
     # round 1: collect all public key sets
+    set_phase("keyceremony-round1")
     all_keys: dict[str, PublicKeys] = {}
     for t in trustees:
         keys = t.send_public_keys()
@@ -109,6 +111,7 @@ def _key_ceremony_exchange(
         all_keys[t.id] = keys
 
     # round 2: distribute all key sets to all other trustees
+    set_phase("keyceremony-round2")
     for t in trustees:
         for other_id, keys in all_keys.items():
             if other_id == t.id:
@@ -119,6 +122,7 @@ def _key_ceremony_exchange(
                     f"{t.id} rejected keys of {other_id}: {res.error}")
 
     # round 3: pairwise encrypted share exchange, with challenge fallback
+    set_phase("keyceremony-round3")
     for sender in trustees:
         for receiver in trustees:
             if sender.id == receiver.id:

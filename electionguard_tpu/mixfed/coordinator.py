@@ -36,7 +36,7 @@ from electionguard_tpu.core.group import GroupContext
 from electionguard_tpu.mixnet.proof import rows_digest
 from electionguard_tpu.mixnet.stage import MixStage
 from electionguard_tpu.mixnet.verify_mix import verify_stage
-from electionguard_tpu.obs import REGISTRY, span
+from electionguard_tpu.obs import REGISTRY, set_phase, span
 from electionguard_tpu.publish import pb, serialize
 from electionguard_tpu.publish.publisher import Consumer, Publisher
 from electionguard_tpu.remote import rpc_util
@@ -290,6 +290,7 @@ class MixCoordinator:
                     f"stage {k}: no registered mix server left to run it "
                     f"(all assigned or failed)")
             srv.stage = k
+            set_phase(f"mix-stage-{k}")
             with span("mixfed.forward", {"stage": k, "server": srv.id}):
                 try:
                     stage = self._run_stage_on(srv, k, pads, datas,
@@ -338,6 +339,7 @@ class MixCoordinator:
             input_hash = output_hash
             published += 1
             k += 1
+        set_phase("mix-complete")
         return published
 
     def shutdown(self, all_ok: bool):

@@ -37,7 +37,7 @@ from electionguard_tpu.core.group import GroupContext
 from electionguard_tpu.mixnet.proof import rows_digest
 from electionguard_tpu.mixnet.shuffle import Shuffler
 from electionguard_tpu.mixnet.stage import run_stage
-from electionguard_tpu.obs import REGISTRY, span
+from electionguard_tpu.obs import REGISTRY, set_phase, span
 from electionguard_tpu.publish import pb, serialize
 from electionguard_tpu.remote import rpc_util
 
@@ -140,6 +140,7 @@ class MixServerServer:
                     error=f"server {self.server_id} already holds stage "
                           f"{self.held_stage}; one stage per process")
             self.held_stage = k
+            set_phase(f"hold-stage-{k}")
             self._public_key = serialize._imp_p_int(
                 self.group, request.joint_public_key)
             self._qbar = serialize.import_q(self.group,

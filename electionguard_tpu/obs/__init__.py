@@ -15,6 +15,8 @@ Env vars (all off by default; see README "Observability"):
 * ``EGTPU_OBS_PROC=<name>``   — process name in spans/logs
 * ``EGTPU_OBS_HTTP=<port>``   — Prometheus /metrics endpoint (0=ephemeral)
 * ``EGTPU_OBS_LOG=<dir>``     — JSONL log mirror (defaults to trace dir)
+* ``EGTPU_OBS_COLLECTOR=<host:port>`` — stream spans/logs/metrics/
+  heartbeats to the run's obs collector (obs.collector)
 """
 
 from __future__ import annotations
@@ -32,7 +34,7 @@ def init_from_env() -> dict:
     """Light up every env-selected obs surface (idempotent); called once
     per process from ``cli/common.setup_logging``.  Returns what was
     enabled, for the caller's startup log line."""
-    from electionguard_tpu.obs import httpd, jaxmon, slog, trace
+    from electionguard_tpu.obs import collector, httpd, jaxmon, slog, trace
     info: dict = {}
     if trace.enable_from_env():
         info["trace_dir"] = trace._dir
@@ -44,4 +46,14 @@ def init_from_env() -> dict:
     port = httpd.maybe_start_from_env()
     if port is not None:
         info["metrics_port"] = port
+    client = collector.client_from_env()
+    if client is not None:
+        info["collector"] = client.addr
     return info
+
+
+def set_phase(phase: str) -> None:
+    """Report a progress phase on this process's collector heartbeat
+    (no-op without ``EGTPU_OBS_COLLECTOR``)."""
+    from electionguard_tpu.obs import collector
+    collector.set_phase(phase)

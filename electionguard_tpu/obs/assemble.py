@@ -39,21 +39,52 @@ def load_spans(trace_dir: str) -> list[dict]:
     return spans
 
 
+def is_open(span: dict) -> bool:
+    """An in-flight span: exported as an ``"open": true`` marker by a
+    live process (collector stream) and not yet closed."""
+    return bool(span.get("open")) or "dur" not in span
+
+
+def dedupe(spans: list[dict]) -> list[dict]:
+    """Collapse duplicate span ids, preferring the CLOSED record: a live
+    collector stream sees a span first as a repeated open marker, then
+    once as the closed export."""
+    best: dict[str, dict] = {}
+    for s in spans:
+        cur = best.get(s["span_id"])
+        if cur is None or (is_open(cur) and not is_open(s)):
+            best[s["span_id"]] = s
+    return list(best.values())
+
+
 def validate(spans: list[dict]) -> dict:
     """Structural report over a merged span set.  A clean single-run
-    trace has exactly one trace id, no orphan parents, and every span
-    inside its process's root envelope (``gaps`` empty)."""
+    trace has exactly one trace id, no orphan parents, and every CLOSED
+    span inside its process's root envelope (``gaps`` empty).  In-flight
+    spans — records without a ``dur`` (``"open": true``), streamed by a
+    live collector before their processes finished — are reported under
+    ``open_spans`` instead of tripping the envelope/orphan checks, so a
+    mid-run (or died-run) assembly can still pass ``-strict``."""
+    spans = dedupe(spans)
     ids = {s["span_id"] for s in spans}
     trace_ids = sorted({s["trace_id"] for s in spans})
     procs = sorted({(s["proc"], s["pid"]) for s in spans})
     orphans = [s["span_id"] for s in spans
                if s["parent_id"] and s["parent_id"] not in ids]
+    open_spans = [s["span_id"] for s in spans if is_open(s)]
     roots = {s["pid"]: s for s in spans if s["name"] == "process"}
     gaps = []
     for s in spans:
         root = roots.get(s["pid"])
+        if is_open(s):
+            continue   # no envelope to check yet
         if root is None:
             gaps.append({"span": s["span_id"], "why": "no process root"})
+        elif is_open(root):
+            # live process: envelope end unknown; start must still hold
+            if s["ts"] + _SLACK_US < root["ts"]:
+                gaps.append({"span": s["span_id"], "name": s["name"],
+                             "why": "before open process root"})
         elif s is not root and not (
                 root["ts"] - _SLACK_US <= s["ts"]
                 and s["ts"] + s["dur"]
@@ -72,7 +103,7 @@ def validate(spans: list[dict]) -> dict:
                 unpaired += 1
     return {"n_spans": len(spans), "trace_ids": trace_ids,
             "processes": [f"{p}:{pid}" for p, pid in procs],
-            "orphans": orphans, "gaps": gaps,
+            "orphans": orphans, "gaps": gaps, "open_spans": open_spans,
             "rpc_pairs": rpc_pairs, "rpc_server_unpaired": unpaired}
 
 
@@ -81,7 +112,7 @@ def chrome_trace(spans: list[dict]) -> dict:
     event per span, parent/trace ids preserved under ``args``."""
     events: list[dict] = []
     named: set[int] = set()
-    for s in sorted(spans, key=lambda s: s["ts"]):
+    for s in sorted(dedupe(spans), key=lambda s: s["ts"]):
         if s["pid"] not in named:
             named.add(s["pid"])
             events.append({"ph": "M", "name": "process_name",
@@ -89,18 +120,22 @@ def chrome_trace(spans: list[dict]) -> dict:
                            "args": {"name": f"{s['proc']} ({s['pid']})"}})
         args = {"trace_id": s["trace_id"], "span_id": s["span_id"],
                 "parent_id": s["parent_id"]}
+        if is_open(s):
+            args["open"] = True
         args.update(s.get("attrs") or {})
         events.append({"ph": "X", "name": s["name"], "cat": "egtpu",
-                       "ts": s["ts"], "dur": max(s["dur"], 1),
+                       "ts": s["ts"], "dur": max(s.get("dur", 0), 1),
                        "pid": s["pid"], "tid": s.get("tid", 0),
                        "args": args})
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
-def merge_dir(trace_dir: str, out_path: str) -> dict:
+def merge_dir(trace_dir: str, out_path: str,
+              extra_spans: list[dict] | None = None) -> dict:
     """Load + validate + write the merged Chrome trace; returns the
-    validation report (with ``out`` added)."""
-    spans = load_spans(trace_dir)
+    validation report (with ``out`` added).  ``extra_spans`` lets a live
+    collector merge its in-memory open-span markers into the files."""
+    spans = load_spans(trace_dir) + list(extra_spans or [])
     report = validate(spans)
     with open(out_path, "w") as f:
         json.dump(chrome_trace(spans), f)

@@ -1,0 +1,590 @@
+"""Live telemetry collector + fleet health/SLO engine (server and client).
+
+One ``ObsCollector`` per run receives ``pushTelemetry`` batches from
+every process — span JSONL lines, structured-log lines, a full
+CUMULATIVE metrics ``snapshot()``, and a liveness heartbeat — and turns
+them into:
+
+* one fleet-wide metrics registry: the latest snapshot per (proc, pid),
+  each series relabeled with ``proc=<role>``, merged with
+  ``MetricsRegistry.merge`` and served on a single ``/metrics`` scrape
+  (``obs.httpd`` with the collector's ``fleet_text`` as ``text_fn``) and
+  the ``getMetrics`` rpc;
+* a mid-run strict-valid timeline: received spans land in the
+  collector's own receive dir (same ``spans-<proc>-<pid>.jsonl`` layout
+  ``obs.assemble`` reads) and ``trace_live.json`` is re-assembled every
+  few seconds with the fleet's in-flight spans merged in as ``open``
+  markers — so the timeline exists DURING the run and survives
+  processes that die without flushing;
+* an SLO evaluation loop (``obs.slo``): every tick emits a ``slo.eval``
+  span; every violation that fires emits a first-class ``slo.alert``
+  span carrying the alert attrs (``detection_s`` for liveness), so
+  alerts are part of the same timeline as the work they judge;
+* ``getFleetStatus``: the one rpc ``tools/egtop.py`` polls for the
+  mission-control board.
+
+The client half (``TelemetryClient``) is wired by ``obs.init_from_env``
+when ``EGTPU_OBS_COLLECTOR=<host:port>`` is set.  Its contract with the
+caller's hot path: trace/slog hooks only append to a bounded in-process
+buffer (drop-oldest, counted by ``obs_dropped_total``); a background
+thread drains it over a PLAIN channel (``rpc_util.make_plain_channel``
+— no fault injection, no self-tracing) through ``rpc_util.Stub`` for
+the retry/deadline-class stack.  A clean exit pushes a final EXITING
+goodbye (atexit), which is how the collector tells a shutdown from a
+death: missed heartbeats WITHOUT a goodbye turn the process DEAD.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from electionguard_tpu.obs import assemble, registry, slog, trace
+from electionguard_tpu.obs import slo as slo_mod
+
+log = logging.getLogger("egtpu.obs.collector")
+
+#: client-side bounded buffer (span+log lines awaiting push)
+DEFAULT_BUFFER = 5000
+#: max lines drained into one TelemetryBatch
+BATCH_LINES = 1000
+
+
+def _label_proc(snap: dict, proc: str) -> dict:
+    """Relabel every series in one ``snapshot()`` dict with a
+    ``proc=<role>`` label, so the fleet merge keeps per-role series
+    distinct while still aggregating within a role."""
+    out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    for kind in ("counters", "gauges", "histograms"):
+        for flat, v in snap.get(kind, {}).items():
+            name, labels = slo_mod.parse_labels(flat)
+            labels["proc"] = proc
+            out[kind][registry.flat_name(name, labels)] = v
+    return out
+
+
+def _sum_gauge(snap: dict, base: str) -> float:
+    total = 0.0
+    for flat, v in snap.get("gauges", {}).items():
+        if slo_mod.parse_labels(flat)[0] == base:
+            total += v
+    return total
+
+
+# ---------------------------------------------------------------------------
+# server half
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _ProcState:
+    """Everything the collector knows about one pushing process."""
+
+    proc: str
+    pid: int
+    status: str = "STARTING"
+    state: str = "ALIVE"            # ALIVE | EXITED | DEAD
+    first_seen: float = 0.0
+    last_seen: float = 0.0
+    seq: int = 0
+    lost_batches: int = 0
+    spans: int = 0
+    dropped: int = 0
+    queue_depth: int = 0
+    phase: str = ""
+    phase_since: float = 0.0
+    metrics: dict = field(default_factory=dict)   # latest raw snapshot
+    open_spans: list = field(default_factory=list)
+    span_file: Optional[object] = None
+
+
+class ObsCollector:
+    """The collector service impl plus its background evaluation loop.
+
+    Thread-safety: gRPC handler threads mutate per-process state under
+    ``_lock``; the eval loop reads under the same lock and does its
+    span/file I/O outside it.
+    """
+
+    def __init__(self, out_dir: str, slo_config: Optional[dict] = None,
+                 tick_s: float = 0.5, assemble_every_s: float = 2.0):
+        self.out_dir = out_dir
+        self.recv_dir = os.path.join(out_dir, "recv")
+        os.makedirs(self.recv_dir, exist_ok=True)
+        self.engine = slo_mod.SLOEngine(slo_config)
+        self.tick_s = tick_s
+        self.assemble_every_s = assemble_every_s
+        self._lock = threading.Lock()
+        self._procs: dict[tuple[str, int], _ProcState] = {}
+        self._spans_total = 0
+        self._ingest_drops = 0
+        self._red_until = 0.0       # monotonic deadline of the red window
+        self._red_reason = ""
+        self._health = "green"
+        self._stop = threading.Event()
+        self._eval_thread: Optional[threading.Thread] = None
+        self._own_file = None
+        self.live_path = os.path.join(out_dir, "trace_live.json")
+        self.live_report: dict = {}
+
+    # ---- ingest ------------------------------------------------------
+
+    def push_telemetry(self, batch, context=None):
+        from electionguard_tpu.publish import pb
+        now = time.monotonic()
+        key = (batch.proc, int(batch.pid))
+        hb = batch.heartbeat
+        with self._lock:
+            p = self._procs.get(key)
+            if p is None:
+                p = self._procs[key] = _ProcState(
+                    proc=batch.proc, pid=int(batch.pid), first_seen=now)
+                log.info("fleet: %s:%d joined", batch.proc, batch.pid)
+            if batch.seq and p.seq and batch.seq > p.seq + 1:
+                p.lost_batches += batch.seq - p.seq - 1
+            p.seq = max(p.seq, int(batch.seq))
+            p.last_seen = now
+            if p.state == "DEAD":
+                # a flagged-dead process pushing again was only slow —
+                # resurrect it (the alert span stays in the timeline)
+                log.warning("fleet: %s:%d heartbeats again after being "
+                            "declared dead", p.proc, p.pid)
+            p.state = "ALIVE"
+            if hb.status:
+                p.status = hb.status
+            p.queue_depth = int(hb.queue_depth)
+            p.dropped = int(hb.dropped_total)
+            if hb.phase != p.phase:
+                p.phase = hb.phase
+                p.phase_since = now
+            if batch.metrics_json:
+                try:
+                    p.metrics = json.loads(batch.metrics_json)
+                except ValueError:
+                    self._ingest_drops += 1
+            closed, open_markers = self._split_spans(batch.span_lines)
+            p.open_spans = open_markers
+            p.spans += len(closed)
+            self._spans_total += len(closed)
+        # file I/O outside the lock: per-(proc,pid) files, one writer each
+        if closed:
+            self._append(p, "spans", closed)
+        if batch.log_lines:
+            self._append(p, "log", list(batch.log_lines))
+        return pb.msg("TelemetryAck")(ok=True)
+
+    def _split_spans(self, lines) -> tuple[list[str], list[dict]]:
+        closed: list[str] = []
+        open_markers: list[dict] = []
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                self._ingest_drops += 1
+                continue
+            if assemble.is_open(rec):
+                open_markers.append(rec)
+            else:
+                closed.append(line)
+        return closed, open_markers
+
+    def _append(self, p: _ProcState, kind: str, lines: list[str]) -> None:
+        path = os.path.join(self.recv_dir,
+                            f"{kind}-{p.proc}-{p.pid}.jsonl")
+        try:
+            with open(path, "a") as f:
+                f.write("\n".join(lines) + "\n")
+        except OSError as e:
+            log.warning("receive dir write failed: %s", e)
+
+    def _ingest_own_span(self, line: dict) -> None:
+        """Trace export hook: the collector's OWN spans (slo.eval,
+        slo.alert, rpc.server.*) join the receive dir too, so the live
+        assembly covers the whole fleet including this process."""
+        with self._lock:
+            if self._own_file is None:
+                self._own_file = open(os.path.join(
+                    self.recv_dir,
+                    f"spans-{trace.proc_name()}-{os.getpid()}.jsonl"), "a")
+            self._own_file.write(
+                json.dumps(line, separators=(",", ":")) + "\n")
+            self._own_file.flush()
+
+    # ---- read paths --------------------------------------------------
+
+    def fleet_snapshot(self) -> dict:
+        """The fleet-merged metrics snapshot: latest per process (series
+        relabeled ``proc=<role>``) plus the collector's own registries."""
+        with self._lock:
+            per_proc = [(p.proc, p.metrics) for p in self._procs.values()
+                        if p.metrics]
+        snaps = [_label_proc(m, proc) for proc, m in per_proc]
+        snaps.append(_label_proc(registry.merged_snapshot(),
+                                 trace.proc_name()))
+        return registry.MetricsRegistry.merge(snaps)
+
+    def fleet_text(self) -> str:
+        """Prometheus exposition of the fleet snapshot (the collector's
+        ``/metrics`` — ONE scrape for the whole run)."""
+        return registry.prometheus_text_of(self.fleet_snapshot())
+
+    def get_metrics(self, request=None, context=None):
+        return registry.proto_of(self.fleet_snapshot())
+
+    def get_fleet_status(self, request=None, context=None):
+        from electionguard_tpu.publish import pb
+        now = time.monotonic()
+        resp = pb.msg("FleetStatusResponse")(
+            health=self._health,
+            spans_total=self._spans_total,
+            dropped_total=self._ingest_drops,
+            slo_evals=self.engine.evals)
+        with self._lock:
+            procs = sorted(self._procs.values(),
+                           key=lambda p: (p.proc, p.pid))
+            for p in procs:
+                resp.processes.add(
+                    proc=p.proc, pid=p.pid, state=p.state, status=p.status,
+                    heartbeat_age_s=round(now - p.last_seen, 3),
+                    queue_depth=p.queue_depth, phase=p.phase,
+                    p99_ms=self._proc_p99(p), spans=p.spans,
+                    dropped=p.dropped)
+        for a in self.engine.fired[-16:]:
+            resp.alerts.append(a.summary())
+        return resp
+
+    @staticmethod
+    def _proc_p99(p: _ProcState) -> float:
+        worst = 0.0
+        for flat, h in p.metrics.get("histograms", {}).items():
+            if slo_mod.parse_labels(flat)[0] == "request_latency_ms":
+                worst = max(worst, slo_mod.histogram_quantile(h, 0.99))
+        return worst
+
+    def finish(self, request=None, context=None):
+        from electionguard_tpu.publish import pb
+        self.stop()
+        return pb.msg("BoolResponse")(ok=True)
+
+    # ---- evaluation loop ---------------------------------------------
+
+    def start(self) -> None:
+        trace.add_export_hook(self._ingest_own_span)
+        self._eval_thread = threading.Thread(
+            target=self._eval_loop, daemon=True, name="obs-collector-eval")
+        self._eval_thread.start()
+
+    def stop(self) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        t = self._eval_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+        self._assemble_live()
+        trace.remove_export_hook(self._ingest_own_span)
+
+    def _eval_loop(self) -> None:
+        last_assemble = 0.0
+        while not self._stop.wait(self.tick_s):
+            try:
+                self.evaluate_once()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                log.exception("slo evaluation failed")
+            now = time.monotonic()
+            if now - last_assemble >= self.assemble_every_s:
+                last_assemble = now
+                try:
+                    self._assemble_live()
+                except Exception:  # noqa: BLE001
+                    log.exception("live assembly failed")
+
+    def evaluate_once(self, now: Optional[float] = None) -> list:
+        """One SLO tick (public for tests and the chaos harness):
+        evaluate, emit the ``slo.eval`` span, turn fired alerts into
+        ``slo.alert`` spans and fleet-state transitions."""
+        now = time.monotonic() if now is None else now
+        hb_cfg = self.engine.config["heartbeat"]
+        window = hb_cfg["interval_s"] * hb_cfg["miss_threshold"]
+        with self._lock:
+            rows = []
+            for p in self._procs.values():
+                age = now - p.last_seen
+                if (p.state == "ALIVE" and p.status == "EXITING"
+                        and age > window):
+                    p.state = "EXITED"   # clean goodbye, then silence
+                    log.info("fleet: %s:%d exited cleanly", p.proc, p.pid)
+                rows.append({"proc": p.proc, "pid": p.pid,
+                             "state": p.state, "status": p.status,
+                             "heartbeat_age_s": age,
+                             "queue_depth": p.queue_depth,
+                             "phase": p.phase,
+                             "phase_age_s": now - p.phase_since})
+        metrics = self.fleet_snapshot()
+        with trace.span("slo.eval") as s:
+            fired = self.engine.evaluate(now, metrics, rows)
+            s.set("evals", self.engine.evals)
+            s.set("procs", len(rows))
+            s.set("fired", len(fired))
+        for a in fired:
+            self._on_alert(a, now)
+        color, reasons = self.engine.health(now)
+        if now < self._red_until:
+            color = "red"
+            if self._red_reason and self._red_reason not in reasons:
+                reasons.append(self._red_reason)
+        if color != self._health:
+            log.warning("fleet: health %s -> %s%s", self._health, color,
+                        f" ({'; '.join(reasons)})" if reasons else "")
+            self._health = color
+        return fired
+
+    def _on_alert(self, alert, now: float) -> None:
+        log.warning("slo alert %s", alert.summary())
+        with trace.span("slo.alert",
+                        {"kind": alert.kind, "subject": alert.subject,
+                         "detail": alert.detail, **alert.attrs}):
+            pass
+        if alert.kind == "heartbeat_miss":
+            with self._lock:
+                for p in self._procs.values():
+                    if p.proc == alert.subject and p.state == "ALIVE":
+                        p.state = "DEAD"
+                        log.warning("fleet: %s:%d declared dead "
+                                    "(detection %.2fs)", p.proc, p.pid,
+                                    alert.attrs.get("detection_s", 0.0))
+            self._red_until = max(
+                self._red_until,
+                now + self.engine.config["heartbeat"]["dead_red_for_s"])
+            self._red_reason = alert.summary()
+
+    def _assemble_live(self) -> None:
+        """Re-merge the receive dir plus every process's in-flight span
+        markers into ``trace_live.json`` — a strict-valid mid-run
+        timeline (open spans are reported, not failed, by the
+        assembler)."""
+        with self._lock:
+            extra = [rec for p in self._procs.values()
+                     for rec in p.open_spans]
+        extra += trace.open_span_records()   # the collector's own
+        # persist the in-flight markers as a spans file too, so a PLAIN
+        # file-based assembly of the receive dir (tools/assemble_trace.py
+        # -dir <out>/obs/recv, mid-run or after a died run) resolves
+        # every in-flight parent without this process's memory
+        marker_path = os.path.join(self.recv_dir,
+                                   "spans-open-markers.jsonl")
+        tmp = marker_path + ".tmp"
+        with open(tmp, "w") as f:
+            for rec in extra:
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        os.replace(tmp, marker_path)
+        self.live_report = assemble.merge_dir(
+            self.recv_dir, self.live_path, extra_spans=extra)
+        # persist the validation report beside the timeline so a dead
+        # run's consumers (and the chaos tests) can check strictness
+        # without reconstructing the in-memory open markers
+        report_path = os.path.join(self.out_dir, "trace_live_report.json")
+        with open(report_path, "w") as f:
+            json.dump(self.live_report, f, indent=2, sort_keys=True)
+
+    # ---- wiring ------------------------------------------------------
+
+    def service(self):
+        from electionguard_tpu.remote import rpc_util
+        return rpc_util.generic_service("ObsCollectorService", {
+            "pushTelemetry": self.push_telemetry,
+            "getFleetStatus": self.get_fleet_status,
+            "finish": self.finish,
+            "getMetrics": self.get_metrics,
+        })
+
+
+def serve(port: int = 0, out_dir: str = ".",
+          slo_config: Optional[dict] = None,
+          http_port: Optional[int] = None):
+    """Build + start a collector server; returns
+    (collector, grpc_server, bound_port, http_bound_or_None)."""
+    from electionguard_tpu.obs import httpd
+    from electionguard_tpu.remote import rpc_util
+    collector = ObsCollector(out_dir, slo_config)
+    server, bound = rpc_util.make_server(port)
+    server.add_generic_rpc_handlers((collector.service(),))
+    server.start()
+    collector.start()
+    http_bound = None
+    if http_port is not None:
+        _, http_bound = httpd.start(http_port,
+                                    text_fn=collector.fleet_text)
+    log.info("obs collector on :%d (fleet /metrics on %s)", bound,
+             http_bound)
+    return collector, server, bound, http_bound
+
+
+# ---------------------------------------------------------------------------
+# client half
+# ---------------------------------------------------------------------------
+
+class TelemetryClient:
+    """Streams this process's telemetry to the collector.
+
+    Hot-path contract: the trace/slog hooks only append to a bounded
+    deque under a lock (drop-oldest, counted in ``obs_dropped_total``);
+    everything else happens on the pusher thread.
+    """
+
+    def __init__(self, addr: str, interval_s: float = 1.0,
+                 max_buffer: int = DEFAULT_BUFFER):
+        from electionguard_tpu.remote import rpc_util
+        self.addr = addr
+        self.interval_s = interval_s
+        self.max_buffer = max_buffer
+        self._buf: list[tuple[str, str]] = []   # (kind, jsonl line)
+        self._buf_lock = threading.Lock()
+        self._dropped = registry.REGISTRY.counter("obs_dropped_total")
+        self._stub = rpc_util.Stub(
+            rpc_util.make_plain_channel(addr), "ObsCollectorService")
+        self._seq = 0
+        self._t0 = time.monotonic()
+        self._status = "STARTING"
+        self._phase = ""
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._push_failures = 0
+
+    # ---- hooks (exporting threads: bounded append only) --------------
+
+    def _on_span(self, line: dict) -> None:
+        self._enqueue("span", json.dumps(line, separators=(",", ":")))
+
+    def _on_log(self, line: dict) -> None:
+        self._enqueue("log", json.dumps(line, separators=(",", ":")))
+
+    def _enqueue(self, kind: str, line: str) -> None:
+        with self._buf_lock:
+            if len(self._buf) >= self.max_buffer:
+                del self._buf[0]
+                self._dropped.inc()
+            self._buf.append((kind, line))
+
+    # ---- control -----------------------------------------------------
+
+    def set_phase(self, phase: str) -> None:
+        self._phase = phase
+        self._status = "SERVING"
+
+    def start(self) -> None:
+        trace.add_export_hook(self._on_span)
+        trace.track_open_spans(True)
+        slog.ensure_forwarding()
+        slog.add_hook(self._on_log)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="obs-telemetry-push")
+        self._thread.start()
+        atexit.register(self.close)
+
+    def close(self) -> None:
+        """Final flush with the EXITING goodbye — how a clean shutdown
+        differs from a death the collector must alert on."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self._status = "EXITING"
+        trace.remove_export_hook(self._on_span)
+        slog.remove_hook(self._on_log)
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
+        try:
+            self._push_once(timeout=3.0)
+        except Exception:  # noqa: BLE001 — exit must not fail on telemetry
+            pass
+
+    # ---- pusher thread -----------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._push_once()
+            except Exception:  # noqa: BLE001 — telemetry is best-effort
+                self._push_failures += 1
+
+    def _push_once(self, timeout: Optional[float] = None) -> None:
+        from electionguard_tpu.publish import pb
+        with self._buf_lock:
+            batch_lines = self._buf[:BATCH_LINES]
+            del self._buf[:BATCH_LINES]
+        if self._status == "STARTING" and self._seq > 0:
+            self._status = "SERVING"
+        snap = registry.merged_snapshot()
+        self._seq += 1
+        span_lines = [ln for k, ln in batch_lines if k == "span"]
+        span_lines += [json.dumps(rec, separators=(",", ":"))
+                       for rec in trace.open_span_records()]
+        msg = pb.msg("TelemetryBatch")(
+            proc=trace.proc_name(), pid=os.getpid(),
+            trace_id=trace.trace_id(), seq=self._seq,
+            span_lines=span_lines,
+            log_lines=[ln for k, ln in batch_lines if k == "log"],
+            metrics_json=json.dumps(snap),
+            heartbeat=pb.msg("ObsHeartbeat")(
+                status=self._status,
+                uptime_s=time.monotonic() - self._t0,
+                queue_depth=int(_sum_gauge(snap, "queue_depth")),
+                phase=self._phase,
+                dropped_total=self._dropped.value))
+        try:
+            # short default deadline: a wedged collector must cost the
+            # pusher loop seconds, not the control class's full 30
+            self._stub.call("pushTelemetry", msg,
+                            timeout=5.0 if timeout is None else timeout)
+        except Exception:
+            # push the drained lines back (front), bounded: cumulative
+            # metrics lose nothing, but span/log lines would
+            with self._buf_lock:
+                room = self.max_buffer - len(self._buf)
+                restored = batch_lines[-room:] if room > 0 else []
+                self._dropped.inc(len(batch_lines) - len(restored))
+                self._buf[:0] = restored
+            raise
+
+
+_client: Optional[TelemetryClient] = None
+_client_lock = threading.Lock()
+
+
+def client_from_env() -> Optional[TelemetryClient]:
+    """Start the per-process telemetry client when
+    ``EGTPU_OBS_COLLECTOR=<host:port>`` is set (idempotent)."""
+    global _client
+    addr = os.environ.get("EGTPU_OBS_COLLECTOR", "")
+    if not addr:
+        return None
+    with _client_lock:
+        if _client is None:
+            interval = float(os.environ.get(
+                "EGTPU_OBS_PUSH_INTERVAL", "1.0"))
+            _client = TelemetryClient(addr, interval_s=interval)
+            _client.start()
+        return _client
+
+
+def set_phase(phase: str) -> None:
+    """Report a progress phase on this process's heartbeat (no-op when
+    no collector is configured) — the mission-control board and the
+    stage-lag SLO read it."""
+    c = _client
+    if c is not None:
+        c.set_phase(phase)
+
+
+def _reset_for_tests() -> None:
+    global _client
+    c = _client
+    _client = None
+    if c is not None:
+        c.close()

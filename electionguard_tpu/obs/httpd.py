@@ -26,9 +26,14 @@ log = logging.getLogger("egtpu.obs.httpd")
 
 
 class _Handler(BaseHTTPRequestHandler):
+    #: what /metrics serves; overridable per server instance (the obs
+    #: collector serves the FLEET-merged exposition instead of this
+    #: process's own registries)
+    text_fn = staticmethod(registry.prometheus_text_all)
+
     def do_GET(self):  # noqa: N802 — http.server API
         if self.path.split("?", 1)[0] == "/metrics":
-            body = registry.prometheus_text_all().encode()
+            body = self.text_fn().encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif self.path.split("?", 1)[0] == "/healthz":
             body, ctype = b"ok\n", "text/plain"
@@ -45,11 +50,16 @@ class _Handler(BaseHTTPRequestHandler):
         log.debug("http %s", fmt % args)
 
 
-def start(port: int = 0,
-          addr: str = "127.0.0.1") -> tuple[ThreadingHTTPServer, int]:
+def start(port: int = 0, addr: str = "127.0.0.1",
+          text_fn=None) -> tuple[ThreadingHTTPServer, int]:
     """Serve /metrics on ``addr:port`` (0 = ephemeral) from a daemon
-    thread; returns (server, bound_port)."""
-    server = ThreadingHTTPServer((addr, port), _Handler)
+    thread; returns (server, bound_port).  ``text_fn`` overrides what
+    /metrics serves (default: this process's merged exposition)."""
+    handler = _Handler
+    if text_fn is not None:
+        handler = type("_Handler", (_Handler,),
+                       {"text_fn": staticmethod(text_fn)})
+    server = ThreadingHTTPServer((addr, port), handler)
     t = threading.Thread(target=server.serve_forever, daemon=True,
                          name="obs-metrics-http")
     t.start()

@@ -1,0 +1,327 @@
+"""Declarative fleet SLOs evaluated with fast+slow burn-rate windows.
+
+The collector (obs/collector.py) calls ``SLOEngine.evaluate`` once per
+tick with the fleet-merged metrics snapshot and the per-process
+heartbeat table; the engine returns the alerts that FIRED this tick
+(edge-triggered: an alert fires when its condition first becomes true
+and cannot re-fire until the condition has cleared).  The collector
+turns each fired alert into a first-class ``slo.alert`` span in the run
+timeline and folds active alerts into the fleet health rollup.
+
+Config is a plain dict (JSON-able), deep-merged over ``DEFAULT_SLO``;
+``load_config`` accepts inline JSON, ``@file``, or the ``EGTPU_OBS_SLO``
+env var.  Objectives:
+
+* ``availability`` — rpc success ratio per deadline class
+  (registration/control/exchange/data), alerting on the standard
+  multiwindow multi-burn-rate rule: the error budget must be burning
+  faster than ``fast_burn`` over the fast window AND faster than
+  ``slow_burn`` over the slow window (Google SRE workbook ch. 5) — the
+  fast window gives detection latency, the slow window stops a single
+  blip from paging;
+* ``serving_p99_ms`` — p99 of the serving latency histograms in the
+  merged snapshot;
+* ``queue_depth_max`` — any process heartbeating a deeper admission
+  queue alerts;
+* ``stage_lag_s`` — a SERVING process whose reported phase has not
+  advanced for this long alerts (a wedged mix/verify stage);
+* ``heartbeat`` — liveness: a process that misses ``miss_threshold``
+  consecutive heartbeat intervals without having said goodbye
+  (status EXITING) is declared dead.  This fires in
+  ``interval_s * miss_threshold`` seconds — far inside any rpc deadline
+  class, so the fleet learns about a SIGKILL'd trustee before its next
+  rpc would time out.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+DEFAULT_SLO: dict = {
+    "availability": {
+        # success-ratio objective per rpc deadline class
+        "objective": {"registration": 0.99, "control": 0.99,
+                      "exchange": 0.99, "data": 0.99},
+        "fast_window_s": 30.0,
+        "slow_window_s": 300.0,
+        "fast_burn": 14.0,
+        "slow_burn": 6.0,
+    },
+    "serving_p99_ms": {
+        "objective": 5000.0,
+        # histogram base names checked against the merged snapshot
+        "histograms": ["request_latency_ms"],
+    },
+    "queue_depth_max": 256,
+    "stage_lag_s": 300.0,
+    "heartbeat": {
+        "interval_s": 1.0,
+        "miss_threshold": 3,
+        # a dead process keeps the fleet red for this long after its
+        # alert fires, then becomes recorded history (the alert span
+        # stays in the timeline; a requeued/replaced role turns green)
+        "dead_red_for_s": 10.0,
+    },
+}
+
+
+def _deep_merge(base: dict, over: dict) -> dict:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def load_config(spec: Optional[str] = None) -> dict:
+    """SLO config: ``spec`` (or ``EGTPU_OBS_SLO``) is inline JSON or
+    ``@path`` to a JSON file, deep-merged over ``DEFAULT_SLO``."""
+    spec = spec if spec is not None else os.environ.get("EGTPU_OBS_SLO", "")
+    if not spec:
+        return _deep_merge(DEFAULT_SLO, {})
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            spec = f.read()
+    return _deep_merge(DEFAULT_SLO, json.loads(spec))
+
+
+def parse_labels(flat: str) -> tuple[str, dict]:
+    """Invert ``registry.flat_name``: ``name{k="v",...}`` -> (name, {k: v})."""
+    if "{" not in flat:
+        return flat, {}
+    name, rest = flat.split("{", 1)
+    labels = {}
+    for part in rest.rstrip("}").split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            labels[k] = v.strip('"')
+    return name, labels
+
+
+def histogram_quantile(hist: dict, q: float) -> float:
+    """Upper bucket-bound estimate of the q-quantile of one histogram
+    snapshot dict ({bounds, counts, count})."""
+    n = hist.get("count", 0)
+    if not n:
+        return 0.0
+    target = q * n
+    seen = 0
+    bounds = hist["bounds"]
+    for i, c in enumerate(hist["counts"]):
+        seen += c
+        if seen >= target:
+            return bounds[i] if i < len(bounds) else bounds[-1]
+    return bounds[-1] if bounds else 0.0
+
+
+@dataclass
+class Alert:
+    """One fired SLO violation.  ``key`` dedupes re-fires; ``attrs``
+    lands verbatim on the alert span."""
+
+    kind: str       # heartbeat_miss | availability_burn | serving_p99 |
+    #                 queue_depth | stage_lag
+    subject: str    # process role / deadline class / histogram name
+    detail: str
+    t: float
+    attrs: dict = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"{self.kind}:{self.subject}"
+
+    def summary(self) -> str:
+        return f"[{self.kind}] {self.subject}: {self.detail}"
+
+
+class SLOEngine:
+    """Stateful evaluator: keeps the availability sample history the
+    burn-rate windows need, the edge-trigger state per alert key, and
+    the fired-alert history the fleet rollup reads."""
+
+    def __init__(self, config: Optional[dict] = None,
+                 method_class: Optional[Callable[[str], str]] = None):
+        self.config = config if config is not None else load_config()
+        self.evals = 0
+        self.fired: list[Alert] = []      # full history, in fire order
+        self._active: dict[str, Alert] = {}
+        #: per deadline class: deque[(t, calls, failures)] cumulative
+        self._avail: dict[str, deque] = {}
+        self._method_class = method_class or _default_method_class
+
+    # ---- evaluation --------------------------------------------------
+
+    def evaluate(self, t: float, metrics: dict,
+                 processes: list[dict]) -> list[Alert]:
+        """One tick.  ``metrics`` is the fleet-merged ``snapshot()``
+        dict; ``processes`` rows carry {proc, state, status,
+        heartbeat_age_s, queue_depth, phase_age_s}.  Returns the alerts
+        that FIRED this tick (edge-triggered)."""
+        self.evals += 1
+        fired: list[Alert] = []
+        fired += self._check_heartbeats(t, processes)
+        fired += self._check_availability(t, metrics)
+        fired += self._check_serving_p99(t, metrics)
+        fired += self._check_queues(t, processes)
+        fired += self._check_stage_lag(t, processes)
+        self.fired.extend(fired)
+        return fired
+
+    def _fire(self, cond: bool, alert_fn) -> list[Alert]:
+        """Edge-trigger plumbing: fire when ``cond`` rises, clear (and
+        re-arm) when it falls.  ``alert_fn()`` builds the Alert lazily."""
+        alert = alert_fn()
+        key = alert.key
+        if cond:
+            if key in self._active:
+                return []
+            self._active[key] = alert
+            return [alert]
+        self._active.pop(key, None)
+        return []
+
+    def _check_heartbeats(self, t: float, processes) -> list[Alert]:
+        cfg = self.config["heartbeat"]
+        window = cfg["interval_s"] * cfg["miss_threshold"]
+        out = []
+        for p in processes:
+            dead = (p["state"] == "ALIVE"
+                    and p["status"] != "EXITING"
+                    and p["heartbeat_age_s"] > window)
+            out += self._fire(dead, lambda p=p: Alert(
+                "heartbeat_miss", p["proc"],
+                f"no heartbeat for {p['heartbeat_age_s']:.2f}s "
+                f"(> {window:.2f}s = {cfg['miss_threshold']} x "
+                f"{cfg['interval_s']}s)", t,
+                attrs={"detection_s": round(p["heartbeat_age_s"], 3),
+                       "window_s": window, "pid": p.get("pid", 0)}))
+        return out
+
+    def _check_availability(self, t: float, metrics) -> list[Alert]:
+        cfg = self.config["availability"]
+        # cumulative calls/failures per deadline class from the merged
+        # counters (calls are labeled with class=; failures with method=)
+        calls: dict[str, float] = {}
+        fails: dict[str, float] = {}
+        for flat, v in metrics.get("counters", {}).items():
+            name, labels = parse_labels(flat)
+            if name == "rpc_client_calls_total":
+                cls = labels.get("class", "exchange")
+                calls[cls] = calls.get(cls, 0) + v
+            elif name == "rpc_client_failures_total":
+                cls = self._method_class(labels.get("method", ""))
+                fails[cls] = fails.get(cls, 0) + v
+        out = []
+        for cls, objective in cfg["objective"].items():
+            hist = self._avail.setdefault(cls, deque())
+            hist.append((t, calls.get(cls, 0), fails.get(cls, 0)))
+            while hist and hist[0][0] < t - cfg["slow_window_s"] - 1:
+                hist.popleft()
+            budget = max(1e-9, 1.0 - objective)
+            fast = _window_error_rate(hist, t, cfg["fast_window_s"])
+            slow = _window_error_rate(hist, t, cfg["slow_window_s"])
+            burning = (fast is not None and slow is not None
+                       and fast / budget > cfg["fast_burn"]
+                       and slow / budget > cfg["slow_burn"])
+            out += self._fire(burning, lambda cls=cls, fast=fast,
+                              slow=slow, budget=budget: Alert(
+                "availability_burn", cls,
+                f"error budget burning {0 if fast is None else fast / budget:.1f}x "
+                f"(fast) / {0 if slow is None else slow / budget:.1f}x (slow) "
+                f"against {objective}", t,
+                attrs={"fast_burn": round((fast or 0) / budget, 2),
+                       "slow_burn": round((slow or 0) / budget, 2),
+                       "objective": objective}))
+        return out
+
+    def _check_serving_p99(self, t: float, metrics) -> list[Alert]:
+        cfg = self.config["serving_p99_ms"]
+        out = []
+        for flat, hist in metrics.get("histograms", {}).items():
+            name, _ = parse_labels(flat)
+            if name not in cfg["histograms"]:
+                continue
+            p99 = histogram_quantile(hist, 0.99)
+            out += self._fire(p99 > cfg["objective"],
+                              lambda flat=flat, p99=p99: Alert(
+                "serving_p99", flat,
+                f"p99 {p99:.0f}ms > objective {cfg['objective']:.0f}ms",
+                t, attrs={"p99_ms": p99,
+                          "objective_ms": cfg["objective"]}))
+        return out
+
+    def _check_queues(self, t: float, processes) -> list[Alert]:
+        limit = self.config["queue_depth_max"]
+        out = []
+        for p in processes:
+            deep = p["state"] == "ALIVE" and p.get("queue_depth", 0) > limit
+            out += self._fire(deep, lambda p=p: Alert(
+                "queue_depth", p["proc"],
+                f"queue depth {p.get('queue_depth', 0)} > {limit}", t,
+                attrs={"queue_depth": p.get("queue_depth", 0),
+                       "limit": limit}))
+        return out
+
+    def _check_stage_lag(self, t: float, processes) -> list[Alert]:
+        limit = self.config["stage_lag_s"]
+        out = []
+        for p in processes:
+            lag = p.get("phase_age_s", 0.0)
+            wedged = (p["state"] == "ALIVE" and p.get("phase")
+                      and p["status"] == "SERVING" and lag > limit)
+            out += self._fire(wedged, lambda p=p, lag=lag: Alert(
+                "stage_lag", p["proc"],
+                f"phase {p.get('phase')!r} unchanged for {lag:.0f}s "
+                f"(> {limit:.0f}s)", t,
+                attrs={"phase": p.get("phase"), "lag_s": round(lag, 1)}))
+        return out
+
+    # ---- rollup ------------------------------------------------------
+
+    def health(self, t: float) -> tuple[str, list[str]]:
+        """Fleet color from the alert state: red while any non-liveness
+        alert is active, or within ``dead_red_for_s`` of a liveness
+        alert firing (after that the death is recorded history — the
+        fleet is green again once the work requeued elsewhere)."""
+        red_for = self.config["heartbeat"]["dead_red_for_s"]
+        reasons = []
+        for key, a in self._active.items():
+            if a.kind == "heartbeat_miss":
+                if t - a.t <= red_for:
+                    reasons.append(a.summary())
+            else:
+                reasons.append(a.summary())
+        return ("red" if reasons else "green"), reasons
+
+    def active(self) -> list[Alert]:
+        return list(self._active.values())
+
+
+def _window_error_rate(hist: deque, t: float,
+                       window_s: float) -> Optional[float]:
+    """Failure ratio over the trailing window from cumulative samples;
+    None when the window has no calls (no verdict, never alert)."""
+    start = None
+    for sample in hist:
+        if sample[0] >= t - window_s:
+            start = sample
+            break
+    if start is None:
+        return None
+    end = hist[-1]
+    d_calls = end[1] - start[1]
+    d_fails = end[2] - start[2]
+    if d_calls <= 0:
+        return None
+    return min(1.0, max(0.0, d_fails / d_calls))
+
+
+def _default_method_class(method: str) -> str:
+    from electionguard_tpu.remote import rpc_util
+    return rpc_util._DEADLINE_CLASS_OF.get(method, "exchange")

@@ -25,13 +25,31 @@ from electionguard_tpu.obs import trace
 
 _lock = threading.Lock()
 _handler: Optional["JsonlHandler"] = None
+#: log-line tee: every structured record is also handed to these (the
+#: telemetry client streams them to the obs collector)
+_hooks: list = []
+
+
+def add_hook(fn) -> None:
+    """Tee every structured log record (a dict) to ``fn``; used by the
+    collector client to stream logs live.  Hooks must never raise."""
+    if fn not in _hooks:
+        _hooks.append(fn)
+
+
+def remove_hook(fn) -> None:
+    if fn in _hooks:
+        _hooks.remove(fn)
 
 
 class JsonlHandler(logging.Handler):
-    def __init__(self, path: str):
+    """Mirror log records as JSONL to ``path`` (None = hooks only: the
+    collector-forwarding posture when no local mirror is wanted)."""
+
+    def __init__(self, path: Optional[str]):
         super().__init__()
         self.path = path
-        self._f = open(path, "a")
+        self._f = open(path, "a") if path else None
 
     def emit(self, record: logging.LogRecord) -> None:
         try:
@@ -47,14 +65,19 @@ class JsonlHandler(logging.Handler):
                 line["span_id"] = sid
             if record.exc_info and record.exc_info[0] is not None:
                 line["exc"] = record.exc_info[0].__name__
-            self._f.write(json.dumps(line, separators=(",", ":")) + "\n")
-            self._f.flush()
+            if self._f is not None:
+                self._f.write(json.dumps(line, separators=(",", ":"))
+                              + "\n")
+                self._f.flush()
+            for hook in _hooks:
+                hook(line)
         except Exception:  # noqa: BLE001 — logging must never raise
             self.handleError(record)
 
     def close(self) -> None:
         try:
-            self._f.close()
+            if self._f is not None:
+                self._f.close()
         finally:
             super().close()
 
@@ -69,6 +92,19 @@ def install(dir_path: str) -> JsonlHandler:
         path = os.path.join(
             dir_path, f"log-{trace.proc_name()}-{os.getpid()}.jsonl")
         _handler = JsonlHandler(path)
+    logging.getLogger().addHandler(_handler)
+    return _handler
+
+
+def ensure_forwarding() -> JsonlHandler:
+    """Make sure SOME JsonlHandler is on the root logger so ``add_hook``
+    consumers see log records even when no ``EGTPU_OBS_LOG`` mirror is
+    configured (hooks-only handler, no file)."""
+    global _handler
+    with _lock:
+        if _handler is not None:
+            return _handler
+        _handler = JsonlHandler(None)
     logging.getLogger().addHandler(_handler)
     return _handler
 

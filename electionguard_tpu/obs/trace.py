@@ -53,6 +53,15 @@ _trace_id = ""
 _proc = ""
 _file = None
 _root: Optional["Span"] = None
+#: export tee: every exported span line is also handed to these (the
+#: telemetry client streams them to the obs collector).  Hooks must be
+#: fast and never raise — they run on the exporting thread.
+_hooks: list = []
+#: open-span tracking (off unless a consumer needs in-flight spans —
+#: the collector client turns it on so a LIVE timeline can include the
+#: process root and currently-running phases as "open" records)
+_track_open = False
+_open: dict = {}
 #: (trace_id, span_id) of the innermost active span in this context
 _ctx: contextvars.ContextVar = contextvars.ContextVar(
     "egtpu_trace_ctx", default=None)
@@ -168,14 +177,63 @@ def _reset_for_tests() -> None:
         _dir = None
         _trace_id = ""
         _proc = ""
+    del _hooks[:]
+    track_open_spans(False)
 
 
 def _export(line: dict) -> None:
     with _lock:
-        if _file is None:
-            return
-        _file.write(json.dumps(line, separators=(",", ":")) + "\n")
-        _file.flush()
+        if _file is not None:
+            _file.write(json.dumps(line, separators=(",", ":")) + "\n")
+            _file.flush()
+    for hook in _hooks:
+        try:
+            hook(line)
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            pass
+
+
+def add_export_hook(fn) -> None:
+    """Tee every exported span record (a dict) to ``fn`` as well as the
+    JSONL file; used by the collector client to stream spans live."""
+    if fn not in _hooks:
+        _hooks.append(fn)
+
+
+def remove_export_hook(fn) -> None:
+    if fn in _hooks:
+        _hooks.remove(fn)
+
+
+def track_open_spans(on: bool = True) -> None:
+    """Keep a registry of currently-open spans so ``open_span_records``
+    can describe in-flight work (the process root, a running phase) to a
+    live consumer.  Off by default: the disabled path adds nothing to
+    span enter/exit."""
+    global _track_open
+    _track_open = on
+    if not on:
+        _open.clear()
+
+
+def open_span_records() -> list[dict]:
+    """Snapshot of the currently-open spans as JSONL-shaped records with
+    ``"open": true`` and no ``dur`` — the timeline assembler reports
+    them as ``open_spans`` instead of failing on the missing envelope.
+    Always includes the process root span (even when tracking is off),
+    so a mid-run assembly can resolve every live process's parents."""
+    out = []
+    root = _root
+    if root is not None and getattr(root, "span_id", ""):
+        out.append(root._open_record())
+    if _track_open:
+        for s in list(_open.values()):
+            if s is not root:
+                try:
+                    out.append(s._open_record())
+                except AttributeError:
+                    pass   # span mid-enter on another thread
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -210,7 +268,16 @@ class Span:
         self._tid = threading.get_native_id()
         self._token = _ctx.set((self.trace_id, self.span_id))
         self.t0 = _now_us()
+        if _track_open:
+            _open[self.span_id] = self
         return self
+
+    def _open_record(self) -> dict:
+        """In-flight description of this span (no ``dur`` — still open)."""
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_id": self.parent_id, "name": self.name,
+                "ts": self.t0, "open": True,
+                "pid": os.getpid(), "tid": self._tid, "proc": _proc}
 
     def set(self, key: str, value) -> None:
         if self.attrs is None:
@@ -219,6 +286,8 @@ class Span:
 
     def __exit__(self, et, ev, tb) -> bool:
         _ctx.reset(self._token)
+        if _track_open:
+            _open.pop(self.span_id, None)
         if et is not None:
             self.set("error", et.__name__)
         line = {"trace_id": self.trace_id, "span_id": self.span_id,

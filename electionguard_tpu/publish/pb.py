@@ -110,3 +110,9 @@ MixRowChunk = msg("MixRowChunk")
 MixRowRequest = msg("MixRowRequest")
 MixShuffleRequest = msg("MixShuffleRequest")
 MixStageResult = msg("MixStageResult")
+ObsHeartbeat = msg("ObsHeartbeat")
+TelemetryBatch = msg("TelemetryBatch")
+TelemetryAck = msg("TelemetryAck")
+FleetStatusRequest = msg("FleetStatusRequest")
+FleetProcess = msg("FleetProcess")
+FleetStatusResponse = msg("FleetStatusResponse")

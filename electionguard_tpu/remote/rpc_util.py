@@ -123,6 +123,8 @@ _DEADLINE_CLASS_OF = {
     "pushRows": "data",
     "shuffleStage": "data",
     "pullRows": "data",
+    "pushTelemetry": "control",
+    "getFleetStatus": "control",
 }
 
 
@@ -356,6 +358,19 @@ def make_channel(url: str, max_message: int = MAX_TRUSTEE_MESSAGE,
             ("grpc.max_send_message_length", max_message),
             ("grpc.keepalive_time_ms", keepalive_ms),
         ])))
+
+
+def make_plain_channel(url: str, max_message: int = MAX_TRUSTEE_MESSAGE,
+                       keepalive_ms: int = 60_000) -> grpc.Channel:
+    """Channel WITHOUT the fault/trace interceptors: the obs-plane escape
+    hatch.  Telemetry pushes must observe injected faults, not suffer
+    them, and must not trace themselves (each client span export would
+    trigger another push — unbounded recursion)."""
+    return grpc.insecure_channel(url, options=[
+        ("grpc.max_receive_message_length", max_message),
+        ("grpc.max_send_message_length", max_message),
+        ("grpc.keepalive_time_ms", keepalive_ms),
+    ])
 
 
 def make_server(port: int, max_message: int = MAX_TRUSTEE_MESSAGE,

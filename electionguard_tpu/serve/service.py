@@ -25,6 +25,7 @@ from typing import Optional, Sequence
 
 import grpc
 
+from electionguard_tpu import obs
 from electionguard_tpu.ballot.plaintext import PlaintextBallot
 from electionguard_tpu.core.group import ElementModQ, GroupContext
 from electionguard_tpu.encrypt.encryptor import BatchEncryptor
@@ -129,6 +130,7 @@ class EncryptionService:
                 httpd.start(metrics_http_port)
         self._drained = threading.Event()
         self._status = "SERVING"
+        obs.set_phase("serving")
         log.info("encryption service on port %d (max_batch=%d "
                  "max_wait=%.0fms max_queue=%d buckets=%s recovered=%d)",
                  self.port, max_batch, max_wait_ms, max_queue,
@@ -288,6 +290,7 @@ class EncryptionService:
             return
         self._drained.set()
         self._status = "DRAINING"
+        obs.set_phase("draining")
         log.info("draining: %d requests queued", self.batcher.depth())
         self.batcher.close()
         self.worker.join(timeout=_RESULT_TIMEOUT)

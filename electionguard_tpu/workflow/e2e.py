@@ -31,9 +31,11 @@ from electionguard_tpu.ballot.manifest import (BallotStyle, Candidate,
                                                Party, SelectionDescription)
 from electionguard_tpu.ballot.plaintext import RandomBallotProvider
 from electionguard_tpu.cli.common import setup_logging
+from electionguard_tpu.obs import collector as obs_collector
 from electionguard_tpu.obs import trace as obs_trace
 from electionguard_tpu.publish.publisher import Publisher
-from electionguard_tpu.remote.rpc_util import find_free_port
+from electionguard_tpu.remote.rpc_util import (Stub, find_free_port,
+                                               make_plain_channel)
 from electionguard_tpu.workflow.run_command import RunCommand, wait_all
 
 
@@ -49,6 +51,7 @@ class _PhaseTracer:
 
     def begin(self, name: str) -> None:
         self.end()
+        obs_collector.set_phase(name)   # mission-control heartbeat
         if not obs_trace.enabled():
             return
         self._cur = obs_trace.span(name)
@@ -127,6 +130,13 @@ def main(argv=None) -> int:
                          "spans under <out>/trace (EGTPU_OBS_TRACE), "
                          "and the driver merges them into <out>/"
                          "trace.json (Chrome-trace/Perfetto) at the end")
+    ap.add_argument("-obsCollector", dest="obs_collector",
+                    action="store_true",
+                    help="launch the run's obs collector FIRST and point "
+                         "every process at it (EGTPU_OBS_COLLECTOR): live "
+                         "telemetry under <out>/obs (fleet /metrics, "
+                         "trace_live.json, SLO engine); the driver "
+                         "asserts fleet-green at the end")
     ap.add_argument("-chaosRestartGuardian", dest="chaos_guardian",
                     type=int, default=-1,
                     help="chaos hook: this guardian hard-crashes "
@@ -173,226 +183,301 @@ def main(argv=None) -> int:
         log.error("phase %s FAILED", name)
         return 1
 
-    # ---- phase 0: write the manifest -------------------------------------
-    manifest = sample_manifest(args.ncontests, args.nselections)
-    input_dir = os.path.join(out, "input")
-    os.makedirs(input_dir, exist_ok=True)
-    with open(os.path.join(input_dir, "manifest.json"), "w") as f:
-        f.write(manifest.to_json())
-
-    # ---- phase 1: key ceremony (multi-process) ---------------------------
-    t0 = time.time()
-    phases.begin("phase.key-ceremony")
-    if args.chaos_guardian >= 0:
-        # the COORDINATOR (launched next) needs a retry window wide
-        # enough to bridge the guardian's kill→restart gap
-        os.environ.setdefault("EGTPU_RPC_RETRIES", "8")
-        os.environ.setdefault("EGTPU_RPC_RETRY_BUDGET", "300")
-    kc_port = find_free_port()
-    coord = RunCommand.python_module(
-        "keyceremony-coordinator",
-        "electionguard_tpu.cli.run_remote_keyceremony",
-        ["-in", input_dir, "-out", record_dir,
-         "-nguardians", str(args.nguardians), "-quorum", str(args.quorum),
-         "-port", str(kc_port), "-trusteeDir", trustee_dir,
-         "-timeout", "90"] + group_flags,
-        cmd_out)
-    procs.append(coord)
-    time.sleep(1.5)  # let the coordinator bind
-    chaos_dir = os.path.join(out, "chaos")
-    guardians = []
-    for i in range(args.nguardians):
-        flags = ["-name", f"guardian-{i}", "-serverPort", str(kc_port),
-                 "-out", trustee_dir] + group_flags
-        env = None
-        if args.chaos_guardian >= 0:
-            # resume files make every guardian restartable; only the
-            # chaos target actually crashes
-            os.makedirs(chaos_dir, exist_ok=True)
-            flags += ["-resumeFile",
-                      os.path.join(chaos_dir, f"guardian-{i}.resume")]
-            if i == args.chaos_guardian:
-                # deterministic death at a protocol point, not a timer:
-                # the guardian hard-exits (os._exit) right after it
-                # commits + checkpoints its first received key share,
-                # so the retried rpc must replay against restored state
-                env = {"EGTPU_FAULT_PLAN": json.dumps({"rules": [
-                    {"method": "receiveSecretKeyShare",
-                     "kind": "crash_after", "on_calls": [1]}]})}
-        guardians.append(RunCommand.python_module(
-            f"guardian-{i}", "electionguard_tpu.cli.run_remote_trustee",
-            flags, cmd_out, env=env))
-    procs.extend(guardians)
-    chaos_thread = None
-    if 0 <= args.chaos_guardian < len(guardians):
-        log.info("CHAOS: guardian-%d dies after its first committed key "
-                 "share and restarts from its resume file",
-                 args.chaos_guardian)
-        chaos_thread = guardians[args.chaos_guardian].restart_on_exit(
-            strip_env=("EGTPU_FAULT_PLAN",), downtime_s=1.0)
-    if not wait_all([coord] + guardians, timeout=240):
-        return phase_fail("key-ceremony", [coord] + guardians)
-    if chaos_thread is not None:
-        chaos_thread.join(timeout=10)
-        log.info("[1] key ceremony survived the guardian-%d chaos "
-                 "restart", args.chaos_guardian)
-    log.info("[1] key ceremony took %.1fs", time.time() - t0)
-
-    # ---- phase 2: fake ballots + batch encryption ------------------------
-    t0 = time.time()
-    phases.begin("phase.encrypt")
-    pub = Publisher(out)
-    for b in RandomBallotProvider(manifest, args.nballots, seed=11).ballots():
-        pub.write_plaintext_ballot("plaintext_ballots", b)
-    enc = RunCommand.python_module(
-        "batch-encryption", "electionguard_tpu.cli.run_batch_encryption",
-        ["-in", record_dir, "-ballots", ballots_dir, "-out", record_dir,
-         "-fixedNonces", "-spoilEvery", str(args.spoil_every)] + group_flags,
-        cmd_out)
-    if not wait_all([enc], timeout=600):
-        return phase_fail("encryption", [enc])
-    dt = time.time() - t0
-    log.info("[2] encrypted %d ballots in %.1fs (%.3fs/ballot)",
-             args.nballots, dt, dt / max(args.nballots, 1))
-
-    # ---- phase 3: accumulate --------------------------------------------
-    t0 = time.time()
-    phases.begin("phase.tally")
-    acc = RunCommand.python_module(
-        "accumulate", "electionguard_tpu.cli.run_accumulate_tally",
-        ["-in", record_dir, "-out", record_dir] + group_flags, cmd_out)
-    if not wait_all([acc], timeout=300):
-        return phase_fail("accumulate", [acc])
-    log.info("[3] tally accumulation took %.1fs", time.time() - t0)
-
-    # ---- phase 3.5: mixnet (optional) -------------------------------------
-    if args.mix > 0:
-        t0 = time.time()
-        phases.begin("phase.mix")
-        mix = RunCommand.python_module(
-            "mixnet", "electionguard_tpu.cli.run_mixnet",
-            ["-in", record_dir, "-out", record_dir,
-             "-stages", str(args.mix)] + group_flags, cmd_out)
-        if not wait_all([mix], timeout=600):
-            return phase_fail("mixnet", [mix])
-        log.info("[3.5] %d mix stages took %.1fs", args.mix,
-                 time.time() - t0)
-
-    # ---- phase 3.5 (federated): one mix-server process per stage ---------
-    if args.mix_servers > 0:
-        t0 = time.time()
-        phases.begin("phase.mixfed")
-        mix_port = find_free_port()
-        n_servers = args.mix_servers + (1 if args.chaos_mix else 0)
-        mcoord = RunCommand.python_module(
-            "mix-coordinator", "electionguard_tpu.cli.run_mix_coordinator",
-            ["-in", record_dir, "-out", record_dir,
-             "-stages", str(args.mix_servers),
-             "-servers", str(n_servers), "-port", str(mix_port),
-             "-registrationTimeout", "90",
-             "-checkpointFile", os.path.join(out, "mix_checkpoint.json")]
-            + group_flags, cmd_out)
-        time.sleep(1.5)  # let the registration service bind
-
-        def launch_mix_server(i, env=None):
-            return RunCommand.python_module(
-                f"mix-server-{i}", "electionguard_tpu.cli.run_mix_server",
-                ["-name", f"mix-{i}", "-serverPort", str(mix_port)]
-                + group_flags, cmd_out, env=env)
-
-        mix_servers = []
-        if args.chaos_mix:
-            # deterministic death at a protocol point: the victim
-            # hard-exits right after its first shuffle commits (the
-            # result is lost with the process); the coordinator's
-            # bounded retries must requeue the stage on the spare.
-            # The coordinator assigns stages in REGISTRATION order, so
-            # the victim launches alone and must be registered before
-            # the honest servers start — otherwise it could end up an
-            # unused spare and the drill would silently test nothing.
-            log.info("CHAOS: mix-server-0 dies after its first shuffle "
-                     "commits; its stage must requeue on the spare")
-            victim = launch_mix_server(0, env={
-                "EGTPU_FAULT_PLAN": json.dumps({"rules": [
-                    {"method": "shuffleStage", "kind": "crash_after",
-                     "on_calls": [1]}]})})
-            mix_servers.append(victim)
-            deadline = time.time() + 60
-            while time.time() < deadline:
-                with open(mcoord.stdout_path, "rb") as f:
-                    if b"registered mix server mix-0" in f.read():
-                        break
+    # ---- phase 0.5 (optional): the obs collector, launched FIRST ---------
+    # so its fleet view covers every other process from its first
+    # heartbeat.  The env var is set only AFTER the collector child is
+    # up, so the collector itself never self-pushes.
+    obs_cmd = None
+    obs_stub = None
+    if args.obs_collector:
+        from electionguard_tpu.publish import pb
+        obs_dir = os.path.join(out, "obs")
+        obs_port, obs_http = find_free_port(), find_free_port()
+        obs_cmd = RunCommand.python_module(
+            "obs-collector", "electionguard_tpu.cli.run_obs_collector",
+            ["-port", str(obs_port), "-metricsPort", str(obs_http),
+             "-out", obs_dir], cmd_out)
+        obs_stub = Stub(make_plain_channel(f"localhost:{obs_port}"),
+                        "ObsCollectorService")
+        deadline = time.time() + 30
+        while True:
+            try:
+                obs_stub.call("getFleetStatus",
+                              pb.msg("FleetStatusRequest")(), timeout=2.0)
+                break
+            except Exception:  # noqa: BLE001 — still binding
+                if time.time() > deadline or obs_cmd.poll() is not None:
+                    obs_cmd.kill()
+                    return phase_fail("obs-collector", [obs_cmd])
                 time.sleep(0.25)
-            else:
-                return phase_fail("mixfed", [mcoord, victim])
-        for i in range(len(mix_servers), n_servers):
-            mix_servers.append(launch_mix_server(i))
-        procs.extend([mcoord] + mix_servers)
-        # the chaos victim dies by design (exit 137) — don't gate the
-        # phase on its exit code
-        waited = [mcoord] + (mix_servers[1:] if args.chaos_mix
-                             else mix_servers)
-        if not wait_all(waited, timeout=600):
-            return phase_fail("mixfed", [mcoord] + mix_servers)
-        log.info("[3.5] %d federated mix stages over %d server "
-                 "processes took %.1fs", args.mix_servers, n_servers,
-                 time.time() - t0)
+        os.environ["EGTPU_OBS_COLLECTOR"] = f"localhost:{obs_port}"
+        obs_collector.client_from_env()   # the driver streams too
+        procs.append(obs_cmd)
+        log.info("[0.5] obs collector up: rpc :%d, fleet /metrics on "
+                 "http://localhost:%d/metrics, live timeline %s",
+                 obs_port, obs_http,
+                 os.path.join(obs_dir, "trace_live.json"))
 
-    # ---- phase 4: remote decryption (multi-process) ----------------------
-    t0 = time.time()
-    phases.begin("phase.decrypt")
-    dec_port = find_free_port()
-    decryptor = RunCommand.python_module(
-        "decryptor", "electionguard_tpu.cli.run_remote_decryptor",
-        ["-in", record_dir, "-out", record_dir,
-         "-navailable", str(args.navailable), "-port", str(dec_port),
-         "-timeout", "90"]
-        + (["-decryptSpoiled"] if args.spoil_every else []) + group_flags,
-        cmd_out)
-    time.sleep(1.5)
-    dec_trustees = []
-    trustee_files = sorted(os.listdir(trustee_dir))[:args.navailable]
-    for name in trustee_files:
-        dec_trustees.append(RunCommand.python_module(
-            f"dec-{name}", "electionguard_tpu.cli.run_remote_decrypting_trustee",
-            ["-trusteeFile", os.path.join(trustee_dir, name),
-             "-serverPort", str(dec_port)] + group_flags,
-            cmd_out))
-    if not wait_all([decryptor] + dec_trustees, timeout=300):
-        return phase_fail("decryption", [decryptor] + dec_trustees)
-    log.info("[4] decryption took %.1fs", time.time() - t0)
+    try:
+        # ---- phase 0: write the manifest -------------------------------------
+        manifest = sample_manifest(args.ncontests, args.nselections)
+        input_dir = os.path.join(out, "input")
+        os.makedirs(input_dir, exist_ok=True)
+        with open(os.path.join(input_dir, "manifest.json"), "w") as f:
+            f.write(manifest.to_json())
 
-    # ---- phase 5: verify --------------------------------------------------
-    t0 = time.time()
-    phases.begin("phase.verify")
-    ver = RunCommand.python_module(
-        "verifier", "electionguard_tpu.cli.run_verifier",
-        ["-in", record_dir] + group_flags, cmd_out)
-    code = ver.wait_for(timeout=600)
-    ver.show()
-    if code != 0:
-        return phase_fail("verify", [ver])
-    log.info("[5] verification took %.1fs", time.time() - t0)
+        # ---- phase 1: key ceremony (multi-process) ---------------------------
+        t0 = time.time()
+        phases.begin("phase.key-ceremony")
+        if args.chaos_guardian >= 0:
+            # the COORDINATOR (launched next) needs a retry window wide
+            # enough to bridge the guardian's kill→restart gap
+            os.environ.setdefault("EGTPU_RPC_RETRIES", "8")
+            os.environ.setdefault("EGTPU_RPC_RETRY_BUDGET", "300")
+        kc_port = find_free_port()
+        coord = RunCommand.python_module(
+            "keyceremony-coordinator",
+            "electionguard_tpu.cli.run_remote_keyceremony",
+            ["-in", input_dir, "-out", record_dir,
+             "-nguardians", str(args.nguardians), "-quorum", str(args.quorum),
+             "-port", str(kc_port), "-trusteeDir", trustee_dir,
+             "-timeout", "90"] + group_flags,
+            cmd_out)
+        procs.append(coord)
+        time.sleep(1.5)  # let the coordinator bind
+        chaos_dir = os.path.join(out, "chaos")
+        guardians = []
+        for i in range(args.nguardians):
+            flags = ["-name", f"guardian-{i}", "-serverPort", str(kc_port),
+                     "-out", trustee_dir] + group_flags
+            env = None
+            if args.chaos_guardian >= 0:
+                # resume files make every guardian restartable; only the
+                # chaos target actually crashes
+                os.makedirs(chaos_dir, exist_ok=True)
+                flags += ["-resumeFile",
+                          os.path.join(chaos_dir, f"guardian-{i}.resume")]
+                if i == args.chaos_guardian:
+                    # deterministic death at a protocol point, not a timer:
+                    # the guardian hard-exits (os._exit) right after it
+                    # commits + checkpoints its first received key share,
+                    # so the retried rpc must replay against restored state
+                    env = {"EGTPU_FAULT_PLAN": json.dumps({"rules": [
+                        {"method": "receiveSecretKeyShare",
+                         "kind": "crash_after", "on_calls": [1]}]})}
+            guardians.append(RunCommand.python_module(
+                f"guardian-{i}", "electionguard_tpu.cli.run_remote_trustee",
+                flags, cmd_out, env=env))
+        procs.extend(guardians)
+        chaos_thread = None
+        if 0 <= args.chaos_guardian < len(guardians):
+            log.info("CHAOS: guardian-%d dies after its first committed key "
+                     "share and restarts from its resume file",
+                     args.chaos_guardian)
+            chaos_thread = guardians[args.chaos_guardian].restart_on_exit(
+                strip_env=("EGTPU_FAULT_PLAN",), downtime_s=1.0)
+        if not wait_all([coord] + guardians, timeout=240):
+            return phase_fail("key-ceremony", [coord] + guardians)
+        if chaos_thread is not None:
+            chaos_thread.join(timeout=10)
+            log.info("[1] key ceremony survived the guardian-%d chaos "
+                     "restart", args.chaos_guardian)
+        log.info("[1] key ceremony took %.1fs", time.time() - t0)
 
-    phases.end()
-    if obs_trace.enabled():
-        # close the driver's own span file first so its spans (phases,
-        # root) land in the merge, then assemble everything into one
-        # Perfetto-openable timeline
-        obs_trace.shutdown()
-        from electionguard_tpu.obs import assemble
-        report = assemble.merge_dir(trace_dir,
-                                    os.path.join(out, "trace.json"))
-        log.info("TRACE: %d spans / %d processes / trace_ids=%s "
-                 "rpc_pairs=%d orphans=%d gaps=%d -> %s",
-                 report["n_spans"], len(report["processes"]),
-                 report["trace_ids"], report["rpc_pairs"],
-                 len(report["orphans"]), len(report["gaps"]),
-                 report["out"])
+        # ---- phase 2: fake ballots + batch encryption ------------------------
+        t0 = time.time()
+        phases.begin("phase.encrypt")
+        pub = Publisher(out)
+        for b in RandomBallotProvider(manifest, args.nballots, seed=11).ballots():
+            pub.write_plaintext_ballot("plaintext_ballots", b)
+        enc = RunCommand.python_module(
+            "batch-encryption", "electionguard_tpu.cli.run_batch_encryption",
+            ["-in", record_dir, "-ballots", ballots_dir, "-out", record_dir,
+             "-fixedNonces", "-spoilEvery", str(args.spoil_every)] + group_flags,
+            cmd_out)
+        if not wait_all([enc], timeout=600):
+            return phase_fail("encryption", [enc])
+        dt = time.time() - t0
+        log.info("[2] encrypted %d ballots in %.1fs (%.3fs/ballot)",
+                 args.nballots, dt, dt / max(args.nballots, 1))
 
-    log.info("WORKFLOW PASS: 5 phases, %d ballots, %.1fs total",
-             args.nballots, time.time() - t_all)
-    return 0
+        # ---- phase 3: accumulate --------------------------------------------
+        t0 = time.time()
+        phases.begin("phase.tally")
+        acc = RunCommand.python_module(
+            "accumulate", "electionguard_tpu.cli.run_accumulate_tally",
+            ["-in", record_dir, "-out", record_dir] + group_flags, cmd_out)
+        if not wait_all([acc], timeout=300):
+            return phase_fail("accumulate", [acc])
+        log.info("[3] tally accumulation took %.1fs", time.time() - t0)
+
+        # ---- phase 3.5: mixnet (optional) -------------------------------------
+        if args.mix > 0:
+            t0 = time.time()
+            phases.begin("phase.mix")
+            mix = RunCommand.python_module(
+                "mixnet", "electionguard_tpu.cli.run_mixnet",
+                ["-in", record_dir, "-out", record_dir,
+                 "-stages", str(args.mix)] + group_flags, cmd_out)
+            if not wait_all([mix], timeout=600):
+                return phase_fail("mixnet", [mix])
+            log.info("[3.5] %d mix stages took %.1fs", args.mix,
+                     time.time() - t0)
+
+        # ---- phase 3.5 (federated): one mix-server process per stage ---------
+        if args.mix_servers > 0:
+            t0 = time.time()
+            phases.begin("phase.mixfed")
+            mix_port = find_free_port()
+            n_servers = args.mix_servers + (1 if args.chaos_mix else 0)
+            mcoord = RunCommand.python_module(
+                "mix-coordinator", "electionguard_tpu.cli.run_mix_coordinator",
+                ["-in", record_dir, "-out", record_dir,
+                 "-stages", str(args.mix_servers),
+                 "-servers", str(n_servers), "-port", str(mix_port),
+                 "-registrationTimeout", "90",
+                 "-checkpointFile", os.path.join(out, "mix_checkpoint.json")]
+                + group_flags, cmd_out)
+            time.sleep(1.5)  # let the registration service bind
+
+            def launch_mix_server(i, env=None):
+                return RunCommand.python_module(
+                    f"mix-server-{i}", "electionguard_tpu.cli.run_mix_server",
+                    ["-name", f"mix-{i}", "-serverPort", str(mix_port)]
+                    + group_flags, cmd_out, env=env)
+
+            mix_servers = []
+            if args.chaos_mix:
+                # deterministic death at a protocol point: the victim
+                # hard-exits right after its first shuffle commits (the
+                # result is lost with the process); the coordinator's
+                # bounded retries must requeue the stage on the spare.
+                # The coordinator assigns stages in REGISTRATION order, so
+                # the victim launches alone and must be registered before
+                # the honest servers start — otherwise it could end up an
+                # unused spare and the drill would silently test nothing.
+                log.info("CHAOS: mix-server-0 dies after its first shuffle "
+                         "commits; its stage must requeue on the spare")
+                victim = launch_mix_server(0, env={
+                    "EGTPU_FAULT_PLAN": json.dumps({"rules": [
+                        {"method": "shuffleStage", "kind": "crash_after",
+                         "on_calls": [1]}]})})
+                mix_servers.append(victim)
+                deadline = time.time() + 60
+                while time.time() < deadline:
+                    with open(mcoord.stdout_path, "rb") as f:
+                        if b"registered mix server mix-0" in f.read():
+                            break
+                    time.sleep(0.25)
+                else:
+                    return phase_fail("mixfed", [mcoord, victim])
+            for i in range(len(mix_servers), n_servers):
+                mix_servers.append(launch_mix_server(i))
+            procs.extend([mcoord] + mix_servers)
+            # the chaos victim dies by design (exit 137) — don't gate the
+            # phase on its exit code
+            waited = [mcoord] + (mix_servers[1:] if args.chaos_mix
+                                 else mix_servers)
+            if not wait_all(waited, timeout=600):
+                return phase_fail("mixfed", [mcoord] + mix_servers)
+            log.info("[3.5] %d federated mix stages over %d server "
+                     "processes took %.1fs", args.mix_servers, n_servers,
+                     time.time() - t0)
+
+        # ---- phase 4: remote decryption (multi-process) ----------------------
+        t0 = time.time()
+        phases.begin("phase.decrypt")
+        dec_port = find_free_port()
+        decryptor = RunCommand.python_module(
+            "decryptor", "electionguard_tpu.cli.run_remote_decryptor",
+            ["-in", record_dir, "-out", record_dir,
+             "-navailable", str(args.navailable), "-port", str(dec_port),
+             "-timeout", "90"]
+            + (["-decryptSpoiled"] if args.spoil_every else []) + group_flags,
+            cmd_out)
+        time.sleep(1.5)
+        dec_trustees = []
+        trustee_files = sorted(os.listdir(trustee_dir))[:args.navailable]
+        for name in trustee_files:
+            dec_trustees.append(RunCommand.python_module(
+                f"dec-{name}", "electionguard_tpu.cli.run_remote_decrypting_trustee",
+                ["-trusteeFile", os.path.join(trustee_dir, name),
+                 "-serverPort", str(dec_port)] + group_flags,
+                cmd_out))
+        if not wait_all([decryptor] + dec_trustees, timeout=300):
+            return phase_fail("decryption", [decryptor] + dec_trustees)
+        log.info("[4] decryption took %.1fs", time.time() - t0)
+
+        # ---- phase 5: verify --------------------------------------------------
+        t0 = time.time()
+        phases.begin("phase.verify")
+        ver = RunCommand.python_module(
+            "verifier", "electionguard_tpu.cli.run_verifier",
+            ["-in", record_dir] + group_flags, cmd_out)
+        code = ver.wait_for(timeout=600)
+        ver.show()
+        if code != 0:
+            return phase_fail("verify", [ver])
+        log.info("[5] verification took %.1fs", time.time() - t0)
+
+        phases.end()
+
+        # ---- obs epilogue: the fleet must be green ----------------------------
+        if obs_stub is not None:
+            st = obs_stub.call("getFleetStatus",
+                               pb.msg("FleetStatusRequest")())
+            for p in st.processes:
+                log.info("fleet: %-26s %-6s %-8s hb=%5.1fs phase=%-18s "
+                         "spans=%d", f"{p.proc}:{p.pid}", p.state, p.status,
+                         p.heartbeat_age_s, p.phase or "-", p.spans)
+            log.info("[obs] fleet %s: %d spans ingested, %d slo evals, %d "
+                     "alerts", st.health, st.spans_total, st.slo_evals,
+                     len(st.alerts))
+            if st.health != "green":
+                log.error("fleet health is %s at end of run: %s", st.health,
+                          "; ".join(st.alerts))
+                return phase_fail("obs-fleet", [obs_cmd])
+
+        log.info("WORKFLOW PASS: 5 phases, %d ballots, %.1fs total",
+                 args.nballots, time.time() - t_all)
+        return 0
+    finally:
+        # best-effort teardown on EVERY exit path — including a phase
+        # failure or an exception mid-run: close any open phase span,
+        # say goodbye to (and stop) the collector so it flushes a final
+        # live assembly, and merge whatever span files exist so a died
+        # run still yields a timeline.
+        phases.end()
+        if obs_cmd is not None and obs_cmd.poll() is None:
+            try:
+                client = obs_collector._client
+                if client is not None:
+                    client.close()   # the driver's EXITING goodbye
+                obs_stub.call("finish", pb.msg("FinishRequest")(),
+                              timeout=10.0)
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                log.warning("obs collector finish rpc failed; killing")
+            if obs_cmd.wait_for(15) is None:
+                obs_cmd.kill()
+        if obs_trace.enabled():
+            # close the driver's own span file first so its spans
+            # (phases, root) land in the merge, then assemble everything
+            # into one Perfetto-openable timeline.  In-flight spans of
+            # processes that never exited cleanly are tolerated by the
+            # assembler (reported as open_spans).
+            obs_trace.shutdown()
+            try:
+                from electionguard_tpu.obs import assemble
+                report = assemble.merge_dir(
+                    trace_dir, os.path.join(out, "trace.json"))
+                log.info("TRACE: %d spans / %d processes / trace_ids=%s "
+                         "rpc_pairs=%d orphans=%d gaps=%d open=%d -> %s",
+                         report["n_spans"], len(report["processes"]),
+                         report["trace_ids"], report["rpc_pairs"],
+                         len(report["orphans"]), len(report["gaps"]),
+                         len(report["open_spans"]), report["out"])
+            except (OSError, ValueError):
+                log.exception("trace merge failed")
 
 
 if __name__ == "__main__":

@@ -22,13 +22,15 @@ def _cpu_env():
 
 
 def _run_workflow(tmp_path, group: str, nballots: int, timeout: int,
-                  extra_flags: list = ()):
+                  extra_flags: list = (), env_extra: dict = None):
+    env = _cpu_env()
+    env.update(env_extra or {})
     proc = subprocess.run(
         [sys.executable, "-m", "electionguard_tpu.workflow.e2e",
          "-out", str(tmp_path), "-nballots", str(nballots),
          "-nguardians", "3", "-quorum", "2", "-navailable", "2",
          "-group", group, *extra_flags],
-        capture_output=True, text=True, timeout=timeout, env=_cpu_env(),
+        capture_output=True, text=True, timeout=timeout, env=env,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "WORKFLOW PASS" in proc.stdout + proc.stderr
@@ -140,6 +142,91 @@ def test_five_phase_workflow_federated_mix_chaos_kill(tmp_path):
                            "mix-coordinator.stdout")) as f:
         coord_log = f.read()
     assert "requeueing on a spare" in coord_log
+
+
+def test_five_phase_workflow_chaos_kill_under_obs_collector(tmp_path):
+    """The SIGKILL drill under live observability: mix-server-0 dies via
+    os._exit mid-mix (no goodbye, no flush) while the run's obs
+    collector is watching.  The collector must detect the death from
+    missed heartbeats — far inside the victim's ``data`` rpc deadline
+    class (600s), i.e. long before any in-flight rpc against it would
+    time out — fire the ``heartbeat_miss`` alert as a first-class span
+    in the run timeline, take the fleet red, and return to green once
+    the stage requeues on the spare and the death ages out.  The run
+    itself still lands a fully verified record, so the end-of-run
+    fleet-green gate passes."""
+    import glob
+    import json
+    import re
+
+    proc = _run_workflow(
+        tmp_path, "tiny", nballots=6, timeout=600,
+        extra_flags=["-mixServers", "2", "-chaosKillMixServer",
+                     "-obsCollector", "-trace"],
+        # shrink the post-death red window so the decrypt+verify tail is
+        # guaranteed to outlast it (the green gate is part of the PASS)
+        env_extra={"EGTPU_OBS_SLO":
+                   '{"heartbeat": {"dead_red_for_s": 4.0}}'})
+    out = proc.stdout + proc.stderr
+
+    # the chaos story itself is unchanged: crash, requeue, green record
+    with open(os.path.join(str(tmp_path), "logs",
+                           "mix-server-0.stdout")) as f:
+        assert "injected crash after shuffleStage" in f.read()
+    with open(os.path.join(str(tmp_path), "logs",
+                           "mix-coordinator.stdout")) as f:
+        assert "requeueing on a spare" in f.read()
+    assert "[obs] fleet green" in out
+
+    # the collector saw the whole arc: miss -> alert -> dead -> red ->
+    # (requeue elsewhere) -> green
+    with open(os.path.join(str(tmp_path), "logs",
+                           "obs-collector.stdout")) as f:
+        coll_log = f.read()
+    assert "slo alert [heartbeat_miss] mix-server-0" in coll_log
+    assert "declared dead" in coll_log
+    assert "fleet: health green -> red" in coll_log
+    assert "fleet: health red -> green" in coll_log
+
+    # the alert is a first-class span in the collector's receive dir,
+    # with the detection latency attribute inside the data class
+    alerts = []
+    for path in glob.glob(os.path.join(str(tmp_path), "obs", "recv",
+                                       "spans-*.jsonl")):
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec["name"] == "slo.alert":
+                    alerts.append(rec)
+    miss = [a for a in alerts
+            if a["attrs"]["kind"] == "heartbeat_miss"
+            and a["attrs"]["subject"] == "mix-server-0"]
+    assert miss, f"no heartbeat_miss alert span in {alerts}"
+    assert 0.0 < miss[0]["attrs"]["detection_s"] < 600.0
+
+    # the dead process is still on the final fleet board, state DEAD,
+    # next to the spare that replaced it
+    assert "mix-server-2" in out
+    assert re.search(r"mix-server-0:\d+\s+DEAD", out), out
+
+    # the live timeline the collector assembled survives the death
+    # strict-valid: the victim's in-flight spans are open markers, not
+    # orphans or envelope gaps
+    with open(os.path.join(str(tmp_path), "obs",
+                           "trace_live_report.json")) as f:
+        rep = json.load(f)
+    assert len(rep["trace_ids"]) == 1
+    assert rep["orphans"] == [] and rep["gaps"] == []
+    # at least driver + coordinator + collector + both mix servers
+    assert len(rep["processes"]) >= 5
+    # ...and the standalone tool agrees on the receive dir (-strict)
+    tool = subprocess.run(
+        [sys.executable, "tools/assemble_trace.py", "-dir",
+         os.path.join(str(tmp_path), "obs", "recv"), "-out",
+         os.path.join(str(tmp_path), "obs", "trace_tool.json"), "-strict"],
+        capture_output=True, text=True, timeout=120, env=_cpu_env(),
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert tool.returncode == 0, tool.stdout + tool.stderr
 
 
 def test_five_phase_workflow_traced(tmp_path):

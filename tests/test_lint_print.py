@@ -37,6 +37,7 @@ def _bare_prints(path: str) -> list[int]:
 def test_no_bare_print_in_library_code():
     offenders = []
     scanned_pkgs = set()
+    scanned_files = set()
     for root, dirs, files in os.walk(PKG_DIR):
         rel = os.path.relpath(root, PKG_DIR)
         top = rel.split(os.sep)[0]
@@ -47,12 +48,17 @@ def test_no_bare_print_in_library_code():
             if not name.endswith(".py"):
                 continue
             path = os.path.join(root, name)
+            scanned_files.add(os.path.relpath(path, PKG_DIR))
             for lineno in _bare_prints(path):
                 offenders.append(
                     f"{os.path.relpath(path, PKG_DIR)}:{lineno}")
-    # the walk is recursive by construction; pin the newer packages so a
-    # future layout change can't silently drop them from the lint
+    # the walk is recursive by construction; pin the newer packages AND
+    # the telemetry-plane modules themselves so a future layout change
+    # can't silently drop them from the lint
     assert {"mixnet", "mixfed", "obs", "serve"} <= scanned_pkgs
+    assert {os.path.join("obs", "collector.py"),
+            os.path.join("obs", "slo.py"),
+            os.path.join("obs", "assemble.py")} <= scanned_files
     assert not offenders, (
         "bare print() in library code (use logging — obs.slog mirrors "
         "it as structured JSONL with trace context):\n  "

@@ -290,3 +290,245 @@ def test_service_metrics_summary_surfaces_failed_and_recovered():
     assert "failed=3" in s
     assert "recovered=5" in s
     assert "queue_depth=2" in s
+
+
+# =====================================================================
+# telemetry collector + slo engine
+# =====================================================================
+
+
+def _batch(proc, pid, seq=1, status="SERVING", phase="", metrics=None,
+           span_lines=(), log_lines=()):
+    """One TelemetryBatch as a pushing process would build it."""
+    return pb.msg("TelemetryBatch")(
+        proc=proc, pid=pid, seq=seq,
+        span_lines=list(span_lines), log_lines=list(log_lines),
+        metrics_json=json.dumps(metrics) if metrics else "",
+        heartbeat=pb.msg("ObsHeartbeat")(status=status, phase=phase))
+
+
+def _quiet_slo(**over):
+    """An SLO config with every objective but heartbeat liveness pushed
+    out of reach, so tests of one check never trip on registry state
+    left behind by earlier tests in the same process."""
+    from electionguard_tpu.obs import slo as slo_mod
+    return slo_mod.load_config(json.dumps({
+        "serving_p99_ms": {"objective": 1e12},
+        "queue_depth_max": 10**9,
+        "stage_lag_s": 1e12,
+        "availability": {"fast_burn": 1e12, "slow_burn": 1e12},
+        **over}))
+
+
+def test_fleet_scrape_merges_labeled_histograms_three_processes(tmp_path):
+    """Satellite: three simulated processes push labeled histograms with
+    overlapping AND disjoint label sets; the one fleet scrape sums
+    shared series bucket-exactly and keeps the rest distinct under their
+    ``proc=`` label."""
+    from electionguard_tpu.obs import collector as coll
+
+    def hist(counts, total):
+        return {"name": "request_latency_ms", "bounds": [10.0, 100.0],
+                "counts": counts, "sum": total, "count": sum(counts)}
+
+    c = coll.ObsCollector(str(tmp_path), slo_config=_quiet_slo())
+    # two shards of the SAME role share the {op="enc"} series; shard b
+    # also carries a disjoint {op="dec"} series; a third, different role
+    # reports the same base name unlabeled
+    c.push_telemetry(_batch("simshard", 101, metrics={
+        "histograms": {'request_latency_ms{op="enc"}': hist([1, 2, 3], 50.0)},
+        "counters": {'simreq_total{op="enc"}': 4}}))
+    c.push_telemetry(_batch("simshard", 102, metrics={
+        "histograms": {'request_latency_ms{op="enc"}': hist([4, 0, 1], 7.0),
+                       'request_latency_ms{op="dec"}': hist([0, 1, 0], 20.0)},
+        "counters": {'simreq_total{op="enc"}': 2}}))
+    c.push_telemetry(_batch("simverify", 103, metrics={
+        "histograms": {"request_latency_ms": hist([2, 2, 2], 60.0)}}))
+
+    snap = c.fleet_snapshot()
+    merged = snap["histograms"][
+        'request_latency_ms{op="enc",proc="simshard"}']
+    assert merged["counts"] == [5, 2, 4]          # bucket-exact sums
+    assert merged["count"] == 11 and merged["sum"] == 57.0
+    lone = snap["histograms"][
+        'request_latency_ms{op="dec",proc="simshard"}']
+    assert lone["counts"] == [0, 1, 0] and lone["sum"] == 20.0
+    other = snap["histograms"]['request_latency_ms{proc="simverify"}']
+    assert other["counts"] == [2, 2, 2]           # role stays distinct
+    assert snap["counters"]['simreq_total{op="enc",proc="simshard"}'] == 6
+    # the same series survive into the Prometheus exposition
+    text = c.fleet_text()
+    assert ('egtpu_request_latency_ms_bucket'
+            '{op="enc",proc="simshard",le="10.0"} 5') in text
+
+
+def test_collector_heartbeat_death_red_window_and_recovery(tmp_path,
+                                                           clean_trace):
+    """Liveness end to end against the collector, clock injected: a
+    SERVING process goes silent, the heartbeat_miss alert fires ONCE
+    (edge-triggered) well inside any rpc deadline class, the process is
+    flagged DEAD and the fleet goes red — then green again once the
+    death ages past dead_red_for_s."""
+    import time
+
+    from electionguard_tpu.obs import collector as coll
+    c = coll.ObsCollector(str(tmp_path), slo_config=_quiet_slo())
+    t0 = time.monotonic()
+    c.push_telemetry(_batch("victim", 4242, status="SERVING",
+                            phase="mix-stage-0"))
+    assert c.evaluate_once(now=t0 + 1.0) == []
+    assert c._health == "green"
+
+    # 5s of silence > the 3s (= 3 x 1s) window
+    fired = c.evaluate_once(now=t0 + 5.0)
+    assert [a.kind for a in fired] == ["heartbeat_miss"]
+    alert = fired[0]
+    assert alert.subject == "victim"
+    assert alert.attrs["window_s"] == pytest.approx(3.0)
+    assert alert.attrs["window_s"] < alert.attrs["detection_s"] < 600.0
+    st = c.get_fleet_status()
+    assert st.health == "red"
+    assert [(p.proc, p.state) for p in st.processes] == [("victim", "DEAD")]
+    assert any("heartbeat_miss" in a for a in st.alerts)
+
+    # edge-triggered: continued silence does not re-fire
+    assert c.evaluate_once(now=t0 + 6.0) == []
+    assert c._health == "red"
+    # past dead_red_for_s (10s) the death is recorded history
+    c.evaluate_once(now=t0 + 5.0 + 10.5)
+    assert c._health == "green"
+    assert c.get_fleet_status().health == "green"
+
+
+def test_collector_exiting_goodbye_is_not_a_death(tmp_path, clean_trace):
+    """The atexit goodbye (status EXITING) followed by silence means a
+    clean shutdown: state EXITED, no alert, fleet stays green."""
+    import time
+
+    from electionguard_tpu.obs import collector as coll
+    c = coll.ObsCollector(str(tmp_path), slo_config=_quiet_slo())
+    t0 = time.monotonic()
+    c.push_telemetry(_batch("worker", 77, status="EXITING"))
+    assert c.evaluate_once(now=t0 + 5.0) == []
+    st = c.get_fleet_status()
+    assert st.health == "green"
+    assert [p.state for p in st.processes] == ["EXITED"]
+
+
+def test_telemetry_client_buffer_drop_oldest():
+    """Hot-path contract: a full client buffer drops the OLDEST line and
+    counts it in obs_dropped_total — the exporting thread never blocks,
+    never grows unbounded."""
+    from electionguard_tpu.obs import collector as coll
+    client = coll.TelemetryClient("localhost:1", max_buffer=5)
+    before = reg.REGISTRY.counter("obs_dropped_total").value
+    for i in range(8):
+        client._enqueue("span", f'{{"i":{i}}}')
+    assert len(client._buf) == 5
+    assert [ln for _, ln in client._buf] == [
+        f'{{"i":{i}}}' for i in range(3, 8)]
+    assert reg.REGISTRY.counter("obs_dropped_total").value == before + 3
+
+
+def test_collector_live_assembly_survives_dead_process(tmp_path,
+                                                       clean_trace):
+    """A process pushes an open root marker plus a closed child, then
+    dies without ever closing the root.  The collector's live assembly
+    — and a PLAIN file assembly of its receive dir — must both be
+    strict-valid, with the in-flight root reported as open, not failed
+    as an orphan/gap."""
+    from electionguard_tpu.obs import collector as coll
+    c = coll.ObsCollector(str(tmp_path), slo_config=_quiet_slo())
+    tid = "ef" * 16
+    root = {"trace_id": tid, "span_id": "a" * 16, "parent_id": "",
+            "name": "process", "proc": "victim", "pid": 7, "tid": 0,
+            "ts": 0, "open": True}
+    child = {"trace_id": tid, "span_id": "b" * 16, "parent_id": "a" * 16,
+             "name": "work", "proc": "victim", "pid": 7, "tid": 0,
+             "ts": 10, "dur": 5}
+    c.push_telemetry(_batch("victim", 7, span_lines=[
+        json.dumps(root), json.dumps(child)]))
+    c._assemble_live()
+
+    report = c.live_report
+    assert report["trace_ids"] == [tid]
+    assert report["orphans"] == [] and report["gaps"] == []
+    assert report["open_spans"] == ["a" * 16]
+    assert os.path.exists(c.live_path)
+    # the report is persisted beside the timeline for dead-run consumers
+    with open(os.path.join(str(tmp_path), "trace_live_report.json")) as f:
+        assert json.load(f)["open_spans"] == ["a" * 16]
+    # the open markers are persisted as a spans file, so assembling the
+    # receive dir from files ALONE (mid-run, or after the collector
+    # died too) resolves the in-flight parent
+    spans = assemble.load_spans(c.recv_dir)
+    file_report = assemble.validate(spans)
+    assert file_report["orphans"] == [] and file_report["gaps"] == []
+    assert file_report["open_spans"] == ["a" * 16]
+
+
+def test_egtop_once_renders_fleet_board(tmp_path, clean_trace):
+    """The mission-control tool end to end: egtop -once against a live
+    collector server prints one frame with the fleet line and a row per
+    process, and exits 0 (1 when the collector is unreachable)."""
+    import subprocess
+    import sys as _sys
+
+    from electionguard_tpu.obs import collector as coll
+    collector, server, port, _ = coll.serve(
+        0, str(tmp_path), slo_config=_quiet_slo(), http_port=None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        collector.push_telemetry(_batch("boardproc", 314,
+                                        status="SERVING", phase="tally"))
+        top = subprocess.run(
+            [_sys.executable, os.path.join(repo, "tools", "egtop.py"),
+             "-collector", f"localhost:{port}", "-once", "-noColor"],
+            capture_output=True, text=True, timeout=60, cwd=repo)
+        assert top.returncode == 0, top.stdout + top.stderr
+        assert "fleet GREEN" in top.stdout
+        assert "boardproc" in top.stdout and "tally" in top.stdout
+    finally:
+        collector.stop()
+        server.stop(grace=0)
+    # unreachable collector: frame explains, exit code says so
+    from electionguard_tpu.remote import rpc_util
+    dead_port = rpc_util.find_free_port()
+    top = subprocess.run(
+        [_sys.executable, os.path.join(repo, "tools", "egtop.py"),
+         "-collector", f"localhost:{dead_port}", "-once"],
+        capture_output=True, text=True, timeout=60, cwd=repo)
+    assert top.returncode == 1
+    assert "unreachable" in top.stdout
+
+
+def test_slo_availability_burn_fast_and_slow_windows():
+    """The availability objective only pages when BOTH burn windows
+    exceed their thresholds: a brief blip inside the fast window alone
+    must not fire; a sustained burn across both must — once."""
+    from electionguard_tpu.obs import slo as slo_mod
+    cfg = slo_mod.load_config(json.dumps({
+        "availability": {"fast_window_s": 10.0, "slow_window_s": 60.0,
+                         "fast_burn": 2.0, "slow_burn": 2.0},
+        "serving_p99_ms": {"objective": 1e12},
+        "queue_depth_max": 10**9, "stage_lag_s": 1e12}))
+    eng = slo_mod.SLOEngine(cfg, method_class=lambda m: "data")
+
+    def snap(calls, fails):
+        return {"counters": {
+            'rpc_client_calls_total{class="data",method="m"}': calls,
+            'rpc_client_failures_total{code="X",method="m"}': fails}}
+
+    # t=0..5: healthy traffic fills the slow window with successes
+    for t in (0.0, 5.0):
+        assert eng.evaluate(t, snap(calls=100 * (t + 1), fails=0), []) == []
+    # t=8: a burst of failures — fast window burns, slow window still
+    # diluted below threshold -> no page
+    assert eng.evaluate(8.0, snap(calls=620, fails=2), []) == []
+    # t=20..30: failures sustained -> both windows above burn -> ONE fire
+    fired = eng.evaluate(20.0, snap(calls=700, fails=60), [])
+    fired += eng.evaluate(30.0, snap(calls=750, fails=90), [])
+    burns = [a for a in fired if a.kind == "availability_burn"]
+    assert len(burns) == 1 and burns[0].subject == "data"
+    assert burns[0].attrs["fast_burn"] > 2.0
+    assert burns[0].attrs["slow_burn"] > 2.0

@@ -8,6 +8,14 @@ Chrome-trace JSON that Perfetto (https://ui.perfetto.dev) or
 (span/process counts, trace ids, orphan parents, envelope gaps, rpc
 client/server pairing).
 
+In-flight spans — records with ``"open": true`` and no ``dur``, streamed
+by the obs collector for work still running (the live process roots,
+an unfinished phase) — are tolerated: they are reported under
+``open_spans`` instead of failing ``-strict``, so the tool also works
+mid-run (and on died runs) against a collector's receive dir::
+
+    python tools/assemble_trace.py -dir /tmp/eg/obs/recv -strict
+
 Usage::
 
     python tools/assemble_trace.py -dir /tmp/eg/trace [-out trace.json]
@@ -33,7 +41,8 @@ def main(argv=None) -> int:
                          "(default <dir>/trace.json)")
     ap.add_argument("-strict", action="store_true",
                     help="exit 1 unless the trace is clean: one trace "
-                         "id, no orphans, no envelope gaps")
+                         "id, no orphans, no envelope gaps (in-flight "
+                         "open spans are reported, not failed)")
     args = ap.parse_args(argv)
 
     from electionguard_tpu.obs import assemble

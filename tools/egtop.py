@@ -1,0 +1,102 @@
+"""Live election-fleet mission control — a ``top`` for an e2e run.
+
+Polls the obs collector's ``getFleetStatus`` rpc (obs/collector.py) and
+redraws a terminal status board: fleet health, one row per process
+(state, liveness, heartbeat age, queue depth, current phase, serving
+p99, spans streamed, client-side drops), and the recent SLO alerts.
+
+Usage::
+
+    python tools/egtop.py -collector localhost:17171
+    python tools/egtop.py -collector localhost:17171 -once   # one frame
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_STATE_GLYPH = {"ALIVE": "✓", "EXITED": "-", "DEAD": "✗"}
+_COLORS = {"green": "\x1b[32m", "red": "\x1b[31m"}
+_RESET = "\x1b[0m"
+
+
+def _paint(text: str, color: str, enabled: bool) -> str:
+    if not enabled or color not in _COLORS:
+        return text
+    return f"{_COLORS[color]}{text}{_RESET}"
+
+
+def render(status, color: bool = True) -> str:
+    """One frame of the board from a FleetStatusResponse."""
+    lines = []
+    alive = sum(1 for p in status.processes if p.state == "ALIVE")
+    dead = sum(1 for p in status.processes if p.state == "DEAD")
+    lines.append(
+        f"fleet {_paint(status.health.upper(), status.health, color)}  "
+        f"procs {alive} alive / {dead} dead / "
+        f"{len(status.processes)} total   spans {status.spans_total}   "
+        f"slo evals {status.slo_evals}")
+    lines.append(f"{'':1} {'PROC':<26}{'PID':>7} {'STATE':<7}{'STATUS':<9}"
+                 f"{'HB_AGE':>7} {'QUEUE':>6} {'P99MS':>7} {'SPANS':>7} "
+                 f"{'DROP':>5}  PHASE")
+    for p in status.processes:
+        glyph = _STATE_GLYPH.get(p.state, "?")
+        row_color = {"DEAD": "red", "ALIVE": "green"}.get(p.state, "")
+        lines.append(_paint(
+            f"{glyph} {p.proc:<26}{p.pid:>7} {p.state:<7}{p.status:<9}"
+            f"{p.heartbeat_age_s:>6.1f}s {p.queue_depth:>6} "
+            f"{p.p99_ms:>7.1f} {p.spans:>7} {p.dropped:>5}  "
+            f"{p.phase or '-'}", row_color, color))
+    if status.alerts:
+        lines.append("recent alerts:")
+        for a in list(status.alerts)[-8:]:
+            lines.append(_paint(f"  ! {a}", "red", color))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("egtop")
+    ap.add_argument("-collector", required=True,
+                    help="obs collector address (host:port)")
+    ap.add_argument("-interval", type=float, default=1.0,
+                    help="refresh interval in seconds")
+    ap.add_argument("-once", action="store_true",
+                    help="print one frame and exit (no screen control)")
+    ap.add_argument("-noColor", dest="no_color", action="store_true")
+    args = ap.parse_args(argv)
+
+    from electionguard_tpu.publish import pb
+    from electionguard_tpu.remote.rpc_util import Stub, make_plain_channel
+
+    stub = Stub(make_plain_channel(args.collector), "ObsCollectorService")
+    color = not args.no_color and (args.once or sys.stdout.isatty())
+    req = pb.msg("FleetStatusRequest")()
+    while True:
+        try:
+            status = stub.call("getFleetStatus", req, timeout=5.0)
+        except Exception as e:  # noqa: BLE001 — show the outage, keep going
+            frame = f"egtop: collector {args.collector} unreachable: {e}"
+            status = None
+        else:
+            frame = render(status, color=color)
+        if args.once:
+            print(frame)
+            return 0 if status is not None else 1
+        # full-screen redraw: clear + home, like watch(1)
+        sys.stdout.write("\x1b[2J\x1b[H")
+        sys.stdout.write(time.strftime("%H:%M:%S") + "  egtop  "
+                         + args.collector + "\n" + frame + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except KeyboardInterrupt:
+        sys.exit(0)
