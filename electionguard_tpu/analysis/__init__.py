@@ -1,0 +1,19 @@
+"""eglint: project-native static analysis.
+
+The repo's trust boundaries (secrets stay in-process, all rpc traffic
+flows through ``rpc_util``, device code never host-syncs, shared state
+stays behind its lock, every ``EGTPU_*`` knob is documented) were
+established PR by PR as *conventions*.  This package machine-checks
+them: an AST pass registry (``core``), six project-specific passes, and
+a ``tools/eglint.py`` CLI.  Run it with::
+
+    python tools/eglint.py -strict
+
+See README "Static analysis" for the pass catalog and the suppression
+story (inline ``# eglint: disable=RULE`` / ``analysis/baseline.json``).
+"""
+
+from electionguard_tpu.analysis.core import (Finding,  # noqa: F401
+                                             Project, Report,
+                                             load_baseline, run_passes,
+                                             write_baseline)

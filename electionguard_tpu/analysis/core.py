@@ -1,0 +1,279 @@
+"""Analysis framework: findings, pass registry, parse cache, suppressions.
+
+A *pass* is a function ``(Project) -> Iterable[Finding]`` registered
+under its primary rule id.  ``run_passes`` walks the package once,
+caches each file's AST, runs every requested pass, then applies the two
+suppression layers:
+
+* inline ``# eglint: disable=RULE[,RULE2]`` on the offending line
+  silences exactly that line (counted per rule, so tests can assert a
+  disable suppressed exactly one finding);
+* ``analysis/baseline.json`` entries — ``{rule, path, line, note}`` —
+  park known findings; every entry MUST carry a non-empty ``note``
+  explaining why it is baselined rather than fixed.
+
+Both layers are visible in the report (and the ``ANALYSIS.json``
+artifact), never silently dropped.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Iterable, Optional
+
+PACKAGE_ROOT = Path(__file__).resolve().parents[1]
+REPO_ROOT = PACKAGE_ROOT.parent
+DEFAULT_BASELINE = Path(__file__).resolve().with_name("baseline.json")
+
+#: rules whose baseline must stay EMPTY: a finding here is a secret leak
+#: or an untraced/unfaultable channel — fixed, never parked.
+NO_BASELINE_RULES = ("secret-taint", "raw-channel")
+
+_DISABLE_RE = re.compile(r"#\s*eglint:\s*disable=([A-Za-z0-9_\-, ]+)")
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation at one source line."""
+
+    rule: str
+    path: str      # posix path relative to the project root
+    line: int
+    message: str
+
+    @property
+    def key(self) -> tuple[str, str, int]:
+        return (self.rule, self.path, self.line)
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class SourceFile:
+    """One scanned file: text + lazily parsed AST + inline disables."""
+
+    def __init__(self, abspath: Path, rel: str):
+        self.abspath = abspath
+        self.rel = rel
+        self.text = abspath.read_text()
+        self._tree: Optional[ast.Module] = None
+        self._disables: Optional[dict[int, set[str]]] = None
+
+    @property
+    def tree(self) -> ast.Module:
+        if self._tree is None:
+            self._tree = ast.parse(self.text, filename=str(self.abspath))
+        return self._tree
+
+    @property
+    def disables(self) -> dict[int, set[str]]:
+        """line number -> rule ids disabled on that line."""
+        if self._disables is None:
+            self._disables = {}
+            for i, line in enumerate(self.text.splitlines(), start=1):
+                m = _DISABLE_RE.search(line)
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",")}
+                    self._disables[i] = {r for r in rules if r}
+        return self._disables
+
+
+class Project:
+    """A scanned source tree: every ``*.py`` under ``package_dir``.
+
+    ``root`` (default: the package's parent) anchors the relative paths
+    findings report; passes locate contract files (the .proto, the knob
+    registry) by suffix inside the same tree, so a temp-dir fixture
+    project with the same relative layout exercises every pass without
+    the real package walk ever seeing it.
+    """
+
+    def __init__(self, package_dir: Optional[Path] = None,
+                 root: Optional[Path] = None):
+        self.package_dir = Path(package_dir or PACKAGE_ROOT).resolve()
+        self.root = Path(root).resolve() if root else self.package_dir.parent
+        self._files: Optional[list[SourceFile]] = None
+
+    def files(self) -> list[SourceFile]:
+        if self._files is None:
+            self._files = []
+            for p in sorted(self.package_dir.rglob("*.py")):
+                if "__pycache__" in p.parts:
+                    continue
+                rel = p.relative_to(self.root).as_posix()
+                self._files.append(SourceFile(p, rel))
+        return self._files
+
+    def file(self, rel_suffix: str) -> Optional[SourceFile]:
+        """The scanned file whose path ends with ``rel_suffix``, if any."""
+        for f in self.files():
+            if f.rel.endswith(rel_suffix):
+                return f
+        return None
+
+    def package_rel_parts(self, f: SourceFile) -> tuple[str, ...]:
+        """Path parts relative to the package dir (for dir exemptions)."""
+        return f.abspath.relative_to(self.package_dir).parts
+
+
+# ---------------------------------------------------------------------------
+# pass registry
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class PassInfo:
+    name: str                      # primary rule id == pass name
+    rules: tuple[str, ...]         # every rule id the pass may emit
+    doc: str
+    fn: Callable[[Project], Iterable[Finding]] = field(compare=False)
+
+
+PASSES: dict[str, PassInfo] = {}
+
+
+def register(name: str, rules: Optional[tuple[str, ...]] = None,
+             doc: str = ""):
+    """Decorator registering an analysis pass under ``name``."""
+    def deco(fn):
+        PASSES[name] = PassInfo(name, tuple(rules or (name,)),
+                                doc or (fn.__doc__ or "").strip(), fn)
+        return fn
+    return deco
+
+
+def load_default_passes() -> None:
+    """Import every built-in pass module (idempotent: registry keyed)."""
+    from electionguard_tpu.analysis import (env_knobs,  # noqa: F401
+                                            jit_hygiene, lock_discipline,
+                                            no_bare_print, rpc_contract,
+                                            secret_taint)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: Optional[Path] = None) -> list[dict]:
+    """Baseline entries ``{rule, path, line, note}``; every entry must
+    carry a non-empty ``note`` (the tracking rationale)."""
+    p = Path(path) if path else DEFAULT_BASELINE
+    if not p.exists():
+        return []
+    entries = json.loads(p.read_text())
+    for e in entries:
+        for k in ("rule", "path", "line"):
+            if k not in e:
+                raise ValueError(f"baseline entry missing {k!r}: {e}")
+        if not str(e.get("note", "")).strip():
+            raise ValueError(
+                f"baseline entry for {e['rule']} at {e['path']}:{e['line']} "
+                f"has no note: every baselined finding needs a rationale")
+        if e["rule"] in NO_BASELINE_RULES:
+            raise ValueError(
+                f"rule {e['rule']!r} may not be baselined (fix it): {e}")
+    return entries
+
+
+def write_baseline(path: Path, findings: Iterable[Finding],
+                   note: str) -> None:
+    """Baseline ``findings`` with one shared rationale ``note``."""
+    entries = [{"rule": f.rule, "path": f.path, "line": f.line,
+                "note": note} for f in sorted(findings)]
+    Path(path).write_text(json.dumps(entries, indent=2) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Report:
+    findings: list[Finding]            # live: unsuppressed, unbaselined
+    baselined: list[Finding]
+    suppressed: dict[str, int]         # rule -> inline-disable count
+    stale_baseline: list[dict]         # entries matching nothing anymore
+    files_scanned: list[str]
+    passes_run: list[str]
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> dict:
+        by_pass: dict[str, dict] = {}
+        load_default_passes()
+        rule_to_pass = {r: info.name for info in PASSES.values()
+                        for r in info.rules}
+        for name in self.passes_run:
+            by_pass[name] = {"findings": 0, "baselined": 0, "suppressed": 0}
+        for f in self.findings:
+            by_pass.setdefault(rule_to_pass.get(f.rule, f.rule),
+                               {"findings": 0, "baselined": 0,
+                                "suppressed": 0})["findings"] += 1
+        for f in self.baselined:
+            by_pass.setdefault(rule_to_pass.get(f.rule, f.rule),
+                               {"findings": 0, "baselined": 0,
+                                "suppressed": 0})["baselined"] += 1
+        for rule, n in self.suppressed.items():
+            by_pass.setdefault(rule_to_pass.get(rule, rule),
+                               {"findings": 0, "baselined": 0,
+                                "suppressed": 0})["suppressed"] += n
+        return {
+            "version": 1,
+            "files_scanned": len(self.files_scanned),
+            "passes": {k: by_pass[k] for k in sorted(by_pass)},
+            "suppressed_total": sum(self.suppressed.values()),
+            "stale_baseline": self.stale_baseline,
+            "findings": [{"rule": f.rule, "path": f.path, "line": f.line,
+                          "message": f.message}
+                         for f in sorted(self.findings)],
+            "baselined": [{"rule": f.rule, "path": f.path, "line": f.line}
+                          for f in sorted(self.baselined)],
+        }
+
+
+def run_passes(project: Optional[Project] = None,
+               passes: Optional[Iterable[str]] = None,
+               baseline: Optional[list[dict]] = None) -> Report:
+    """Run ``passes`` (default: all registered) over ``project``."""
+    load_default_passes()
+    project = project or Project()
+    names = list(passes) if passes else sorted(PASSES)
+    unknown = [n for n in names if n not in PASSES]
+    if unknown:
+        raise KeyError(f"unknown passes: {unknown}; "
+                       f"have {sorted(PASSES)}")
+    raw: list[Finding] = []
+    for name in names:
+        raw.extend(PASSES[name].fn(project))
+
+    by_rel = {f.rel: f for f in project.files()}
+    live: list[Finding] = []
+    suppressed: dict[str, int] = {}
+    for f in sorted(set(raw)):
+        src = by_rel.get(f.path)
+        if src is not None and f.rule in src.disables.get(f.line, set()):
+            suppressed[f.rule] = suppressed.get(f.rule, 0) + 1
+            continue
+        live.append(f)
+
+    baseline = baseline if baseline is not None else load_baseline()
+    bkeys = {(e["rule"], e["path"], int(e["line"])) for e in baseline}
+    hit: set[tuple] = set()
+    findings, baselined = [], []
+    for f in live:
+        if f.key in bkeys:
+            baselined.append(f)
+            hit.add(f.key)
+        else:
+            findings.append(f)
+    stale = [e for e in baseline
+             if (e["rule"], e["path"], int(e["line"])) not in hit]
+    return Report(findings=findings, baselined=baselined,
+                  suppressed=suppressed, stale_baseline=stale,
+                  files_scanned=[f.rel for f in project.files()],
+                  passes_run=names)

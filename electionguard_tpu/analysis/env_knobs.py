@@ -1,0 +1,166 @@
+"""env-knob-registry: every ``EGTPU_*`` read is declared and documented.
+
+Three checks, all against ``utils/knobs.py``:
+
+* a read of an undeclared ``EGTPU_*`` name (``os.environ.get``/``[]``/
+  ``in``, ``os.getenv``, the typed ``knobs.get_*`` getters, or the
+  ``_env_float``/``_env_int`` helpers) is a finding;
+* a read site whose inline literal default disagrees with the declared
+  default is a finding (the registry can't drift from the code);
+* the committed ``ENV_KNOBS.md`` table must equal ``render_table()`` of
+  the declarations (docs can't drift from the registry).
+
+Dynamic names are supported for declared prefixes: an f-string knob
+name whose literal head is ``EGTPU_RPC_TIMEOUT_`` is covered because
+declared knobs with that prefix exist.  Writes (``os.environ[...] =``,
+``setdefault``, ``pop``) never count as reads; ``setdefault`` is
+declaration-checked but not default-checked (workflow posture overrides
+intentionally differ from the process default).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from electionguard_tpu.analysis import astutil, core
+from electionguard_tpu.utils import knobs as knobs_mod
+
+RULE = "env-knob-registry"
+
+#: helper callables whose literal first argument is an env-knob read
+_GETTERS_CHECKED = {"_env_float", "_env_int"}          # default-checked
+_GETTERS_DECLARED = {"get_str", "get_int", "get_float", "get_flag"}
+
+KNOBS_SUFFIX = "utils/knobs.py"
+TABLE_NAME = "ENV_KNOBS.md"
+
+
+def _is_environ(node: ast.expr) -> bool:
+    """``os.environ`` (or a bare ``environ`` import)."""
+    return ((isinstance(node, ast.Attribute) and node.attr == "environ")
+            or (isinstance(node, ast.Name) and node.id == "environ"))
+
+
+def _literal_default(node: ast.Call) -> Optional[str]:
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+        return str(node.args[1].value)
+    return None
+
+
+def _declarations(project: core.Project) -> list[knobs_mod.Knob]:
+    src = project.file(KNOBS_SUFFIX)
+    if src is None:
+        return []
+    decls = []
+    for node in ast.walk(src.tree):
+        if (isinstance(node, ast.Call)
+                and astutil.call_name(node) == "Knob"
+                and len(node.args) >= 4):
+            name = astutil.str_const(node.args[0])
+            ktype = astutil.str_const(node.args[1])
+            default = (node.args[2].value
+                       if isinstance(node.args[2], ast.Constant) else None)
+            doc = astutil.str_const(node.args[3])
+            if name and ktype and doc is not None:
+                decls.append(knobs_mod.Knob(name, ktype, default, doc))
+    return decls
+
+
+def _reads(tree: ast.AST) -> Iterator[tuple[str, int, Optional[str], bool]]:
+    """Yield (name, line, literal_default_or_None, default_checked) for
+    every EGTPU_* read; prefix reads yield the literal f-string head."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            # os.environ.get(NAME[, default]) / os.getenv / setdefault
+            if isinstance(fn, ast.Attribute) and _is_environ(fn.value):
+                if fn.attr not in ("get", "setdefault"):
+                    continue   # pop etc: a write
+                name_node = node.args[0] if node.args else None
+                checked = fn.attr == "get"
+            elif (isinstance(fn, ast.Attribute) and fn.attr == "getenv"
+                  and isinstance(fn.value, ast.Name)
+                  and fn.value.id == "os"):
+                name_node, checked = (node.args[0] if node.args else None,
+                                      True)
+            else:
+                cname = astutil.call_name(node)
+                if cname in _GETTERS_CHECKED or cname in _GETTERS_DECLARED:
+                    name_node = node.args[0] if node.args else None
+                    checked = cname in _GETTERS_CHECKED
+                else:
+                    continue
+            if name_node is None:
+                continue
+            lit = astutil.str_const(name_node)
+            if lit is not None and lit.startswith("EGTPU_"):
+                yield (lit, node.lineno, _literal_default(node), checked)
+            elif isinstance(name_node, ast.JoinedStr) and name_node.values:
+                head = name_node.values[0]
+                if isinstance(head, ast.Constant) and str(
+                        head.value).startswith("EGTPU_"):
+                    yield (str(head.value) + "*", node.lineno, None, False)
+        elif (isinstance(node, ast.Subscript)
+              and _is_environ(node.value)
+              and isinstance(node.ctx, ast.Load)):
+            lit = astutil.str_const(node.slice)
+            if lit is not None and lit.startswith("EGTPU_"):
+                yield (lit, node.lineno, None, False)
+        elif isinstance(node, ast.Compare):
+            if (len(node.ops) == 1
+                    and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                    and _is_environ(node.comparators[0])):
+                lit = astutil.str_const(node.left)
+                if lit is not None and lit.startswith("EGTPU_"):
+                    yield (lit, node.lineno, None, False)
+
+
+@core.register(RULE, doc="undeclared/undocumented EGTPU_* env reads and "
+                         "registry/docs drift")
+def run(project: core.Project) -> Iterator[core.Finding]:
+    decls = _declarations(project)
+    by_name = {k.name: k for k in decls}
+    names = sorted(by_name)
+
+    for f in project.files():
+        for name, line, site_default, checked in _reads(f.tree):
+            if name.endswith("*"):    # declared-prefix dynamic read
+                prefix = name[:-1]
+                if not any(n.startswith(prefix) for n in names):
+                    yield core.Finding(
+                        RULE, f.rel, line,
+                        f"dynamic env knob {name} matches no declared "
+                        f"knob prefix in utils/knobs.py")
+                continue
+            k = by_name.get(name)
+            if k is None:
+                yield core.Finding(
+                    RULE, f.rel, line,
+                    f"{name} is read here but not declared in "
+                    f"utils/knobs.py (type/default/doc)")
+                continue
+            if (checked and k.default is not None
+                    and site_default is not None
+                    and site_default != str(k.default)):
+                yield core.Finding(
+                    RULE, f.rel, line,
+                    f"{name} read with default {site_default!r} but "
+                    f"utils/knobs.py declares {k.default!r}")
+
+    # docs drift: ENV_KNOBS.md must equal the rendered registry
+    if decls:
+        table = project.root / TABLE_NAME
+        rendered = knobs_mod.render_table(decls)
+        knobs_src = project.file(KNOBS_SUFFIX)
+        rel = knobs_src.rel if knobs_src else KNOBS_SUFFIX
+        if not table.exists():
+            yield core.Finding(
+                RULE, rel, 1,
+                f"{TABLE_NAME} missing: run `python tools/eglint.py "
+                f"--write-knobs`")
+        elif table.read_text() != rendered:
+            yield core.Finding(
+                RULE, TABLE_NAME, 1,
+                f"{TABLE_NAME} is out of sync with utils/knobs.py: "
+                f"run `python tools/eglint.py --write-knobs`")

@@ -1,0 +1,182 @@
+"""jit-hygiene: jitted code must stay compile-once and device-resident.
+
+The serving plane's ``device_compiles``-flat guarantee (power-of-two
+bucket padding, construction-time ``jax.jit``) dies from four habits:
+
+* **host sync inside jit** — ``.item()``, ``.tolist()``,
+  ``.block_until_ready()``, or ``int()``/``float()``/``complex()`` on a
+  traced value: a blocking device->host transfer per call (or a tracer
+  error at runtime);
+* **per-call jit construction** — ``jax.jit(fn)(x)`` builds and throws
+  away the compiled callable every call;
+* **unhashable static/container args** — calling a jitted callable with
+  a list/dict/set literal retraces per call (or fails to hash);
+* **dynamic shapes** — ``jnp.arange(n)``/``zeros(n)`` where ``n`` is a
+  function parameter (a tracer under jit) keys a fresh compile per
+  value or errors outright.
+
+Jitted functions are found by decorator (``@jax.jit``, ``@jit``,
+``@partial(jax.jit, ...)``) and by call-site registration: any name
+passed (however deeply: ``jax.jit(shard_map(self._f_impl, ...))``) into
+a ``jax.jit(...)`` call is looked up among the module's function defs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from electionguard_tpu.analysis import astutil, core
+
+RULE = "jit-hygiene"
+
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_HOST_CASTS = {"int", "float", "complex"}
+_SHAPE_BUILDERS = {"arange", "zeros", "ones", "empty", "full"}
+#: static accessors whose result is a python int even under jit
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+
+def _is_jit(node: ast.expr) -> bool:
+    """``jit`` / ``jax.jit`` (as a name or the function of a call)."""
+    return ((isinstance(node, ast.Name) and node.id == "jit")
+            or (isinstance(node, ast.Attribute) and node.attr == "jit"))
+
+
+def _jit_decorated(fn: ast.FunctionDef) -> bool:
+    for d in fn.decorator_list:
+        if _is_jit(d):
+            return True
+        if isinstance(d, ast.Call):
+            if _is_jit(d.func):
+                return True
+            if astutil.call_name(d) == "partial" and any(
+                    _is_jit(a) for a in d.args):
+                return True
+    return False
+
+
+def _leaf_names(node: ast.expr) -> Iterator[str]:
+    """Every Name id / Attribute attr inside an expression — the
+    candidate function references handed to ``jax.jit``."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            yield n.id
+        elif isinstance(n, ast.Attribute):
+            yield n.attr
+
+
+def _is_static_value(node: ast.expr) -> bool:
+    """Values that are python ints under jit: literals, ``len(...)``,
+    ``x.shape[...]`` / ``x.ndim`` chains."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Call) and astutil.call_name(node) == "len":
+        return True
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return True
+    return False
+
+
+def _check_jitted_body(fn: ast.FunctionDef, rel: str
+                       ) -> Iterator[core.Finding]:
+    params = set(astutil.param_names(fn))
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _HOST_SYNC_METHODS:
+            yield core.Finding(
+                RULE, rel, node.lineno,
+                f".{f.attr}() inside jitted code forces a device->host "
+                f"sync (or fails on a tracer)")
+        elif (isinstance(f, ast.Name) and f.id in _HOST_CASTS
+              and len(node.args) == 1
+              and not _is_static_value(node.args[0])):
+            yield core.Finding(
+                RULE, rel, node.lineno,
+                f"{f.id}() on a traced value inside jitted code is a "
+                f"host sync; keep it an array (or hoist to a static "
+                f"arg)")
+        elif (isinstance(f, ast.Attribute) and f.attr in _SHAPE_BUILDERS
+              and node.args
+              and isinstance(node.args[0], ast.Name)
+              and node.args[0].id in params):
+            yield core.Finding(
+                RULE, rel, node.lineno,
+                f"jnp.{f.attr}({node.args[0].id}) sizes an array from a "
+                f"traced parameter: dynamic shapes defeat the "
+                f"compile-once guarantee")
+
+
+@core.register(RULE, doc="host syncs, per-call jit construction, "
+                         "container args, and dynamic shapes in jitted "
+                         "code")
+def run(project: core.Project) -> Iterator[core.Finding]:
+    for src in project.files():
+        fns = list(astutil.walk_functions(src.tree))
+        by_name: dict[str, list[ast.FunctionDef]] = {}
+        for fn in fns:
+            by_name.setdefault(fn.name, []).append(fn)
+
+        jitted: set[str] = set()          # function names
+        jitted_callables: set[str] = set()  # names bound to jax.jit(...)
+        # one-level indirection: mapped = shard_map(kernel, ...);
+        # jax.jit(mapped) must still mark `kernel` as jitted
+        indirect: dict[str, set[str]] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                leaves = set(_leaf_names(node.value))
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        indirect[t.id] = leaves
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call) and _is_jit(node.func):
+                for arg in node.args:
+                    leaves = set(_leaf_names(arg))
+                    for n in list(leaves):
+                        leaves |= indirect.get(n, set())
+                    jitted.update(n for n in leaves if n in by_name)
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call) and _is_jit(node.value.func):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jitted_callables.add(t.id)
+                    else:
+                        a = astutil.self_attr(t)
+                        if a:
+                            jitted_callables.add(a)
+
+        # per-call construction: jax.jit(fn)(x) builds + discards the
+        # compiled callable every call
+        for node in ast.walk(src.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Call)
+                    and _is_jit(node.func.func)):
+                yield core.Finding(
+                    RULE, src.rel, node.lineno,
+                    "jax.jit(fn)(...) constructs and discards the "
+                    "compiled callable per call; bind it once at "
+                    "construction time")
+            # container literal handed to a known-jitted callable:
+            # retraces per call (unhashable if static)
+            elif isinstance(node, ast.Call):
+                name = astutil.call_name(node)
+                if name in jitted_callables and any(
+                        isinstance(a, (ast.List, ast.Dict, ast.Set))
+                        for a in node.args):
+                    yield core.Finding(
+                        RULE, src.rel, node.lineno,
+                        f"list/dict/set literal passed to jitted "
+                        f"callable {name!r}: container args retrace "
+                        f"per call (and can't hash as statics)")
+
+        seen: set[int] = set()
+        for fn in fns:
+            if fn.name in jitted or _jit_decorated(fn):
+                if id(fn) in seen:
+                    continue
+                seen.add(id(fn))
+                yield from _check_jitted_body(fn, src.rel)

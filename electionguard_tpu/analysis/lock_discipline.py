@@ -1,0 +1,129 @@
+"""lock-discipline: guarded attributes stay behind their lock.
+
+Per class: find the lock-ish attributes (``with self._lock: ...`` where
+the attr name matches lock/mutex/cv/cond), compute the *guarded set* —
+``self.X`` attributes WRITTEN under such a ``with`` (assignment,
+augmented assignment, item store, del, or a mutating method call like
+``self._q.append``), then flag every lexically lock-free touch (read or
+write) of a guarded attribute in any other method.  ``__init__`` is
+exempt: construction happens before the object is shared.
+
+This is exactly the race class the serving/obs planes are exposed to:
+request threads, the device-owner worker, the SLO eval loop, and
+drain/shutdown paths all share ``self`` state (serve/batcher,
+serve/service, obs/collector).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from electionguard_tpu.analysis import astutil, core
+
+RULE = "lock-discipline"
+
+_LOCK_NAME = re.compile(r"lock|mutex|cv|cond", re.IGNORECASE)
+
+#: method calls that mutate their receiver (``self.X.append(...)``
+#: counts as a write to ``X``)
+_MUTATORS = {"append", "appendleft", "extend", "insert", "add", "update",
+             "remove", "discard", "pop", "popleft", "popitem", "clear",
+             "setdefault", "append_drop"}
+
+_EXEMPT_METHODS = {"__init__", "__new__", "__post_init__"}
+
+
+def _lock_attrs_of(cls: ast.ClassDef) -> set[str]:
+    attrs = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                a = astutil.self_attr(item.context_expr)
+                if a and _LOCK_NAME.search(a):
+                    attrs.add(a)
+    return attrs
+
+
+def _touches(method: ast.FunctionDef, lock_attrs: set[str]
+             ) -> Iterator[tuple[str, int, bool, bool]]:
+    """Yield (attr, line, is_write, under_lock) for every ``self.X``
+    touch in ``method`` (lexical: a with-lock in the same method)."""
+
+    def visit(node: ast.AST, under: bool) -> Iterator:
+        if isinstance(node, ast.With):
+            locked = under or any(
+                (astutil.self_attr(i.context_expr) or "") in lock_attrs
+                for i in node.items)
+            for item in node.items:
+                yield from visit(item.context_expr, under)
+            for child in node.body:
+                yield from visit(child, locked)
+            return
+        if isinstance(node, ast.Attribute):
+            a = astutil.self_attr(node)
+            if a and a not in lock_attrs:
+                yield (a, node.lineno,
+                       isinstance(node.ctx, (ast.Store, ast.Del)), under)
+            yield from visit(node.value, under)
+            return
+        if isinstance(node, ast.Call):
+            # self.X.mutator(...) writes X; self.X[k] = v handled via ctx
+            fn = node.func
+            if (isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS):
+                a = astutil.self_attr(fn.value)
+                if a and a not in lock_attrs:
+                    yield (a, node.lineno, True, under)
+        elif isinstance(node, ast.Subscript):
+            a = astutil.self_attr(node.value)
+            if a and a not in lock_attrs and isinstance(
+                    node.ctx, (ast.Store, ast.Del)):
+                yield (a, node.lineno, True, under)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            return   # nested defs run later, under their own discipline
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, under)
+
+    for stmt in method.body:
+        yield from visit(stmt, False)
+
+
+@core.register(RULE, doc="attributes written under a lock in one method "
+                         "but touched lock-free in another")
+def run(project: core.Project) -> Iterator[core.Finding]:
+    for f in project.files():
+        for cls in [n for n in ast.walk(f.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            lock_attrs = _lock_attrs_of(cls)
+            if not lock_attrs:
+                continue
+            methods = [n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            per_method = {m.name: list(_touches(m, lock_attrs))
+                          for m in methods}
+            guarded: set[str] = set()
+            for touches in per_method.values():
+                for attr, _line, is_write, under in touches:
+                    if is_write and under:
+                        guarded.add(attr)
+            for m in methods:
+                if m.name in _EXEMPT_METHODS:
+                    continue
+                # dedupe per (attr, line): self._q.append(x) is both a
+                # read of _q and a mutation of it — one finding
+                merged: dict[tuple[str, int], tuple[bool, bool]] = {}
+                for attr, line, is_write, under in per_method[m.name]:
+                    w, u = merged.get((attr, line), (False, False))
+                    merged[(attr, line)] = (w or is_write, u or under)
+                for (attr, line), (is_write, under) in sorted(
+                        merged.items()):
+                    if attr in guarded and not under:
+                        kind = "written" if is_write else "read"
+                        yield core.Finding(
+                            RULE, f.rel, line,
+                            f"{cls.name}.{attr} is written under a lock "
+                            f"elsewhere but {kind} lock-free in "
+                            f"{m.name}()")

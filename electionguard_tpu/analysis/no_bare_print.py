@@ -1,0 +1,43 @@
+"""no-bare-print: library telemetry goes through ``logging``.
+
+A bare ``print()`` is invisible to the observability plane (``obs.slog``
+mirrors logging, not stdout) and unattributable to a trace.  CLI entry
+points (``electionguard_tpu/cli/``) are exempt — their stdout IS their
+user interface — and ``print(..., file=...)`` writing to an explicitly
+chosen stream is display plumbing, not telemetry.
+
+Migrated from the seed lint ``tests/test_lint_print.py`` (which is now a
+thin wrapper over this pass, pinning the walked packages).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from electionguard_tpu.analysis import core
+
+#: subpackages whose stdout is their interface (pinned by
+#: tests/test_lint_print.py so coverage can't silently shrink)
+EXEMPT_DIRS = ("cli",)
+
+RULE = "no-bare-print"
+
+
+@core.register(RULE, doc="bare print() in library code (use logging; "
+                         "obs.slog mirrors it with trace context)")
+def run(project: core.Project) -> Iterator[core.Finding]:
+    for f in project.files():
+        parts = project.package_rel_parts(f)
+        if parts and parts[0] in EXEMPT_DIRS:
+            continue
+        for node in ast.walk(f.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"
+                    and not any(kw.arg == "file" for kw in node.keywords)):
+                yield core.Finding(
+                    RULE, f.rel, node.lineno,
+                    "bare print() in library code: use logging so "
+                    "obs.slog mirrors it as structured JSONL with "
+                    "trace context")

@@ -1,0 +1,223 @@
+"""rpc-contract / raw-channel: the .proto is the contract, rpc_util the
+only transport.
+
+Contract checks (``rpc-contract``), driven by the runtime-descriptor
+toolchain itself — the proto files are compiled with the same
+``protoc_mini`` that builds the production descriptors:
+
+* every rpc method in ``remote_rpc.proto`` has a deadline class in
+  ``rpc_util._DEADLINE_CLASS_OF`` (otherwise ``Stub.call`` silently
+  falls back to the exchange default);
+* every rpc method has a server impl in some
+  ``rpc_util.generic_service("Svc", {...})`` registration
+  (``getMetrics`` has a registry-backed default);
+* chunked rpcs — those whose request message carries ``chunk_start`` —
+  have impls that actually read ``chunk_start`` (the idempotency
+  contract: a retried chunk must overwrite, not append).
+
+Channel discipline (``raw-channel``): ``grpc.insecure_channel`` /
+``grpc.server`` may only be created inside ``remote/rpc_util.py``
+(``make_channel`` / ``make_plain_channel`` / ``make_server``).  Those
+hooks are what make tracing and fault injection universal — a raw
+channel is invisible to both, so the baseline for this rule must stay
+EMPTY.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional
+
+from electionguard_tpu.analysis import astutil, core
+
+RULE_CONTRACT = "rpc-contract"
+RULE_CHANNEL = "raw-channel"
+
+PROTO_SUFFIX = "publish/proto/remote_rpc.proto"
+RPC_UTIL_SUFFIX = "remote/rpc_util.py"
+
+#: methods generic_service supplies a default impl for
+_DEFAULT_IMPLS = {"getMetrics"}
+
+
+def _compile_protos(project: core.Project):
+    """FileDescriptorSet of every .proto beside the contract file, via
+    protoc_mini (pure python); None when the project has no contract."""
+    main = None
+    for p in sorted(project.package_dir.rglob("*.proto")):
+        if p.as_posix().endswith(PROTO_SUFFIX):
+            main = p
+    if main is None:
+        return None, None
+    try:
+        from electionguard_tpu.publish import protoc_mini
+    except Exception:       # descriptor runtime unavailable: skip
+        return None, None
+    texts = [(p.name, p.read_text())
+             for p in sorted(main.parent.glob("*.proto"))]
+    try:
+        return protoc_mini.compile_files(texts), main
+    except Exception:
+        return None, None
+
+
+def _deadline_classes(src: core.SourceFile
+                      ) -> tuple[dict[str, int], Optional[int]]:
+    """method -> lineno of its entry in _DEADLINE_CLASS_OF, + dict line."""
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "_DEADLINE_CLASS_OF"
+                for t in node.targets):
+            if isinstance(node.value, ast.Dict):
+                entries = {}
+                for k in node.value.keys:
+                    name = astutil.str_const(k) if k is not None else None
+                    if name:
+                        entries[name] = k.lineno
+                return entries, node.lineno
+    return {}, None
+
+
+def _service_registrations(project: core.Project
+                           ) -> dict[str, list[tuple[core.SourceFile, int,
+                                                     set[str]]]]:
+    """service name -> [(file, line, literal impl-dict keys)]."""
+    regs: dict[str, list] = {}
+    for f in project.files():
+        # module-level NAME = "literal" constants (serve/service.py
+        # registers via a _SERVICE constant, not an inline literal)
+        consts: dict[str, str] = {}
+        for stmt in f.tree.body:
+            if isinstance(stmt, ast.Assign):
+                lit = astutil.str_const(stmt.value)
+                if lit is not None:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            consts[t.id] = lit
+        for node in ast.walk(f.tree):
+            if not (isinstance(node, ast.Call)
+                    and astutil.call_name(node) == "generic_service"
+                    and len(node.args) >= 2):
+                continue
+            svc = astutil.str_const(node.args[0])
+            if svc is None and isinstance(node.args[0], ast.Name):
+                svc = consts.get(node.args[0].id)
+            if svc is None:
+                continue
+            impls: set[str] = set()
+            if isinstance(node.args[1], ast.Dict):
+                impls = {astutil.str_const(k) for k in node.args[1].keys
+                         if k is not None and astutil.str_const(k)}
+            regs.setdefault(svc, []).append((f, node.lineno, impls))
+    return regs
+
+
+def _impl_reads_chunk_start(project: core.Project, reg_file: core.SourceFile,
+                            reg_line: int, method: str) -> Optional[bool]:
+    """Does the registered impl for ``method`` reference .chunk_start?
+    None when the impl expression isn't statically resolvable."""
+    impl_name = None
+    for node in ast.walk(reg_file.tree):
+        if (isinstance(node, ast.Call)
+                and astutil.call_name(node) == "generic_service"
+                and node.lineno == reg_line
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Dict)):
+            for k, v in zip(node.args[1].keys, node.args[1].values):
+                if k is not None and astutil.str_const(k) == method:
+                    if isinstance(v, ast.Name):
+                        impl_name = v.id
+                    else:
+                        impl_name = astutil.self_attr(v) or (
+                            v.attr if isinstance(v, ast.Attribute)
+                            else None)
+    if impl_name is None:
+        return None
+    for fn in astutil.walk_functions(reg_file.tree):
+        if fn.name == impl_name:
+            return any(isinstance(n, ast.Attribute)
+                       and n.attr == "chunk_start"
+                       for n in ast.walk(fn))
+    return None
+
+
+def _proto_line(text: str, method: str) -> int:
+    m = re.search(rf"^\s*rpc\s+{re.escape(method)}\b", text, re.MULTILINE)
+    return text[:m.start()].count("\n") + 1 if m else 1
+
+
+@core.register(RULE_CONTRACT, rules=(RULE_CONTRACT, RULE_CHANNEL),
+               doc="proto/deadline/impl/idempotency contract + the "
+                   "rpc_util-only channel discipline")
+def run(project: core.Project) -> Iterator[core.Finding]:
+    # ---- raw-channel: grpc.insecure_channel / grpc.server outside
+    # rpc_util's factory functions
+    for f in project.files():
+        if f.rel.endswith(RPC_UTIL_SUFFIX):
+            continue
+        for node in ast.walk(f.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("insecure_channel", "server",
+                                           "secure_channel")
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "grpc"):
+                yield core.Finding(
+                    RULE_CHANNEL, f.rel, node.lineno,
+                    f"raw grpc.{node.func.attr}() bypasses rpc_util."
+                    f"make_channel/make_server — invisible to tracing "
+                    f"and fault injection")
+
+    # ---- contract checks need the proto + rpc_util in the project
+    fds, proto_path = _compile_protos(project)
+    rpc_util = project.file(RPC_UTIL_SUFFIX)
+    if fds is None or rpc_util is None:
+        return
+    proto_rel = proto_path.relative_to(project.root).as_posix()
+    proto_text = proto_path.read_text()
+    classes, dict_line = _deadline_classes(rpc_util)
+    regs = _service_registrations(project)
+
+    msg_fields: dict[str, set[str]] = {}
+    for fl in fds.file:
+        for m in fl.message_type:
+            msg_fields[m.name] = {fld.name for fld in m.field}
+
+    for fl in fds.file:
+        for svc in fl.service:
+            svc_regs = regs.get(svc.name, [])
+            if not svc_regs:
+                yield core.Finding(
+                    RULE_CONTRACT, proto_rel, 1,
+                    f"service {svc.name} has no rpc_util."
+                    f"generic_service registration in the package")
+            for m in svc.method:
+                line = _proto_line(proto_text, m.name)
+                if m.name not in classes:
+                    yield core.Finding(
+                        RULE_CONTRACT, proto_rel, line,
+                        f"rpc {svc.name}.{m.name} has no deadline class "
+                        f"in rpc_util._DEADLINE_CLASS_OF (Stub.call "
+                        f"would silently use the exchange default)")
+                impl_regs = [(f, ln) for f, ln, impls in svc_regs
+                             if m.name in impls]
+                if svc_regs and not impl_regs \
+                        and m.name not in _DEFAULT_IMPLS:
+                    yield core.Finding(
+                        RULE_CONTRACT, proto_rel, line,
+                        f"rpc {svc.name}.{m.name} has no server impl in "
+                        f"any generic_service({svc.name!r}, ...) "
+                        f"registration")
+                req = m.input_type.rsplit(".", 1)[-1]
+                if "chunk_start" in msg_fields.get(req, set()):
+                    for f, ln in impl_regs:
+                        ok = _impl_reads_chunk_start(project, f, ln,
+                                                     m.name)
+                        if ok is False:
+                            yield core.Finding(
+                                RULE_CONTRACT, f.rel, ln,
+                                f"chunked rpc {svc.name}.{m.name}: impl "
+                                f"never reads chunk_start — a retried "
+                                f"chunk would append instead of "
+                                f"overwrite (idempotency contract)")

@@ -1,0 +1,269 @@
+"""secret-taint: secret material must never reach a telemetry sink.
+
+Intraprocedural dataflow.  Sources are registered three ways:
+
+* **call-site**: any call of ``rand_q`` / ``prf_scalars`` /
+  ``prf_permutation`` / ``compute_polynomial`` is secret everywhere in
+  the package (fresh randomness, polynomial secrets, mix permutations);
+* **attribute**, path-scoped: ``self._coefficients`` in
+  ``keyceremony/trustee.py``, ``self._pinned_seed`` in
+  ``mixfed/server.py``;
+* **parameter name**, path-scoped: ``nonce``/``secret`` in
+  ``crypto/elgamal.py``, ``seed``/``perm`` in ``mixnet/shuffle.py``, ...
+
+Taint propagates through assignments, arithmetic, f-strings,
+containers, comprehensions (a loop var over a tainted iterable is
+tainted), and through ANY call that takes a tainted argument — except
+the registered *declassifiers*, the one-way functions whose outputs are
+the published record (``g_pow_p``, ``elgamal_encrypt``,
+``make_schnorr_proof``, ... and ``len``: sizes are public).
+
+Sinks are the telemetry plane PR 4/7 built: ``logging`` calls (mirrored
+fleet-wide by ``obs.slog``), span attributes (``obs.span(...)`` dicts /
+``span.set``), metric names/labels, exception messages (they cross the
+rpc boundary in-band), and protobuf message construction outside the
+published-record allowlist.  One careless ``log.info("%s", seed)``
+would broadcast a trustee's secret to the collector; this pass makes
+that a build failure.  The baseline for this rule must stay EMPTY.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from electionguard_tpu.analysis import astutil, core
+
+RULE = "secret-taint"
+
+#: calls whose RESULT is secret, package-wide
+SOURCE_CALLS = {"rand_q", "prf_scalars", "prf_permutation",
+                "compute_polynomial"}
+
+#: path-suffix -> name-registered sources in that module
+PATH_SOURCES: dict[str, dict[str, set[str]]] = {
+    "keyceremony/trustee.py": {"attrs": {"_coefficients"},
+                               "params": {"nonce", "seed"}},
+    "mixnet/shuffle.py": {"attrs": set(), "params": {"seed", "perm"}},
+    "mixfed/server.py": {"attrs": {"_pinned_seed"}, "params": {"seed"}},
+    "crypto/elgamal.py": {"attrs": set(), "params": {"nonce", "secret"}},
+    "crypto/hashed_elgamal.py": {"attrs": set(), "params": {"nonce"}},
+}
+
+#: one-way publicization: the output is (part of) the published record,
+#: so taint stops here.  Everything else that consumes a secret returns
+#: a secret.
+DECLASSIFIERS = {
+    "g_pow_p", "pow_p",                    # discrete exp: public keys
+    "elgamal_encrypt", "hashed_elgamal_encrypt",   # ciphertexts
+    "encrypt_ballots", "encrypt_ballot",   # encrypted record + audit rows
+    "make_schnorr_proof", "make_chaum_pedersen",   # ZK proofs
+    "commitment_product",                  # public commitment algebra
+    "run_stage",                           # mix stage -> public transcript
+    "len", "type", "isinstance", "bool",   # shape/size/type are public
+    "range", "enumerate",
+}
+
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "log"}
+
+#: proto fields that may carry secret-derived values by design (the
+#: encrypted/proof channels of the record).  Everything else tainted in
+#: a ``pb.msg(...)``/``pb.X(...)`` constructor is a finding.
+PROTO_ALLOWLIST = {"encrypted_coordinate", "ciphertext", "proof"}
+
+
+def _sources_for(rel: str) -> dict[str, set[str]]:
+    for suffix, cfg in PATH_SOURCES.items():
+        if rel.endswith(suffix):
+            return cfg
+    return {"attrs": set(), "params": set()}
+
+
+class _FnTaint:
+    """Taint evaluation for one function body (intraprocedural)."""
+
+    def __init__(self, fn: ast.FunctionDef, attrs: set[str],
+                 params: set[str]):
+        self.fn = fn
+        self.source_attrs = set(attrs)
+        self.names: set[str] = {p for p in astutil.param_names(fn)
+                                if p in params}
+        self.attrs: set[str] = set()    # self.X assigned from taint here
+
+    # -- expression taint ------------------------------------------------
+    def tainted(self, node: Optional[ast.expr],
+                extra: frozenset = frozenset()) -> bool:
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.names or node.id in extra
+        if isinstance(node, ast.Attribute):
+            a = astutil.self_attr(node)
+            if a is not None:
+                return a in self.source_attrs or a in self.attrs
+            return self.tainted(node.value, extra)
+        if isinstance(node, ast.Call):
+            name = astutil.call_name(node)
+            if name in DECLASSIFIERS:
+                return False
+            if name in SOURCE_CALLS:
+                return True
+            parts = ([node.func.value] if isinstance(node.func,
+                                                     ast.Attribute) else [])
+            parts += list(node.args)
+            parts += [kw.value for kw in node.keywords]
+            return any(self.tainted(p, extra) for p in parts)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            bound = set(extra)
+            for gen in node.generators:
+                if self.tainted(gen.iter, frozenset(bound)):
+                    for t in ast.walk(gen.target):
+                        if isinstance(t, ast.Name):
+                            bound.add(t.id)
+            inner = frozenset(bound)
+            if isinstance(node, ast.DictComp):
+                return (self.tainted(node.key, inner)
+                        or self.tainted(node.value, inner))
+            return self.tainted(node.elt, inner)
+        if isinstance(node, ast.Compare):
+            return False          # a comparison result is one public bit
+        if isinstance(node, ast.Lambda):
+            return False
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr) and self.tainted(child, extra):
+                return True
+        return False
+
+    # -- propagation -----------------------------------------------------
+    def _bind(self, target: ast.expr) -> None:
+        if isinstance(target, ast.Name):
+            self.names.add(target.id)
+        elif isinstance(target, ast.Attribute):
+            a = astutil.self_attr(target)
+            if a is not None:
+                self.attrs.add(a)
+        elif isinstance(target, ast.Subscript):
+            # a tainted store into a container taints the container,
+            # never the names used to index it
+            self._bind(target.value)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value)
+
+    def propagate(self) -> None:
+        """Two monotone passes (taint only grows) reach a fixpoint for
+        straight-line code and simple loops."""
+        for _ in range(2):
+            for node in ast.walk(self.fn):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node is not self.fn:
+                    continue
+                if isinstance(node, ast.Assign):
+                    if self.tainted(node.value):
+                        for t in node.targets:
+                            self._bind(t)
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    if node.value is not None and self.tainted(node.value):
+                        self._bind(node.target)
+                elif isinstance(node, ast.NamedExpr):
+                    if self.tainted(node.value):
+                        self._bind(node.target)
+                elif isinstance(node, ast.For):
+                    if self.tainted(node.iter):
+                        self._bind(node.target)
+
+
+def _is_logger_base(node: ast.expr) -> bool:
+    """Heuristic: ``log.info``/``logger.x``/``logging.getLogger(..).x``."""
+    if isinstance(node, ast.Name):
+        return "log" in node.id.lower()
+    if isinstance(node, ast.Attribute):
+        return "log" in node.attr.lower()
+    if isinstance(node, ast.Call):
+        return astutil.call_name(node) == "getLogger"
+    return False
+
+
+def _is_pb_ctor(node: ast.Call) -> bool:
+    """``pb.msg("X")(...)`` or ``pb.X(...)``."""
+    fn = node.func
+    if isinstance(fn, ast.Call) and astutil.call_name(fn) == "msg":
+        return True
+    return (isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name) and fn.value.id == "pb")
+
+
+def _sinks(ft: _FnTaint, rel: str) -> Iterator[core.Finding]:
+    for node in ast.walk(ft.fn):
+        if isinstance(node, ast.Raise):
+            exc = node.exc
+            if isinstance(exc, ast.Call) and any(
+                    ft.tainted(a) for a in
+                    list(exc.args) + [k.value for k in exc.keywords]):
+                yield core.Finding(
+                    RULE, rel, node.lineno,
+                    "secret-derived value in an exception message "
+                    "(errors travel in-band over rpc and into logs)")
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        argvals = list(node.args) + [k.value for k in node.keywords]
+        if isinstance(fn, ast.Attribute) and fn.attr in _LOG_METHODS \
+                and _is_logger_base(fn.value):
+            if any(ft.tainted(a) for a in argvals):
+                yield core.Finding(
+                    RULE, rel, node.lineno,
+                    "secret-derived value reaches a logging call "
+                    "(obs.slog mirrors logs to the fleet collector)")
+        elif astutil.call_name(node) == "span":
+            if any(ft.tainted(a) for a in argvals):
+                yield core.Finding(
+                    RULE, rel, node.lineno,
+                    "secret-derived value in span attributes (spans "
+                    "are exported and pushed to the collector)")
+        elif (isinstance(fn, ast.Attribute) and fn.attr == "set"
+              and len(node.args) == 2):
+            if ft.tainted(node.args[1]):
+                yield core.Finding(
+                    RULE, rel, node.lineno,
+                    "secret-derived value in a span attribute "
+                    "(span.set exports it with the trace)")
+        elif (isinstance(fn, ast.Attribute)
+              and fn.attr in ("counter", "gauge", "histogram")):
+            if any(ft.tainted(a) for a in argvals):
+                yield core.Finding(
+                    RULE, rel, node.lineno,
+                    "secret-derived value in a metric name/labels "
+                    "(scraped and pushed fleet-wide)")
+        elif isinstance(fn, ast.Attribute) and fn.attr == "Err":
+            if any(ft.tainted(a) for a in argvals):
+                yield core.Finding(
+                    RULE, rel, node.lineno,
+                    "secret-derived value in a Result.Err message "
+                    "(errors are logged and cross process boundaries)")
+        elif _is_pb_ctor(node):
+            for kw in node.keywords:
+                if kw.arg and kw.arg not in PROTO_ALLOWLIST \
+                        and ft.tainted(kw.value):
+                    yield core.Finding(
+                        RULE, rel, node.lineno,
+                        f"secret-derived value in proto field "
+                        f"{kw.arg!r} outside the published-record "
+                        f"allowlist")
+
+
+@core.register(RULE, doc="dataflow from secret sources (key shares, "
+                         "permutations, nonces) to telemetry sinks")
+def run(project: core.Project) -> Iterator[core.Finding]:
+    for f in project.files():
+        cfg = _sources_for(f.rel)
+        for fn in astutil.walk_functions(f.tree):
+            ft = _FnTaint(fn, cfg["attrs"], cfg["params"])
+            ft.propagate()
+            yield from _sinks(ft, f.rel)

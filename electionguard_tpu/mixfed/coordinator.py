@@ -40,13 +40,14 @@ from electionguard_tpu.obs import REGISTRY, set_phase, span
 from electionguard_tpu.publish import pb, serialize
 from electionguard_tpu.publish.publisher import Consumer, Publisher
 from electionguard_tpu.remote import rpc_util
+from electionguard_tpu.utils import knobs
 
 log = logging.getLogger("mixfed.coordinator")
 
 
 def _chunk_rows() -> int:
     try:
-        return max(1, int(os.environ.get("EGTPU_MIX_CHUNK_ROWS", "64")))
+        return max(1, knobs.get_int("EGTPU_MIX_CHUNK_ROWS"))
     except ValueError:
         return 64
 

@@ -40,13 +40,14 @@ from electionguard_tpu.mixnet.stage import run_stage
 from electionguard_tpu.obs import REGISTRY, set_phase, span
 from electionguard_tpu.publish import pb, serialize
 from electionguard_tpu.remote import rpc_util
+from electionguard_tpu.utils import knobs
 
 log = logging.getLogger("mixfed.server")
 
 
 def _env_shards() -> int:
     try:
-        return max(0, int(os.environ.get("EGTPU_MIX_SHARDS", "0")))
+        return max(0, knobs.get_int("EGTPU_MIX_SHARDS"))
     except ValueError:
         return 0
 
@@ -167,20 +168,22 @@ class MixServerServer:
             REGISTRY.counter("mixfed_rows_pushed_total").inc(len(pads))
             return pb.msg("BoolResponse")(ok=True)
 
-    def _assemble_rows(self):
-        """Contiguous rows from the pushed chunks, or None + error."""
+    @staticmethod
+    def _assemble_rows(chunks, n_rows):
+        """Contiguous rows from the pushed chunks, or None + error.
+        Pure: the caller passes state it read under ``self._lock``."""
         pads: list = []
         datas: list = []
-        for start in sorted(self._chunks):
+        for start in sorted(chunks):
             if start != len(pads):
                 return None, None, (f"row chunks not contiguous at "
                                     f"{len(pads)} (got chunk {start})")
-            p, d = self._chunks[start]
+            p, d = chunks[start]
             pads.extend(p)
             datas.extend(d)
-        if len(pads) != self._n_rows:
+        if len(pads) != n_rows:
             return None, None, (f"{len(pads)} rows pushed != announced "
-                                f"{self._n_rows}")
+                                f"{n_rows}")
         return pads, datas, ""
 
     def _shuffle_stage(self, request, context):
@@ -200,7 +203,8 @@ class MixServerServer:
                 return pb.MixStageResult(
                     error=f"stage {k} already shuffled for a different "
                           f"input hash")
-            pads, datas, err = self._assemble_rows()
+            pads, datas, err = self._assemble_rows(self._chunks,
+                                                   self._n_rows)
             if err:
                 return pb.MixStageResult(error=f"stage {k}: {err}")
             got = rows_digest(self.group, pads, datas)

@@ -167,7 +167,9 @@ class ObsCollector:
                     p.metrics = json.loads(batch.metrics_json)
                 except ValueError:
                     self._ingest_drops += 1
-            closed, open_markers = self._split_spans(batch.span_lines)
+            closed, open_markers, drops = self._split_spans(
+                batch.span_lines)
+            self._ingest_drops += drops
             p.open_spans = open_markers
             p.spans += len(closed)
             self._spans_total += len(closed)
@@ -178,20 +180,23 @@ class ObsCollector:
             self._append(p, "log", list(batch.log_lines))
         return pb.msg("TelemetryAck")(ok=True)
 
-    def _split_spans(self, lines) -> tuple[list[str], list[dict]]:
+    def _split_spans(self, lines) -> tuple[list[str], list[dict], int]:
+        """Pure split: also returns the unparseable-line count so the
+        caller can account for it under the ingest lock."""
         closed: list[str] = []
         open_markers: list[dict] = []
+        drops = 0
         for line in lines:
             try:
                 rec = json.loads(line)
             except ValueError:
-                self._ingest_drops += 1
+                drops += 1
                 continue
             if assemble.is_open(rec):
                 open_markers.append(rec)
             else:
                 closed.append(line)
-        return closed, open_markers
+        return closed, open_markers, drops
 
     def _append(self, p: _ProcState, kind: str, lines: list[str]) -> None:
         path = os.path.join(self.recv_dir,
@@ -239,12 +244,12 @@ class ObsCollector:
     def get_fleet_status(self, request=None, context=None):
         from electionguard_tpu.publish import pb
         now = time.monotonic()
-        resp = pb.msg("FleetStatusResponse")(
-            health=self._health,
-            spans_total=self._spans_total,
-            dropped_total=self._ingest_drops,
-            slo_evals=self.engine.evals)
         with self._lock:
+            resp = pb.msg("FleetStatusResponse")(
+                health=self._health,
+                spans_total=self._spans_total,
+                dropped_total=self._ingest_drops,
+                slo_evals=self.engine.evals)
             procs = sorted(self._procs.values(),
                            key=lambda p: (p.proc, p.pid))
             for p in procs:
@@ -566,8 +571,8 @@ def client_from_env() -> Optional[TelemetryClient]:
         return None
     with _client_lock:
         if _client is None:
-            interval = float(os.environ.get(
-                "EGTPU_OBS_PUSH_INTERVAL", "1.0"))
+            from electionguard_tpu.utils import knobs
+            interval = knobs.get_float("EGTPU_OBS_PUSH_INTERVAL")
             _client = TelemetryClient(addr, interval_s=interval)
             _client.start()
         return _client

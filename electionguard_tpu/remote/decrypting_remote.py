@@ -199,7 +199,9 @@ class DecryptionCoordinator:
             self._started = True
 
     def shutdown(self, all_ok: bool):
-        for p in self.proxies:
+        with self._lock:
+            proxies = list(self.proxies)
+        for p in proxies:
             p.finish(all_ok)
             p.shutdown()
         self.server.stop(grace=1)

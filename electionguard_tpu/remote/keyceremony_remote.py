@@ -251,17 +251,22 @@ class KeyCeremonyCoordinator:
     def run_key_ceremony(self, trustee_out_dir: str) -> Union[KeyCeremonyResults, Result]:
         with self._lock:
             self._started_ceremony = True
-        results = key_ceremony_exchange(self.proxies, self.group)
+            # snapshot: a late registerTrustee racing the ceremony must
+            # not mutate the list we are iterating
+            proxies = list(self.proxies)
+        results = key_ceremony_exchange(proxies, self.group)
         if isinstance(results, Result):
             return results
-        for p in self.proxies:
+        for p in proxies:
             res = p.save_state(trustee_out_dir)
             if not res.ok:
                 return Result.Err(f"saveState({p.id}): {res.error}")
         return results
 
     def shutdown(self, all_ok: bool):
-        for p in self.proxies:
+        with self._lock:
+            proxies = list(self.proxies)
+        for p in proxies:
             p.finish(all_ok)
             p.shutdown()
         self.server.stop(grace=1)

@@ -297,12 +297,16 @@ class EncryptionService:
         if self._stream is not None:
             self._stream.close()
             self._stream = None
-        if self.journal is not None and not self.worker.is_alive():
-            # everything admitted is now resolved (published or answered
-            # in-band); an empty journal marks the shutdown as clean
-            self.journal.reset()
-            self.journal.close()
-            self.journal = None
+        with self._adm_lock:
+            # the admission lock keeps a straggler _admit from appending
+            # to a journal we are about to close
+            if self.journal is not None and not self.worker.is_alive():
+                # everything admitted is now resolved (published or
+                # answered in-band); an empty journal marks the
+                # shutdown as clean
+                self.journal.reset()
+                self.journal.close()
+                self.journal = None
         # request threads blocked in _resolve still hold completed
         # futures; give them `grace` to serialize their responses
         self.server.stop(grace=grace).wait(grace)

@@ -1,0 +1,181 @@
+"""Central registry of every ``EGTPU_*`` environment knob.
+
+Every env var the codebase reads is declared here with its type, the
+default the read site uses, and one line of doc.  The eglint pass
+``env-knob-registry`` enforces the contract in both directions:
+
+* an ``os.environ`` read of an undeclared ``EGTPU_*`` name is a finding
+  (so a knob can't ship undocumented), and
+* a read site whose literal default disagrees with the declared default
+  is a finding (so this table can't silently drift from the code).
+
+``ENV_KNOBS.md`` at the repo root is generated from this registry
+(``python tools/eglint.py --write-knobs``) and the same pass fails on
+drift between the committed table and ``render_table()``.
+
+Code may read knobs either directly (``os.environ.get(name, default)``)
+or through the typed getters below; the getters centralize the default
+so the read site can't contradict the declaration.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One declared env knob.  ``default`` is the literal string the
+    read sites pass to ``os.environ.get`` (None = no default: the knob
+    is an opt-in switch or has a context-dependent fallback)."""
+
+    name: str
+    type: str               # int | float | str | path | json | flag
+    default: Optional[str]
+    doc: str
+
+
+KNOBS: tuple[Knob, ...] = (
+    Knob("EGTPU_BIGNUM", "str", "auto",
+         "Bignum kernel backend: auto|ntt|cios (core/group_jax)."),
+    Knob("EGTPU_CHAOS_HOLD_AFTER_BALLOTS", "int", None,
+         "Chaos hook: the serving worker holds the device after N "
+         "ballots so a SIGKILL lands mid-batch (cli/run_encryption_"
+         "service; tests/test_faults)."),
+    Knob("EGTPU_COORDINATOR", "str", None,
+         "jax.distributed coordinator address host:port "
+         "(parallel/distributed)."),
+    Knob("EGTPU_DRYRUN_INLINE", "flag", None,
+         "Harness-internal: run the smoke dry-run inline instead of "
+         "re-exec'ing (repo entry shim)."),
+    Knob("EGTPU_DRYRUN_TIMEOUT", "float", "900",
+         "Harness-internal: dry-run subprocess timeout, seconds (repo "
+         "entry shim)."),
+    Knob("EGTPU_FAULT_PLAN", "json", "",
+         "Fault-injection plan: inline JSON or @file "
+         "(testing/faults; workflow chaos modes set it per process)."),
+    Knob("EGTPU_FEEDER_PLATFORM", "str", "cpu",
+         "Verifier feeder-pool child JAX platform (cli/run_verifier)."),
+    Knob("EGTPU_LOG", "str", "INFO",
+         "Root log level for every CLI (cli/common)."),
+    Knob("EGTPU_MIX_CHUNK_ROWS", "int", "64",
+         "Row-chunk size for the mixfed pushRows/pullRows paging "
+         "(mixfed/coordinator)."),
+    Knob("EGTPU_MIX_SHARDS", "int", "0",
+         "Mix-server row-axis shard count; 0 = single device "
+         "(mixfed/server)."),
+    Knob("EGTPU_MIX_TAMPER", "flag", None,
+         "Test hook: tamper with one mix stage's output so verification "
+         "must catch it (mixfed/server)."),
+    Knob("EGTPU_NUM_PROCESSES", "int", None,
+         "jax.distributed process count (parallel/distributed)."),
+    Knob("EGTPU_OBS_COLLECTOR", "str", "",
+         "Obs collector address host:port; enables the per-process "
+         "telemetry push client (obs/collector)."),
+    Knob("EGTPU_OBS_HTTP", "int", "",
+         "Prometheus /metrics port; 0 = ephemeral (obs/httpd)."),
+    Knob("EGTPU_OBS_LOG", "path", None,
+         "JSONL log-mirror dir; defaults to the trace dir (obs/slog)."),
+    Knob("EGTPU_OBS_PARENT_SPAN", "str", "",
+         "Parent span id for this process's root span; set by the "
+         "workflow driver (obs/trace)."),
+    Knob("EGTPU_OBS_PROC", "str", None,
+         "Process name stamped on spans/logs (obs/trace)."),
+    Knob("EGTPU_OBS_PUSH_INTERVAL", "float", "1.0",
+         "Telemetry push interval, seconds (obs/collector)."),
+    Knob("EGTPU_OBS_SLO", "json", "",
+         "SLO config override: inline JSON or @file (obs/slo)."),
+    Knob("EGTPU_OBS_TRACE", "path", None,
+         "Span-export dir; enables tracing (obs/trace)."),
+    Knob("EGTPU_OBS_TRACE_ID", "str", None,
+         "Join an existing trace id instead of minting one (obs/trace)."),
+    Knob("EGTPU_PROCESS_ID", "int", None,
+         "jax.distributed process id (parallel/distributed)."),
+    Knob("EGTPU_PROFILE", "path", None,
+         "JAX profiler trace dir, one subdir per workflow phase "
+         "(utils.profile_phase)."),
+    Knob("EGTPU_RPC_CONNECT_WINDOW", "float", "5.0",
+         "Max seconds one wait_for_ready retry may block "
+         "(remote/rpc_util)."),
+    Knob("EGTPU_RPC_RETRIES", "int", "3",
+         "RPC tries per call; 1 restores the reference's no-retry "
+         "posture (remote/rpc_util)."),
+    Knob("EGTPU_RPC_RETRY_BUDGET", "float", "120.0",
+         "Total backoff-sleep seconds one Stub may spend before "
+         "fail-fast (remote/rpc_util)."),
+    Knob("EGTPU_RPC_RETRY_CAP", "float", "8.0",
+         "Retry backoff ceiling, seconds (remote/rpc_util)."),
+    Knob("EGTPU_RPC_RETRY_WAIT", "float", "0.5",
+         "Retry backoff base, seconds (remote/rpc_util)."),
+    Knob("EGTPU_RPC_TIMEOUT_CONTROL", "float", "30.0",
+         "Deadline for control-class rpcs (remote/rpc_util)."),
+    Knob("EGTPU_RPC_TIMEOUT_DATA", "float", "600.0",
+         "Deadline for data-plane rpcs (51 MB batches; "
+         "remote/rpc_util)."),
+    Knob("EGTPU_RPC_TIMEOUT_EXCHANGE", "float", "120.0",
+         "Deadline for key-exchange rpcs (seconds of crypto; "
+         "remote/rpc_util)."),
+    Knob("EGTPU_RPC_TIMEOUT_REGISTRATION", "float", "30.0",
+         "Deadline for registration rpcs (remote/rpc_util)."),
+    Knob("EGTPU_SHA_DEVICE_MIN", "int", "65536",
+         "Min rows before the ballot-code SHA batch runs on the device "
+         "(ballot/code_batch)."),
+    Knob("EGTPU_TILE", "int", "4096",
+         "Row cap per device dispatch; bounds compile count AND peak "
+         "memory (core/group_jax)."),
+)
+
+_BY_NAME = {k.name: k for k in KNOBS}
+
+
+def declared(name: str) -> Optional[Knob]:
+    return _BY_NAME.get(name)
+
+
+def _declared_or_raise(name: str) -> Knob:
+    k = _BY_NAME.get(name)
+    if k is None:
+        raise KeyError(f"{name} is not declared in utils/knobs.py — add "
+                       f"it there (eglint env-knob-registry enforces "
+                       f"this)")
+    return k
+
+
+def get_str(name: str) -> str:
+    k = _declared_or_raise(name)
+    return os.environ.get(name, k.default or "")
+
+
+def get_int(name: str) -> int:
+    k = _declared_or_raise(name)
+    return int(os.environ.get(name, k.default))
+
+
+def get_float(name: str) -> float:
+    k = _declared_or_raise(name)
+    return float(os.environ.get(name, k.default))
+
+
+def get_flag(name: str) -> bool:
+    _declared_or_raise(name)
+    return os.environ.get(name, "") not in ("", "0")
+
+
+def render_table(knobs=KNOBS) -> str:
+    """The markdown knob table (``ENV_KNOBS.md``), generated so docs
+    can't drift from the registry."""
+    lines = [
+        "<!-- Generated from electionguard_tpu/utils/knobs.py by",
+        "     `python tools/eglint.py --write-knobs` — do not edit;",
+        "     the eglint env-knob-registry pass fails on drift. -->",
+        "# `EGTPU_*` environment knobs",
+        "",
+        "| Knob | Type | Default | Description |",
+        "|------|------|---------|-------------|",
+    ]
+    for k in sorted(knobs, key=lambda k: k.name):
+        default = f"`{k.default}`" if k.default else "(unset)"
+        lines.append(f"| `{k.name}` | {k.type} | {default} | {k.doc} |")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,377 @@
+"""Tier-1 gate + known-bad fixtures for the analysis framework.
+
+Two jobs:
+
+* **the gate**: run every registered pass over the real package and
+  require a clean strict report (no live findings, no stale baseline,
+  empty baseline for secret-taint/raw-channel, ENV_KNOBS.md in sync,
+  ANALYSIS.json artifact present and clean);
+* **prove each pass fires**: one deliberately-bad fixture per pass,
+  written into a temp dir with the package-relative layout the
+  path-scoped passes key on — the real package walk never sees them —
+  asserting the finding lands on the exact line, that an inline
+  ``# eglint: disable=RULE`` suppresses exactly one finding, and that
+  the baseline round-trips.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from electionguard_tpu.analysis import core
+from electionguard_tpu.utils import knobs as knobs_mod
+
+ALL_PASSES = {"env-knob-registry", "jit-hygiene", "lock-discipline",
+              "no-bare-print", "rpc-contract", "secret-taint"}
+
+
+# ---------------------------------------------------------------------------
+# fixture plumbing
+# ---------------------------------------------------------------------------
+
+def _project(tmp_path, files: dict[str, str]) -> core.Project:
+    """A throwaway project: ``files`` maps package-relative paths to
+    source text, rooted at ``tmp_path/pkg``."""
+    pkg = tmp_path / "pkg"
+    for rel, text in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return core.Project(package_dir=pkg, root=tmp_path)
+
+
+def _run(project, passes, baseline=()):
+    return core.run_passes(project, passes=passes,
+                           baseline=list(baseline))
+
+
+def _lines(report, rule):
+    return [f.line for f in report.findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# the whole-package gate
+# ---------------------------------------------------------------------------
+
+def test_registry_has_all_passes():
+    core.load_default_passes()
+    assert set(core.PASSES) == ALL_PASSES
+
+
+def test_package_strict_gate():
+    report = core.run_passes()
+    assert set(report.passes_run) == ALL_PASSES
+    assert len(report.files_scanned) > 80
+    assert not report.findings, "\n".join(str(f) for f in report.findings)
+    assert not report.stale_baseline
+
+
+def test_secret_rules_ship_with_empty_baseline():
+    baseline = core.load_baseline()
+    assert core.NO_BASELINE_RULES == ("secret-taint", "raw-channel")
+    assert not [e for e in baseline
+                if e["rule"] in core.NO_BASELINE_RULES]
+    # and every entry that IS baselined carries a tracking rationale
+    assert all(str(e["note"]).strip() for e in baseline)
+
+
+def test_env_knobs_table_in_sync():
+    table = core.REPO_ROOT / "ENV_KNOBS.md"
+    assert table.exists(), "run `python tools/eglint.py --write-knobs`"
+    assert table.read_text() == knobs_mod.render_table(), (
+        "ENV_KNOBS.md drifted from utils/knobs.py: run "
+        "`python tools/eglint.py --write-knobs`")
+
+
+def test_analysis_json_artifact():
+    path = core.REPO_ROOT / "ANALYSIS.json"
+    assert path.exists(), "run `python tools/eglint.py --json`"
+    data = json.loads(path.read_text())
+    assert data["version"] == 1
+    assert set(data["passes"]) == ALL_PASSES
+    assert data["findings"] == []
+    assert data["stale_baseline"] == []
+
+
+# ---------------------------------------------------------------------------
+# known-bad fixtures: each pass fires on the exact line
+# ---------------------------------------------------------------------------
+
+def test_secret_taint_fires_on_logged_secret(tmp_path):
+    project = _project(tmp_path, {"keyceremony/trustee.py": """\
+        import logging
+
+        log = logging.getLogger("t")
+
+
+        def leak(group):
+            seed = group.rand_q()
+            log.info("seed=%s", seed)
+    """})
+    report = _run(project, ["secret-taint"])
+    assert _lines(report, "secret-taint") == [8]
+
+
+def test_secret_taint_declassifier_stops_taint(tmp_path):
+    project = _project(tmp_path, {"keyceremony/trustee.py": """\
+        import logging
+
+        log = logging.getLogger("t")
+
+
+        def ok(group):
+            seed = group.rand_q()
+            pub = group.g_pow_p(seed)
+            log.info("pub=%s", pub)
+    """})
+    assert not _run(project, ["secret-taint"]).findings
+
+
+def test_raw_channel_fires_outside_rpc_util(tmp_path):
+    project = _project(tmp_path, {"client.py": """\
+        import grpc
+
+        chan = grpc.insecure_channel("localhost:1")
+    """})
+    report = _run(project, ["rpc-contract"])
+    assert _lines(report, "raw-channel") == [3]
+
+
+_FIXTURE_PROTO = """\
+syntax = "proto3";
+package egtpu;
+
+message Ping { uint64 chunk_start = 1; }
+message Pong { bool ok = 1; }
+message Empty { bool x = 1; }
+
+service DemoService {
+  rpc pushRows (Ping) returns (Pong);
+  rpc health (Empty) returns (Pong);
+}
+"""
+
+
+def test_rpc_contract_deadline_and_idempotency(tmp_path):
+    project = _project(tmp_path, {
+        "publish/proto/remote_rpc.proto": _FIXTURE_PROTO,
+        "remote/rpc_util.py": """\
+            _DEADLINE_CLASS_OF = {
+                "pushRows": "data",
+            }
+
+
+            def generic_service(name, impls):
+                return name, impls
+        """,
+        "remote/server.py": """\
+            def _push(request, context):
+                return request
+
+
+            def _health(request, context):
+                return context
+
+
+            SVC = generic_service("DemoService", {"pushRows": _push,
+                                                  "health": _health})
+        """,
+    })
+    report = _run(project, ["rpc-contract"])
+    msgs = {f.message.split(" — ")[0].split(" (")[0]: f
+            for f in report.findings}
+    # health has no deadline class, flagged at its proto line
+    health_line = 1 + _FIXTURE_PROTO.splitlines().index(
+        "  rpc health (Empty) returns (Pong);")
+    deadline = [f for f in report.findings if "deadline class" in f.message]
+    assert [(f.path.endswith(".proto"), f.line) for f in deadline] \
+        == [(True, health_line)]
+    # pushRows is chunked but its impl never reads chunk_start
+    idem = [f for f in report.findings if "chunk_start" in f.message]
+    assert len(idem) == 1 and idem[0].path.endswith("remote/server.py")
+    assert idem[0].line == 9        # the generic_service registration
+    assert len(report.findings) == 2, msgs
+
+
+def test_jit_hygiene_fires(tmp_path):
+    project = _project(tmp_path, {"kernels.py": """\
+        import jax
+        import jax.numpy as jnp
+
+
+        @jax.jit
+        def bad_sync(x):
+            return x.max().item()
+
+
+        @jax.jit
+        def bad_cast(x):
+            return int(x.sum())
+
+
+        @jax.jit
+        def bad_shape(n):
+            return jnp.arange(n)
+
+
+        def caller(x):
+            return jax.jit(bad_cast)(x)
+    """})
+    report = _run(project, ["jit-hygiene"])
+    assert sorted(_lines(report, "jit-hygiene")) == [7, 12, 17, 21]
+
+
+def test_jit_hygiene_construction_time_jit_is_clean(tmp_path):
+    # the sharded-plane idiom: jit bound once at __init__ time
+    project = _project(tmp_path, {"plane.py": """\
+        import jax
+
+
+        def kernel(x):
+            return x + 1
+
+
+        class Plane:
+            def __init__(self):
+                self._f = jax.jit(kernel)
+
+            def apply(self, x):
+                return self._f(x)
+    """})
+    assert not _run(project, ["jit-hygiene"]).findings
+
+
+def test_lock_discipline_fires(tmp_path):
+    project = _project(tmp_path, {"state.py": """\
+        import threading
+
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def size(self):
+                return len(self._items)
+    """})
+    report = _run(project, ["lock-discipline"])
+    assert _lines(report, "lock-discipline") == [14]
+    assert "read lock-free in size()" in report.findings[0].message
+
+
+def test_env_knob_registry_fires(tmp_path):
+    project = _project(tmp_path, {
+        "utils/knobs.py": """\
+            class Knob:
+                def __init__(self, name, type, default, doc):
+                    pass
+
+
+            KNOBS = (
+                Knob("EGTPU_DEMO", "int", "1", "Demo knob."),
+            )
+        """,
+        "app.py": """\
+            import os
+
+            ok = os.environ.get("EGTPU_DEMO", "1")
+            bad = os.environ.get("EGTPU_SECRET_TUNING", "")
+            drift = os.environ.get("EGTPU_DEMO", "2")
+        """,
+    })
+    # keep the docs-drift check quiet: commit the rendered table
+    decls = [knobs_mod.Knob("EGTPU_DEMO", "int", "1", "Demo knob.")]
+    (tmp_path / "ENV_KNOBS.md").write_text(knobs_mod.render_table(decls))
+    report = _run(project, ["env-knob-registry"])
+    assert sorted(_lines(report, "env-knob-registry")) == [4, 5]
+    msgs = sorted(f.message for f in report.findings)
+    assert "not declared" in msgs[1] and "declares '1'" in msgs[0]
+
+
+def test_env_knob_registry_flags_missing_table(tmp_path):
+    project = _project(tmp_path, {
+        "utils/knobs.py": """\
+            class Knob:
+                def __init__(self, name, type, default, doc):
+                    pass
+
+
+            KNOBS = (
+                Knob("EGTPU_DEMO", "int", "1", "Demo knob."),
+            )
+        """,
+    })
+    report = _run(project, ["env-knob-registry"])
+    assert len(report.findings) == 1
+    assert "ENV_KNOBS.md missing" in report.findings[0].message
+
+
+def test_no_bare_print_fires_and_cli_is_exempt(tmp_path):
+    project = _project(tmp_path, {
+        "mod.py": 'print("hi")\n',
+        "cli/tool.py": 'print("hi")\n',
+    })
+    report = _run(project, ["no-bare-print"])
+    assert [(f.path, f.line) for f in report.findings] \
+        == [("pkg/mod.py", 1)]
+
+
+# ---------------------------------------------------------------------------
+# suppression layers
+# ---------------------------------------------------------------------------
+
+def test_inline_disable_suppresses_exactly_one(tmp_path):
+    project = _project(tmp_path, {"keyceremony/trustee.py": """\
+        import logging
+
+        log = logging.getLogger("t")
+
+
+        def leak(group):
+            seed = group.rand_q()
+            log.info("a=%s", seed)  # eglint: disable=secret-taint
+            log.info("b=%s", seed)
+    """})
+    report = _run(project, ["secret-taint"])
+    assert report.suppressed == {"secret-taint": 1}
+    assert _lines(report, "secret-taint") == [9]
+
+
+def test_baseline_round_trip(tmp_path):
+    files = {"mod.py": 'print("hi")\n'}
+    project = _project(tmp_path, files)
+    first = _run(project, ["no-bare-print"])
+    assert len(first.findings) == 1
+
+    path = tmp_path / "baseline.json"
+    core.write_baseline(path, first.findings,
+                        note="fixture: parked for the round-trip test")
+    baseline = core.load_baseline(path)
+    second = _run(project, ["no-bare-print"], baseline=baseline)
+    assert not second.findings
+    assert [f.key for f in second.baselined] \
+        == [f.key for f in first.findings]
+    assert not second.stale_baseline
+
+    # fix the finding without removing the entry -> stale, never silent
+    third = _run(_project(tmp_path / "fixed", {"mod.py": "x = 1\n"}),
+                 ["no-bare-print"], baseline=baseline)
+    assert third.stale_baseline == baseline
+
+
+def test_baseline_rejects_noteless_and_no_baseline_rules(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps(
+        [{"rule": "lock-discipline", "path": "x.py", "line": 1}]))
+    with pytest.raises(ValueError, match="no note"):
+        core.load_baseline(p)
+    p.write_text(json.dumps(
+        [{"rule": "secret-taint", "path": "x.py", "line": 1,
+          "note": "tempting, but no"}]))
+    with pytest.raises(ValueError, match="may not be baselined"):
+        core.load_baseline(p)

@@ -1,65 +1,61 @@
 """Lint: no bare ``print()`` in electionguard_tpu/ library code.
 
-Library telemetry goes through ``logging`` (mirrored as structured JSONL
-with trace context by ``obs.slog``) — a bare ``print()`` is invisible to
-the observability plane and unattributable to a trace.  CLI entry points
-(``electionguard_tpu/cli/``) are exempt: their stdout IS their user
-interface.  A ``print(..., file=...)`` writing to an explicitly chosen
-stream (e.g. ``RunCommand.show(stream=...)`` dumping captured subprocess
-output) is display plumbing, not telemetry, and stays allowed.
-
-AST-based, so ``print`` inside string literals (subprocess ``-c``
-snippets in utils/platform.py) never false-positives.
+The rule itself now lives in the analysis framework
+(``electionguard_tpu/analysis/no_bare_print.py``, rule
+``no-bare-print``); this test is the seed lint's thin wrapper over that
+pass.  It preserves the original pins: the recursive package walk must
+still cover the newer subpackages AND the telemetry-plane modules (so a
+future layout change can't silently drop them from the lint), and the
+``cli/`` exemption must stay exactly ``("cli",)`` — entry-point stdout
+IS the user interface, everything else goes through ``logging``.
 """
 
 import ast
-import os
 
-import electionguard_tpu
-
-PKG_DIR = os.path.dirname(os.path.abspath(electionguard_tpu.__file__))
-EXEMPT_DIRS = ("cli",)   # entry points: stdout is the interface
+from electionguard_tpu.analysis import core, no_bare_print
 
 
-def _bare_prints(path: str) -> list[int]:
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    lines = []
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "print"
-                and not any(kw.arg == "file" for kw in node.keywords)):
-            lines.append(node.lineno)
-    return lines
+def _project() -> core.Project:
+    return core.Project()
+
+
+def test_walk_covers_new_packages_and_obs_modules():
+    project = _project()
+    tops = set()
+    rels = set()
+    for f in project.files():
+        parts = project.package_rel_parts(f)
+        if len(parts) > 1:
+            tops.add(parts[0])
+        rels.add("/".join(parts))
+    assert {"mixnet", "mixfed", "obs", "serve"} <= tops
+    assert {"obs/collector.py", "obs/slo.py", "obs/assemble.py"} <= rels
 
 
 def test_no_bare_print_in_library_code():
-    offenders = []
-    scanned_pkgs = set()
-    scanned_files = set()
-    for root, dirs, files in os.walk(PKG_DIR):
-        rel = os.path.relpath(root, PKG_DIR)
-        top = rel.split(os.sep)[0]
-        if top in EXEMPT_DIRS or "__pycache__" in root:
-            continue
-        scanned_pkgs.add(top)
-        for name in sorted(files):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(root, name)
-            scanned_files.add(os.path.relpath(path, PKG_DIR))
-            for lineno in _bare_prints(path):
-                offenders.append(
-                    f"{os.path.relpath(path, PKG_DIR)}:{lineno}")
-    # the walk is recursive by construction; pin the newer packages AND
-    # the telemetry-plane modules themselves so a future layout change
-    # can't silently drop them from the lint
-    assert {"mixnet", "mixfed", "obs", "serve"} <= scanned_pkgs
-    assert {os.path.join("obs", "collector.py"),
-            os.path.join("obs", "slo.py"),
-            os.path.join("obs", "assemble.py")} <= scanned_files
-    assert not offenders, (
+    report = core.run_passes(_project(), passes=["no-bare-print"],
+                             baseline=[])
+    assert not report.findings, (
         "bare print() in library code (use logging — obs.slog mirrors "
         "it as structured JSONL with trace context):\n  "
-        + "\n  ".join(offenders))
+        + "\n  ".join(str(f) for f in report.findings))
+
+
+def test_cli_exemption_is_pinned_and_load_bearing():
+    # the exemption list must not silently widen...
+    assert no_bare_print.EXEMPT_DIRS == ("cli",)
+    # ...and must actually be load-bearing: cli/ really does print to
+    # stdout (if this ever becomes false, drop the exemption too)
+    project = _project()
+    cli_prints = 0
+    for f in project.files():
+        if project.package_rel_parts(f)[0] != "cli":
+            continue
+        for node in ast.walk(f.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"
+                    and not any(kw.arg == "file"
+                                for kw in node.keywords)):
+                cli_prints += 1
+    assert cli_prints > 0

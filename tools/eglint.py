@@ -1,0 +1,91 @@
+#!/usr/bin/env python3
+"""eglint — the repo's project-native static analyzer.
+
+Usage::
+
+    python tools/eglint.py                 # report findings, exit 0
+    python tools/eglint.py -strict         # exit 1 on any live finding
+    python tools/eglint.py --json          # also write ANALYSIS.json
+    python tools/eglint.py --rule secret-taint --rule raw-channel
+    python tools/eglint.py --write-knobs   # regenerate ENV_KNOBS.md
+
+Findings are suppressed either inline (``# eglint: disable=RULE`` on
+the offending line) or via ``electionguard_tpu/analysis/baseline.json``
+(every entry needs a ``note`` rationale; secret-taint and raw-channel
+may never be baselined).  See README "Static analysis".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from electionguard_tpu.analysis import core  # noqa: E402
+from electionguard_tpu.utils import knobs  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="eglint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("-strict", "--strict", action="store_true",
+                    help="exit nonzero on any unbaselined finding")
+    ap.add_argument("--json", nargs="?", const=os.path.join(
+                        REPO_ROOT, "ANALYSIS.json"), default=None,
+                    metavar="PATH",
+                    help="write the findings artifact (default "
+                         "ANALYSIS.json at the repo root)")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="PASS", help="run only this pass "
+                    "(repeatable); default: all")
+    ap.add_argument("--package", default=None, metavar="DIR",
+                    help="package dir to scan (default: the installed "
+                         "electionguard_tpu package)")
+    ap.add_argument("--write-knobs", action="store_true",
+                    help="regenerate ENV_KNOBS.md from utils/knobs.py "
+                         "and exit")
+    args = ap.parse_args(argv)
+
+    if args.write_knobs:
+        out = os.path.join(REPO_ROOT, "ENV_KNOBS.md")
+        with open(out, "w") as f:
+            f.write(knobs.render_table())
+        print(f"wrote {os.path.relpath(out)}")
+        return 0
+
+    project = core.Project(package_dir=args.package) if args.package \
+        else core.Project()
+    report = core.run_passes(project, passes=args.rule)
+
+    for f in report.findings:
+        print(f)
+    for f in report.baselined:
+        print(f"{f}  [baselined]")
+    for e in report.stale_baseline:
+        print(f"{e['path']}:{e['line']}: [{e['rule']}] stale baseline "
+              f"entry (finding no longer fires) — remove it")
+    n_sup = sum(report.suppressed.values())
+    print(f"eglint: {len(report.files_scanned)} files, "
+          f"{len(report.passes_run)} passes, "
+          f"{len(report.findings)} findings, "
+          f"{len(report.baselined)} baselined, {n_sup} suppressed "
+          f"inline")
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report.to_json(), f, indent=2, sort_keys=False)
+            f.write("\n")
+        print(f"wrote {os.path.relpath(args.json)}")
+
+    if args.strict and (report.findings or report.stale_baseline):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
