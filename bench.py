@@ -180,7 +180,7 @@ def _microbench(group) -> None:
 
     lines = []
     rates: dict[str, float] = {}
-    for backend in ("cios", "ntt"):
+    for backend in ("cios", "ntt", "pallas"):
         try:
             ops = JaxGroupOps(group, backend=backend)
             if ops.backend != backend:  # ntt silently degraded
@@ -204,6 +204,49 @@ def _microbench(group) -> None:
         RESULT["mfu_pct"] = round(macs / 400e12 * 100, 3)
     RESULT["powmod_per_s"] = {k: round(v, 1) for k, v in rates.items()}
     note(f"microbench batch={B}: " + "  ".join(lines))
+
+
+def _bench_bignum(group) -> None:
+    """Per-backend primitive rates through core.bignum_bench.
+
+    On the chip: production batch, full-width ladders, all three
+    backends.  On the CPU fallback the pallas rows run in interpret
+    mode (~2.5 s per emulated launch), so the batch, reps, and powmod
+    ladder width shrink and the pallas row set drops the fixed-table
+    ladder; every row records the shape it actually ran.
+    """
+    import jax
+
+    from electionguard_tpu.core import bignum_bench
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        # measure the real pallas kernels (emulated) instead of the
+        # silent ntt fallback
+        os.environ.setdefault("EGTPU_PALLAS_INTERPRET", "1")
+    batch = 512 if on_tpu else 16
+    reps = 3 if on_tpu else 1
+    rows: list = []
+    RESULT["bignum"] = rows
+    for backend in ("cios", "ntt", "pallas"):
+        ops = ("mulmod", "powmod", "fixed")
+        bits = None
+        if backend == "pallas" and not on_tpu:
+            ops = ("mulmod", "powmod")  # fixed = ~8k emulated launches
+            bits, batch = 32, 8
+        try:
+            got = retry(f"bignum-{backend}",
+                        lambda: bignum_bench.backend_rows(
+                            group, backend, batch=batch, ops=ops,
+                            exp_bits=bits, reps=reps))
+        except Exception as e:  # noqa: BLE001 — diagnostics
+            RESULT.setdefault("bignum_backend_errors", {})[backend] = \
+                f"{type(e).__name__}: {e}"
+            continue
+        rows.extend(got)
+        flush_partial()
+    note("bignum phase: " + "  ".join(
+        f"{r['effective']}:{r['op']}={r['per_s']:.0f}/s" for r in rows))
 
 
 def _prewarm_fingerprint(g, mesh) -> dict:
@@ -422,6 +465,11 @@ def run_workload(nballots: int, n_chips: int) -> None:
             _stamp_prewarm(g, mesh)
     t_setup = time.time() - t_setup
     RESULT["setup_s"] = round(t_setup, 1)
+    # was the setup warm or cold? hit/miss/write counters of the on-disk
+    # table cache (EGTPU_TABLE_CACHE), plus whether it was enabled at all
+    from electionguard_tpu.core import table_cache
+    RESULT["table_cache"] = dict(table_cache.stats(),
+                                 dir=table_cache.cache_dir())
     flush_partial()
     note(f"setup done in {t_setup:.1f}s; full pass ({nballots} ballots)")
 
@@ -472,6 +520,17 @@ def run_workload(nballots: int, n_chips: int) -> None:
     except Exception as e:  # noqa: BLE001 — diagnostics
         note(f"obs phase failed: {type(e).__name__}: {e}")
         RESULT["obs_error"] = f"{type(e).__name__}: {e}"
+    flush_partial()
+
+    # ---- bignum phase: per-backend primitive rates (cios/ntt/pallas) ----
+    # the roofline's raw numbers — mulmod/powmod/fixed rows through the
+    # shared core.bignum_bench helper, labeled requested-vs-effective.
+    # Best-effort like the planes above; rows flush per backend.
+    try:
+        _bench_bignum(g)
+    except Exception as e:  # noqa: BLE001 — diagnostics
+        note(f"bignum phase failed: {type(e).__name__}: {e}")
+        RESULT["bignum_error"] = f"{type(e).__name__}: {e}"
     flush_partial()
 
     import jax

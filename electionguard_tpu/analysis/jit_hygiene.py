@@ -19,6 +19,14 @@ Jitted functions are found by decorator (``@jax.jit``, ``@jit``,
 ``@partial(jax.jit, ...)``) and by call-site registration: any name
 passed (however deeply: ``jax.jit(shard_map(self._f_impl, ...))``) into
 a ``jax.jit(...)`` call is looked up among the module's function defs.
+
+Pallas kernel bodies are jitted code too — stricter, even: Mosaic
+compiles them, so a host sync or dynamic shape is a guaranteed error,
+not just a performance bug.  The kernel handed to ``pl.pallas_call``
+(directly, or via the factory idiom ``self._k = make_kernel(...)`` →
+``pallas_call(ctx._k, ...)``) is resolved against the module's function
+defs and walked with the same checks; a factory match walks the factory
+whole, nested kernel def included.
 """
 
 from __future__ import annotations
@@ -41,6 +49,14 @@ def _is_jit(node: ast.expr) -> bool:
     """``jit`` / ``jax.jit`` (as a name or the function of a call)."""
     return ((isinstance(node, ast.Name) and node.id == "jit")
             or (isinstance(node, ast.Attribute) and node.attr == "jit"))
+
+
+def _is_pallas_call(node: ast.expr) -> bool:
+    """``pallas_call`` / ``pl.pallas_call`` — its first argument is a
+    kernel body that must obey the jitted-code rules."""
+    return ((isinstance(node, ast.Name) and node.id == "pallas_call")
+            or (isinstance(node, ast.Attribute)
+                and node.attr == "pallas_call"))
 
 
 def _jit_decorated(fn: ast.FunctionDef) -> bool:
@@ -122,8 +138,9 @@ def run(project: core.Project) -> Iterator[core.Finding]:
 
         jitted: set[str] = set()          # function names
         jitted_callables: set[str] = set()  # names bound to jax.jit(...)
-        # one-level indirection: mapped = shard_map(kernel, ...);
-        # jax.jit(mapped) must still mark `kernel` as jitted
+        # one-level indirection: mapped = shard_map(kernel, ...) or
+        # self._k = make_kernel(...); jax.jit(mapped) /
+        # pallas_call(ctx._k, ...) must still mark the def as jitted
         indirect: dict[str, set[str]] = {}
         for node in ast.walk(src.tree):
             if isinstance(node, ast.Assign) and isinstance(
@@ -132,13 +149,23 @@ def run(project: core.Project) -> Iterator[core.Finding]:
                 for t in node.targets:
                     if isinstance(t, ast.Name):
                         indirect[t.id] = leaves
+                    else:
+                        a = astutil.self_attr(t)
+                        if a:
+                            indirect[a] = leaves
         for node in ast.walk(src.tree):
             if isinstance(node, ast.Call) and _is_jit(node.func):
-                for arg in node.args:
-                    leaves = set(_leaf_names(arg))
-                    for n in list(leaves):
-                        leaves |= indirect.get(n, set())
-                    jitted.update(n for n in leaves if n in by_name)
+                args = node.args
+            elif (isinstance(node, ast.Call)
+                  and _is_pallas_call(node.func)):
+                args = node.args[:1]      # the kernel body argument
+            else:
+                args = ()
+            for arg in args:
+                leaves = set(_leaf_names(arg))
+                for n in list(leaves):
+                    leaves |= indirect.get(n, set())
+                jitted.update(n for n in leaves if n in by_name)
             if isinstance(node, ast.Assign) and isinstance(
                     node.value, ast.Call) and _is_jit(node.value.func):
                 for t in node.targets:

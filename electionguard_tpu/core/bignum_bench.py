@@ -1,0 +1,102 @@
+"""Shared per-backend measurement for the bignum data plane.
+
+One helper, two consumers: ``tools/bench_bignum.py`` (the standalone
+CLI, which adds ``--backend``/``--json``) and bench.py's best-effort
+``bignum`` phase (which lands the same rows in the benchmark artifact).
+Rows carry both the *requested* and the *effective* backend so a
+degraded fallback (pallas off-TPU without interpret mode, MXU engines
+on a tiny group) is measured as whatever it degraded to and labeled
+honestly rather than silently misattributed.
+
+Reduced ``exp_bits`` keeps the interpret-mode pallas ladder tractable
+on CPU (one montmul launch is ~2.5 s emulated; a full 256-bit ladder
+would be ~12 minutes per call): the row records the width it actually
+ran so throughputs are never compared across unequal ladders.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+import warnings
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from electionguard_tpu.core import bignum_jax as bn
+
+#: ops measurable per backend; "fixed" always runs the full-width
+#: window ladder over the registered g table
+DEFAULT_OPS = ("mulmod", "powmod", "fixed")
+
+
+def timeit(fn, *args, reps: int = 3) -> float:
+    """Warm (compile) once, then average ``reps`` timed calls."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / max(1, reps)
+
+
+def backend_rows(group, backend: str, batch: int = 64,
+                 ops: Sequence[str] = DEFAULT_OPS,
+                 exp_bits: Optional[int] = None,
+                 reps: int = 3) -> list[dict]:
+    """Measure the requested ops on one backend; one row dict per op.
+
+    Row fields: ``backend`` (requested), ``effective`` (post-fallback),
+    ``op``, ``batch``, ``exp_bits`` (None for mulmod), ``platform``,
+    ``sec_per_call``, ``per_s``.
+    """
+    from electionguard_tpu.core.group_jax import JaxGroupOps
+
+    with warnings.catch_warnings():
+        # fallback warnings are the point here — the row label carries
+        # the same information without spamming the bench log
+        warnings.simplefilter("ignore")
+        gops = JaxGroupOps(group, backend=backend)
+    bits = exp_bits or gops.exp_bits
+    rng = np.random.default_rng(0)
+    exps = [int.from_bytes(rng.bytes(32), "big") % group.q
+            for _ in range(batch)]
+    bases = [pow(group.g, e | 1, group.p) for e in exps[:min(batch, 64)]]
+    bases = (bases * (batch // len(bases) + 1))[:batch]
+    A = jnp.asarray(gops.to_limbs_p(bases))
+    platform = jax.devices()[0].platform
+    rows: list[dict] = []
+
+    def row(op: str, sec: float, op_bits: Optional[int]) -> None:
+        rows.append({"backend": backend, "effective": gops.backend,
+                     "op": op, "batch": batch, "exp_bits": op_bits,
+                     "platform": platform,
+                     "sec_per_call": round(sec, 6),
+                     "per_s": round(batch / sec, 2)})
+
+    if "mulmod" in ops:
+        row("mulmod", timeit(gops._mulmod_j, A, A, reps=reps), None)
+    if "powmod" in ops:
+        if bits == gops.exp_bits:
+            E = jnp.asarray(gops.to_limbs_q(exps))
+            row("powmod", timeit(gops._powmod_j, A, E, reps=reps), bits)
+        else:
+            # reduced ladder: same kernels, shorter square-and-multiply
+            # chain; jitted here once since _powmod_j is fixed-width
+            ne = max(1, (bits + 15) // 16)
+            E = jnp.asarray(bn.ints_to_limbs(
+                [e % (1 << bits) for e in exps], ne))
+            kw = {}
+            if gops._ms is not None:
+                kw = {"montmul_fn": gops._mm, "montsqr_fn": gops._ms}
+            pfn = jax.jit(functools.partial(
+                bn.powmod, gops.ctx, exp_bits=bits, **kw))
+            row("powmod", timeit(pfn, A, E, reps=reps), bits)
+    if "fixed" in ops:
+        E = jnp.asarray(gops.to_limbs_q(exps))
+        row("fixed", timeit(gops._fixed_pow_j, gops.g_table, E,
+                            reps=reps), gops.exp_bits)
+    return rows

@@ -32,7 +32,9 @@ import numpy as np
 
 from electionguard_tpu.core import bignum_jax as bn
 from electionguard_tpu.core import ntt_mxu
+from electionguard_tpu.core import table_cache
 from electionguard_tpu.core.group import GroupContext
+from electionguard_tpu.utils import knobs
 
 
 def _dispatch_tile() -> int:
@@ -113,15 +115,15 @@ def run_tiled_multi(jfn, arrays, fills, cap: int | None = None):
 
 
 def _default_backend() -> str:
-    """MXU NTT engine on TPU, VPU CIOS elsewhere; override with
-    EGTPU_BIGNUM=ntt|cios."""
+    """Fused Pallas kernels on TPU, VPU CIOS elsewhere; override with
+    EGTPU_BIGNUM=pallas|ntt|cios."""
     env = os.environ.get("EGTPU_BIGNUM", "auto").strip().lower()
-    if env in ("ntt", "cios"):
+    if env in ("pallas", "ntt", "cios"):
         return env
     if env not in ("", "auto"):
         raise ValueError(f"EGTPU_BIGNUM={env!r} not recognized; "
-                         "expected 'ntt', 'cios', or 'auto'")
-    return "ntt" if jax.default_backend() == "tpu" else "cios"
+                         "expected 'pallas', 'ntt', 'cios', or 'auto'")
+    return "pallas" if jax.default_backend() == "tpu" else "cios"
 
 
 class JaxGroupOps:
@@ -129,8 +131,13 @@ class JaxGroupOps:
     after construction (all tables are device constants).
 
     ``backend`` selects the Montgomery multiplier: "cios" (VPU lax.scan
-    kernel, bignum_jax) or "ntt" (MXU int8-matmul engine, ntt_mxu); both
-    share the R = 2^4096 Montgomery domain and limb format."""
+    kernel, bignum_jax), "ntt" (MXU int8-matmul engine, ntt_mxu), or
+    "pallas" (the fused-kernel build of the same NTT math,
+    core.pallas.engine); all share the R = 2^4096 Montgomery domain and
+    limb format.  The fallback chain pallas→ntt→cios degrades with a
+    warning instead of raising: pallas needs a TPU (or the
+    EGTPU_PALLAS_INTERPRET opt-in for bit-exact-but-slow CPU testing)
+    and, like ntt, the 4096-bit production limb count."""
 
     def __init__(self, group: GroupContext, backend: str | None = None):
         self.group = group
@@ -140,14 +147,39 @@ class JaxGroupOps:
         self.exp_bits = group.q.bit_length()
         self.ctx = bn.make_mont_ctx(p, self.n)
         self.backend = backend or _default_backend()
-        if self.backend not in ("ntt", "cios"):
+        if self.backend not in ("pallas", "ntt", "cios"):
             raise ValueError(f"unknown bignum backend {self.backend!r}; "
-                             "expected 'ntt' or 'cios'")
-        if self.backend == "ntt" and self.n != ntt_mxu.NL:
-            # the MXU engine is built for the 4096-bit production group
-            warnings.warn(f"ntt backend requires {ntt_mxu.NL}-limb groups; "
-                          f"falling back to cios for {self.n}-limb group")
+                             "expected 'pallas', 'ntt', or 'cios'")
+        if self.backend in ("pallas", "ntt") and self.n != ntt_mxu.NL:
+            # the MXU engines are built for the 4096-bit production group
+            warnings.warn(f"{self.backend} backend requires "
+                          f"{ntt_mxu.NL}-limb groups; falling back to "
+                          f"cios for {self.n}-limb group")
             self.backend = "cios"
+        if (self.backend == "pallas" and jax.default_backend() != "tpu"
+                and not knobs.get_flag("EGTPU_PALLAS_INTERPRET")):
+            warnings.warn("pallas backend requires a TPU (set "
+                          "EGTPU_PALLAS_INTERPRET=1 to run its kernels "
+                          "in interpret mode); falling back to ntt")
+            self.backend = "ntt"
+        if self.backend == "pallas":
+            try:
+                from electionguard_tpu.core.pallas import (
+                    engine as pallas_eng)
+            except ImportError as e:  # jax without pallas support
+                warnings.warn(f"pallas backend unavailable ({e}); "
+                              "falling back to ntt")
+                self.backend = "ntt"
+            else:
+                pctx = pallas_eng.make_pallas_ctx(p)
+                self._nctx = pctx.nctx
+                self._mm = functools.partial(pallas_eng.montmul, pctx)
+                self._ms = functools.partial(pallas_eng.montsqr, pctx)
+                self._mm_shared = functools.partial(
+                    pallas_eng.montmul_shared, pctx)
+                self._mm_hat = functools.partial(pallas_eng.montmul_hat,
+                                                 pctx)
+                self._nttfwd = functools.partial(pallas_eng.nttfwd, pctx)
         if self.backend == "ntt":
             nctx = ntt_mxu.make_ntt_ctx(p)
             self._nctx = nctx
@@ -158,12 +190,14 @@ class JaxGroupOps:
                                                 nctx)
             # fixed-base ladders multiply by pre-evaluated table rows
             self._mm_hat = functools.partial(ntt_mxu.montmul_hat, nctx)
-        else:
+            self._nttfwd = functools.partial(ntt_mxu.nttfwd, nctx)
+        elif self.backend == "cios":
             self._nctx = None
             self._mm = functools.partial(bn.montmul, self.ctx)
             self._ms = None
             self._mm_shared = None
             self._mm_hat = None
+            self._nttfwd = None
         R = 1 << (16 * self.n)
         self._R = R
 
@@ -199,12 +233,24 @@ class JaxGroupOps:
     # ------------------------------------------------------------------
     # fixed-base tables (PowRadix)
     # ------------------------------------------------------------------
+    def _table_fingerprint(self, kind: str, base: int) -> str:
+        return table_cache.fingerprint(
+            kind, p=table_cache.int_digest(self.group.p),
+            base=table_cache.int_digest(base % self.group.p),
+            nwin8=self.nwin8, n=self.n)
+
     def _make_fixed_table(self, base: int) -> jax.Array:
         """table[w, d] = mont(base^(d * 2^(8w))), shape (nwin8, 256, n).
 
-        Host-built with Python ints (one-time, ~8k modmuls), stored on
-        device in the Montgomery domain.
+        Host-built with Python ints (one-time, ~8k modmuls of 4096-bit
+        values — the dominant setup cost per base), stored on device in
+        the Montgomery domain and persisted via core.table_cache when
+        EGTPU_TABLE_CACHE is set.
         """
+        fp = self._table_fingerprint("powradix", base)
+        cached = table_cache.load("powradix", fp)
+        if cached is not None:
+            return jnp.asarray(cached["table"])
         p, R = self.group.p, self._R
         rows = np.empty((self.nwin8, 256, self.n), dtype=np.uint32)
         step = base % p  # base^(2^(8w)) for current w
@@ -214,6 +260,7 @@ class JaxGroupOps:
                 rows[w, d] = bn.int_to_limbs(acc * R % p, self.n)
                 acc = acc * step % p
             step = acc  # after 256 iters acc = step^256 = base^(2^(8(w+1)))
+        table_cache.store("powradix", fp, {"table": rows})
         return jnp.asarray(rows)
 
     _TABLE_CACHE_MAX = 16  # 8 MiB each; FIFO like the hat cache
@@ -231,20 +278,28 @@ class JaxGroupOps:
 
     def fixed_table_hat(self, base: int):
         """NTT-evaluated twin of ``fixed_table``: (nwin8, 256, 2, NC)
-        uint32 forward evaluations of every table row (ntt backend only;
-        None otherwise).  8x the plain table's memory — lets the
-        fixed-base ladder skip the table operand's forward NTT in every
-        window (ntt_mxu.montmul_hat).  Cache is FIFO-bounded: a
+        uint32 forward evaluations of every table row (ntt/pallas
+        backends only; None otherwise).  8x the plain table's memory —
+        lets the fixed-base ladder skip the table operand's forward NTT
+        in every window (montmul_hat).  Cache is FIFO-bounded: a
         long-lived process serving many elections (many keys K) must not
         accrete 64 MiB of HBM per key; evicted tables rebuild in one
-        device pass."""
-        if self._nctx is None:
+        device pass.  Evaluations are backend-independent (pallas is
+        bit-identical to ntt), so the on-disk entry is shared."""
+        if self._nttfwd is None:
             return None
         t = self._fixed_tables_hat.get(base)
         if t is None:
-            plain = self.fixed_table(base)
-            hat = ntt_mxu.nttfwd(self._nctx, plain.reshape(-1, self.n))
-            t = hat.reshape(self.nwin8, 256, 2, ntt_mxu.NC)
+            fp = self._table_fingerprint("powradix_hat", base)
+            cached = table_cache.load("powradix_hat", fp)
+            if cached is not None:
+                t = jnp.asarray(cached["table"])
+            else:
+                plain = self.fixed_table(base)
+                hat = self._nttfwd(plain.reshape(-1, self.n))
+                t = hat.reshape(self.nwin8, 256, 2, ntt_mxu.NC)
+                table_cache.store("powradix_hat", fp,
+                                  {"table": np.asarray(t)})
             while len(self._fixed_tables_hat) >= self._HAT_CACHE_MAX:
                 self._fixed_tables_hat.pop(
                     next(iter(self._fixed_tables_hat)))

@@ -48,6 +48,7 @@ import numpy as np
 from jax import lax
 
 from electionguard_tpu.core import bignum_jax as bn
+from electionguard_tpu.core import table_cache
 
 NL = 256          # 16-bit limbs per 4096-bit element
 ND = 512          # 8-bit digits
@@ -109,11 +110,13 @@ def _int_to_digits(x: int, nd: int) -> np.ndarray:
     return np.frombuffer(x.to_bytes(nd, "little"), dtype=np.uint8).copy()
 
 
-@functools.lru_cache(maxsize=None)
-def make_ntt_ctx(p: int) -> NttCtx:
-    mctx = bn.make_mont_ctx(p, NL)
-    R = 1 << (16 * NL)
-
+def _build_ntt_arrays(p: int) -> dict:
+    """Host-side construction of every NttCtx array constant, as plain
+    numpy (the expensive part of ``make_ntt_ctx`` — minutes of Python
+    bigint/Vandermonde work on the production group — and therefore the
+    part ``core.table_cache`` persists across processes).  The static
+    ints ride along packed into the ``scalars`` vector so a cache hit
+    skips the build entirely."""
     V0s, V1s, iV0s, iV1s = [], [], [], []
     ev0, ev1, iv0, iv1 = [], [], [], []
     mprime, mu26, mu27 = [], [], []
@@ -164,6 +167,7 @@ def make_ntt_ctx(p: int) -> NttCtx:
         mu27.append((1 << 27) // m)
 
     # Toeplitz constants for the Montgomery reduction (fixed operands)
+    R = 1 << (16 * NL)
     pprime = (-pow(p, -1, R)) % R
     pd = _int_to_digits(pprime, ND).astype(np.int64)
     pe = pd - 128
@@ -194,22 +198,53 @@ def make_ntt_ctx(p: int) -> NttCtx:
     p_pad[:NL] = np.asarray(bn.int_to_limbs(p, NL))
 
     m1, m2 = PRIMES
+    return {
+        "V0": np.stack(V0s), "V1": np.stack(V1s),
+        "iV0": np.stack(iV0s), "iV1": np.stack(iV1s),
+        "evoff0": np.stack(ev0).astype(np.int32),
+        "evoff1": np.stack(ev1).astype(np.int32),
+        "ivoff0": np.stack(iv0).astype(np.int32),
+        "ivoff1": np.stack(iv1).astype(np.int32),
+        "toep_m": toep_m, "f_m": f_m.astype(np.int32),
+        "toep_p": toep_p, "f_p": f_p.astype(np.int32),
+        "p_pad": p_pad,
+        "scalars": np.array(
+            list(PRIMES) + mprime + mu26 + mu27 + b1 + b0 + bc + bb + ba
+            + [pow(m1, -1, m2) * (1 << 16) % m2], dtype=np.int64),
+    }
+
+
+@functools.lru_cache(maxsize=None)
+def make_ntt_ctx(p: int) -> NttCtx:
+    mctx = bn.make_mont_ctx(p, NL)
+    fp = table_cache.fingerprint(
+        "nttctx", p=table_cache.int_digest(p), nl=NL, nd=ND, nc=NC,
+        primes=list(PRIMES), omega=[OMEGA[m] for m in PRIMES])
+    arrays = table_cache.load("nttctx", fp)
+    if arrays is None:
+        arrays = _build_ntt_arrays(p)
+        table_cache.store("nttctx", fp, arrays)
+    sc = arrays["scalars"]
+
+    def pair(i: int) -> tuple:
+        return (int(sc[i]), int(sc[i + 1]))
+
     return NttCtx(
         mctx=mctx,
-        V0=jnp.asarray(np.stack(V0s)), V1=jnp.asarray(np.stack(V1s)),
-        iV0=jnp.asarray(np.stack(iV0s)), iV1=jnp.asarray(np.stack(iV1s)),
-        evoff0=jnp.asarray(np.stack(ev0))[:, None, :].astype(jnp.int32),
-        evoff1=jnp.asarray(np.stack(ev1))[:, None, :].astype(jnp.int32),
-        ivoff0=jnp.asarray(np.stack(iv0))[:, None, :].astype(jnp.int32),
-        ivoff1=jnp.asarray(np.stack(iv1))[:, None, :].astype(jnp.int32),
-        toep_m=jnp.asarray(toep_m), f_m=jnp.asarray(f_m, dtype=jnp.int32),
-        toep_p=jnp.asarray(toep_p), f_p=jnp.asarray(f_p, dtype=jnp.int32),
-        p_pad=jnp.asarray(p_pad),
-        m=tuple(PRIMES), mprime=tuple(mprime),
-        mu26=tuple(mu26), mu27=tuple(mu27),
-        bias1=tuple(b1), bias0=tuple(b0),
-        biasc=tuple(bc), biasb=tuple(bb), biasa=tuple(ba),
-        inv12s=pow(m1, -1, m2) * (1 << 16) % m2,
+        V0=jnp.asarray(arrays["V0"]), V1=jnp.asarray(arrays["V1"]),
+        iV0=jnp.asarray(arrays["iV0"]), iV1=jnp.asarray(arrays["iV1"]),
+        evoff0=jnp.asarray(arrays["evoff0"])[:, None, :],
+        evoff1=jnp.asarray(arrays["evoff1"])[:, None, :],
+        ivoff0=jnp.asarray(arrays["ivoff0"])[:, None, :],
+        ivoff1=jnp.asarray(arrays["ivoff1"])[:, None, :],
+        toep_m=jnp.asarray(arrays["toep_m"]),
+        f_m=jnp.asarray(arrays["f_m"]),
+        toep_p=jnp.asarray(arrays["toep_p"]),
+        f_p=jnp.asarray(arrays["f_p"]),
+        p_pad=jnp.asarray(arrays["p_pad"]),
+        m=pair(0), mprime=pair(2), mu26=pair(4), mu27=pair(6),
+        bias1=pair(8), bias0=pair(10), biasc=pair(12), biasb=pair(14),
+        biasa=pair(16), inv12s=int(sc[18]),
     )
 
 

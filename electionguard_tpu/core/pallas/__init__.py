@@ -1,0 +1,15 @@
+"""Fused Pallas/Mosaic kernel set for the NTT Montgomery engine.
+
+The third ``EGTPU_BIGNUM`` backend ("pallas"): the same 4096-bit MXU
+NTT montmul math as ``core.ntt_mxu``, with the inter-matmul glue (digit
+carries, Barrett, CRT, Toeplitz offsets) fused into two hand-written
+kernels so coefficients stay in VMEM between stages instead of
+round-tripping through HBM as separate XLA ops.  Off-TPU the kernels
+run under ``pallas_call(..., interpret=True)`` and are bit-identical to
+``bignum_jax`` / ``ntt_mxu`` — tier-1 exercises them differentially on
+the CPU backend (tests/test_pallas.py).
+"""
+
+from electionguard_tpu.core.pallas.engine import (  # noqa: F401
+    PallasCtx, make_pallas_ctx, mont_pow, mont_prod_tree, montmul,
+    montmul_hat, montmul_shared, montsqr, mulmod, nttfwd, powmod)

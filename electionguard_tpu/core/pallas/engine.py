@@ -1,0 +1,502 @@
+"""Fused Pallas kernels + launch plumbing for the MXU Montgomery engine.
+
+``ntt_mxu`` runs a montmul as ~16 MXU matmuls stitched together by long
+XLA elementwise chains (digit/carry resolution, Barrett, CRT, Toeplitz
+offset glue) — and on the production group that glue, not the matmuls,
+is the measured bottleneck: every (B, 1024) intermediate round-trips
+through HBM as its own fused-elementwise op.  This module re-expresses
+the same math as TWO Pallas kernels per montmul so all intermediates
+live in VMEM for the whole stage:
+
+* ``eval`` kernel — canonical limbs -> balanced digit planes -> forward
+  NTT -> Barrett, per prime.  The input block is (bb, 256) uint32 limbs;
+  the low/high bytes ARE the even/odd base-256 digits, so the kernel
+  builds the two int8 e-form planes in registers and contracts them
+  against the de-interleaved Vandermonde rows: four (bb, 256) @
+  (256, 1024) MXU dots instead of ``ntt_mxu``'s four (bb, 1024) @
+  (1024, 1024) dots.  The dropped rows are the constant padding half of
+  the digit vector (e = -128 there); their contribution,
+  ``-128 * colsum(V[512:])``, is folded into the eval offset vectors
+  host-side (`PallasCtx`), so the kernel computes the *same exact
+  integers* with half the MACs.
+* ``combine`` kernel — per-prime pointwise 16-bit Montgomery products,
+  inverse NTT + CRT (six MXU dots + Barretts), then the full Montgomery
+  reduction (two Toeplitz dots + carry/cumsum offset glue + final
+  conditional subtract) in ONE launch: canonical product limbs out,
+  nothing between the pointwise multiply and the final result ever
+  leaves VMEM.
+
+Bound analysis is inherited UNCHANGED from the ``ntt_mxu`` module
+header: every intermediate here is the identical integer the unfused
+engine computes, so its int32/uint32 proofs (int8 partial dots < 2^24
+exact in int32; Barrett domains < 2^26 / < 2^28; conv coefficients
+< 2^25; Toeplitz rows >= 0 and < 2^25) apply verbatim.  The only
+re-derived pieces are Mosaic-friendly rewrites with the same results:
+``bignum_jax.normalize``'s carry-lookahead becomes an explicit
+Kogge-Stone shift/mask ladder (no ``lax.associative_scan``), and the
+offset prefix-sums become log-depth pad/add ladders (no ``jnp.cumsum``)
+— `|csT| <= 512*128 = 2^16` and `|cs1| <= 2^16` keep them exact in
+int32.
+
+VMEM working set per block (bb = EGTPU_PALLAS_BLOCK rows): the eval
+kernel holds the (bb, 256) limb block, two int8 digit planes, and one
+(bb, 1024) int32 accumulator per dot (~bb * 20 KiB) against 1 MiB of
+resident int8 Vandermonde planes; the combine kernel peaks at the
+(bb, 1028) digit stream plus two (bb, 1024) int32 accumulators
+(~bb * 24 KiB) against ~4.7 MiB of resident inverse-NTT/Toeplitz
+constants — bb = 128 fits comfortably in 16 MiB VMEM cores.
+
+Off-TPU every launch runs under ``pallas_call(..., interpret=True)``,
+which executes the kernel body with stock jax ops — bit-identical to
+``bignum_jax``/``ntt_mxu`` and exercised differentially by tier-1
+(tests/test_pallas.py) on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+from electionguard_tpu.core import bignum_jax as bn
+from electionguard_tpu.core import ntt_mxu
+from electionguard_tpu.core.ntt_mxu import NC, ND, NL
+from electionguard_tpu.utils import knobs
+
+U32 = jnp.uint32
+I32 = jnp.int32
+
+
+# ---------------------------------------------------------------------------
+# in-kernel math (VPU element ops; values stay in VMEM/registers)
+# ---------------------------------------------------------------------------
+
+def _dot_i8(a: jax.Array, w: jax.Array) -> jax.Array:
+    """(B, K) int8 @ (K, N) int8 -> (B, N) int32, exact (MXU int8 path)."""
+    return lax.dot_general(a, w, (((1,), (0,)), ((), ())),
+                           preferred_element_type=jnp.int32)
+
+
+def _barrett(x: jax.Array, m: int, mu: int, a: int, nsub: int) -> jax.Array:
+    """x mod m for uint32 x; same exhaustively-validated constants as
+    ``ntt_mxu._barrett`` (q = ((x>>a)*mu)>>13, nsub conditional subs)."""
+    q = ((x >> a) * U32(mu)) >> 13
+    r = x - q * U32(m)
+    for _ in range(nsub):
+        r = jnp.where(r >= m, r - U32(m), r)
+    return r
+
+
+def _mredc16(x: jax.Array, m: int, mprime: int) -> jax.Array:
+    """(x · 2^-16) mod m for uint32 x < 2^16·m: exact, in [0, m)."""
+    u = (x * U32(mprime)) & U32(0xFFFF)
+    t = (x + u * U32(m)) >> 16
+    return jnp.where(t >= m, t - U32(m), t)
+
+
+def _shup(x: jax.Array, d: int = 1, fill=None) -> jax.Array:
+    """Shift limbs ``d`` towards the MSB, dropping the top ``d`` (zero by
+    construction in every call site — moduli leave headroom)."""
+    pad = [(0, 0)] * (x.ndim - 1) + [(d, 0)]
+    if fill is None:
+        return jnp.pad(x[..., :-d], pad)
+    return jnp.pad(x[..., :-d], pad, constant_values=fill)
+
+
+def _normalize(t: jax.Array) -> jax.Array:
+    """Carry-propagate a redundant limb vector to canonical 16-bit limbs;
+    values < 2^32 in.  Same algorithm as ``bignum_jax.normalize`` (two
+    ripple passes then carry-lookahead over generate/propagate flags),
+    with the lookahead unrolled as an explicit Kogge-Stone doubling
+    ladder — shift/mask/and ops Mosaic lowers natively, in place of
+    ``lax.associative_scan``.  Step d combines each prefix with the
+    prefix d limbs below it (identity (g=0, p=1) shifts in), which is
+    exactly the associative scan of (gr | pr&gl, pl & pr)."""
+    m16 = U32(0xFFFF)
+    t = (t & m16) + _shup(t >> 16)        # limbs < 2^32 -> <= 2^17 - 2
+    t = (t & m16) + _shup(t >> 16)        # -> <= 2^16
+    g = (t >> 16).astype(U32)             # generate: limb == 2^16
+    p = t == m16                          # propagate: limb == 0xFFFF
+    d = 1
+    while d < t.shape[-1]:
+        g = g | (p.astype(U32) & _shup(g, d))
+        p = p & _shup(p, d, fill=True)
+        d <<= 1
+    return (t + _shup(g)) & m16           # exclusive prefix = carry-in
+
+
+def _prefix_sum(x: jax.Array) -> jax.Array:
+    """Inclusive prefix sum over the last axis as a log-depth pad/add
+    ladder (integer adds — bit-identical to ``jnp.cumsum``).  Callers
+    keep |sums| <= 2^16, exact in int32."""
+    d = 1
+    while d < x.shape[-1]:
+        x = x + _shup(x, d)
+        d <<= 1
+    return x
+
+
+def _digits_to_limbs(d: jax.Array) -> jax.Array:
+    """Nonneg redundant base-256 coeffs (..., L) u32 (< 2^25) -> canonical
+    16-bit limbs (..., L/2); carries beyond limb L/2 are provably zero at
+    every call site (see ``ntt_mxu._digits_to_limbs``)."""
+    d = (d & U32(0xFF)) + _shup(d >> 8)          # < 255 + 2^17
+    pairs = d.reshape(d.shape[:-1] + (d.shape[-1] // 2, 2))
+    return _normalize(pairs[..., 0] + (pairs[..., 1] << 8))
+
+
+def _limbs_to_e(x: jax.Array) -> jax.Array:
+    """(..., L) uint32 16-bit limbs -> (..., 2L) int8 e-form (digit-128)."""
+    d0 = (x & U32(0xFF)).astype(I32)
+    d1 = ((x >> 8) & U32(0xFF)).astype(I32)
+    e = jnp.stack([d0, d1], axis=-1).reshape(x.shape[:-1]
+                                             + (2 * x.shape[-1],))
+    return (e - 128).astype(jnp.int8)
+
+
+def _sub_if_ge(t: jax.Array, pp: jax.Array) -> jax.Array:
+    """t mod p for canonical t (..., n) < 2p; pp is p as (1, n) limbs.
+    Two's-complement add of -p (``bignum_jax._sub_p``) with the +1 and
+    the carry-capture limb built by concatenation instead of ``.at``."""
+    n = pp.shape[-1]
+    s = t + (U32(0xFFFF) - pp)
+    s = jnp.concatenate([s[..., :1] + U32(1), s[..., 1:],
+                         jnp.zeros_like(s[..., :1])], axis=-1)
+    s = _normalize(s)
+    return jnp.where(s[..., n:n + 1] > 0, s[..., :n], t)
+
+
+def _mont_reduce_vals(y, toep_m, f_m, toep_p, f_p, pp):
+    """Exact conv coefficients of T = a·b (bb, NC) int/uint32 -> canonical
+    (bb, NL) limbs of T·R^{-1} mod p.  Line-for-line ``ntt_mxu.
+    _mont_reduce`` on VMEM-resident values; offsets f_m/f_p/pp arrive as
+    (1, ·) rows so every op is a 2D broadcast."""
+    yp = jnp.pad(y, [(0, 0)] * (y.ndim - 1) + [(0, 4)])
+    Tl = _digits_to_limbs(yp)                             # (bb, 514)
+    eT = _limbs_to_e(Tl[..., :NL])                        # (bb, 512) low half
+    csT = _prefix_sum(eT.astype(I32))                     # |.| <= 2^16
+    m1c = _dot_i8(eT, toep_m) + f_m + (csT << 7)          # >= 0, < 2^25
+    m1l = _digits_to_limbs(m1c.astype(U32))               # (bb, 256) mod R
+    em1 = _limbs_to_e(m1l)                                # (bb, 512)
+    cs1 = _prefix_sum(em1.astype(I32))
+    last = jnp.broadcast_to(cs1[..., -1:], cs1.shape[:-1] + (ND,))
+    wsum = (jnp.concatenate([cs1, last], axis=-1)
+            - jnp.pad(cs1, [(0, 0)] * (cs1.ndim - 1) + [(ND, 0)]))
+    m1pc = _dot_i8(em1, toep_p) + f_p + (wsum << 7)       # >= 0, < 2^25
+    Td = jnp.stack([Tl & U32(0xFF), Tl >> 8], axis=-1)
+    Td = Td.reshape(Tl.shape[:-1] + (Tl.shape[-1] * 2,))  # (bb, 1028)
+    S = Td.astype(I32) + jnp.pad(m1pc, [(0, 0)] * (y.ndim - 1) + [(0, 4)])
+    Sl = _digits_to_limbs(S.astype(U32))                  # (bb, 514)
+    U = Sl[..., NL:NL + NL + 2]                           # (bb, 258) = S/R
+    return _sub_if_ge(U, pp)[..., :NL]
+
+
+# ---------------------------------------------------------------------------
+# kernel factories (statics baked in as python ints; refs in VMEM)
+# ---------------------------------------------------------------------------
+
+def make_eval_kernel(m: tuple, mu26: tuple, mu27: tuple):
+    """Fused limbs -> e-form planes -> forward NTT -> Barrett kernel.
+
+    Block shapes: x (bb, NL) uint32 canonical limbs; vlo/vhi the
+    de-interleaved (2, 2, ND/2, NC) int8 Vandermonde planes
+    ([prime, input-digit-parity, row, col]); off0/off1 the (2, 1, NC)
+    int32 folded eval offsets; outputs one (bb, NC) uint32 evaluation
+    block per prime, in [0, m_t)."""
+
+    def eval_kernel(x_ref, vlo_ref, vhi_ref, off0_ref, off1_ref,
+                    o0_ref, o1_ref):
+        x = x_ref[...]
+        d0 = ((x & U32(0xFF)).astype(I32) - 128).astype(jnp.int8)
+        d1 = ((x >> 8).astype(I32) - 128).astype(jnp.int8)
+        for t, o_ref in enumerate((o0_ref, o1_ref)):
+            a1 = (_dot_i8(d0, vhi_ref[t, 0]) + _dot_i8(d1, vhi_ref[t, 1])
+                  + off1_ref[t])                          # >= 0, < 2^24
+            r1 = _barrett(a1.astype(U32), m[t], mu26[t], 13, 2)
+            a0 = (_dot_i8(d0, vlo_ref[t, 0]) + _dot_i8(d1, vlo_ref[t, 1])
+                  + off0_ref[t]).astype(U32) + (r1 << 8)
+            o_ref[...] = _barrett(a0, m[t], mu27[t], 14, 3)  # < 2^27 dom
+
+    return eval_kernel
+
+
+def make_combine_kernel(m: tuple, mprime: tuple, mu26: tuple, mu27: tuple,
+                        biasc: tuple, inv12s: int):
+    """Fused pointwise-product -> inverse NTT -> CRT -> Montgomery
+    reduction kernel: per-prime evaluation blocks of both operands in,
+    canonical product limbs out, one launch."""
+
+    def combine_kernel(a0_ref, a1_ref, b0_ref, b1_ref, iv0_ref, iv1_ref,
+                       ivo0_ref, ivo1_ref, tm_ref, fm_ref, tp_ref,
+                       fp_ref, pp_ref, o_ref):
+        cs = []
+        for t, (a_ref, b_ref) in enumerate(((a0_ref, b0_ref),
+                                            (a1_ref, b1_ref))):
+            th = _mredc16(a_ref[...] * b_ref[...], m[t], mprime[t])
+            t0e = ((th & U32(0xFF)).astype(I32) - 128).astype(jnp.int8)
+            t1 = (th >> 8).astype(jnp.int8)               # <= 51
+            c = _dot_i8(t1, iv1_ref[t]) + biasc[t]
+            cm = _barrett(c.astype(U32), m[t], mu26[t], 13, 2)
+            b_ = (_dot_i8(t0e, iv1_ref[t]) + _dot_i8(t1, iv0_ref[t])
+                  + ivo1_ref[t]).astype(U32) + (cm << 8)
+            bm = _barrett(b_, m[t], mu26[t], 13, 2)
+            a_ = (_dot_i8(t0e, iv0_ref[t])
+                  + ivo0_ref[t]).astype(U32) + (bm << 8)
+            cs.append(_barrett(a_, m[t], mu27[t], 14, 3))
+        c1, c2 = cs
+        # CRT: y = c1 + m1·((c2 - c1)·m1^{-1} mod m2) via mredc16 with
+        # the 2^16 factor folded into inv12s; exact conv coeffs < 2^25.
+        d = c2 + U32(2 * m[1]) - c1
+        u = _mredc16(d * U32(inv12s), m[1], mprime[1])
+        y = c1 + U32(m[0]) * u
+        o_ref[...] = _mont_reduce_vals(y, tm_ref[...], fm_ref[...],
+                                       tp_ref[...], fp_ref[...],
+                                       pp_ref[...])
+
+    return combine_kernel
+
+
+# ---------------------------------------------------------------------------
+# context + launch plumbing
+# ---------------------------------------------------------------------------
+
+def _pow2ceil(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+class PallasCtx:
+    """Device constants + kernel closures for one modulus p.
+
+    Wraps the host-built ``NttCtx`` (same Barrett/bias constants, same
+    bound analysis) and derives the eval kernel's de-interleaved
+    operands: ``vlo[t, par]`` holds rows ``par::2`` of the first ND rows
+    of ``V0[t]`` (matching the low/high byte planes of the input limbs)
+    and the eval offsets absorb the constant -128 padding rows' column
+    sums, so the kernel's two-plane contraction equals the unfused
+    ``e_full @ V + evoff`` integer-for-integer."""
+
+    def __init__(self, p: int):
+        nctx = ntt_mxu.make_ntt_ctx(p)
+        self.nctx = nctx
+        self.block = max(8, knobs.get_int("EGTPU_PALLAS_BLOCK"))
+        # off-TPU the kernels always run in interpret mode (stock jax
+        # ops, bit-identical); backend *selection* policy lives in
+        # group_jax, not here.
+        self.interpret = jax.default_backend() != "tpu"
+
+        V0 = np.asarray(nctx.V0)
+        V1 = np.asarray(nctx.V1)
+        self.vlo = jnp.asarray(np.stack(
+            [V0[:, 0:ND:2, :], V0[:, 1:ND:2, :]], axis=1))
+        self.vhi = jnp.asarray(np.stack(
+            [V1[:, 0:ND:2, :], V1[:, 1:ND:2, :]], axis=1))
+
+        def fold(off, plane):
+            # e = -128 on the padded rows [ND:]; fold their contribution
+            # out of the offset so the kernel can skip those rows.
+            tail = 128 * plane[:, ND:, :].astype(np.int64).sum(axis=1)
+            out = np.asarray(off).astype(np.int64) - tail[:, None, :]
+            assert out.min() > -(1 << 31) and out.max() < (1 << 31)
+            return jnp.asarray(out.astype(np.int32))
+
+        self.evoff0 = fold(nctx.evoff0, V0)
+        self.evoff1 = fold(nctx.evoff1, V1)
+        # combine-kernel constants; vectors as (1, ·) rows for 2D layout
+        self.iv0, self.iv1 = nctx.iV0, nctx.iV1
+        self.ivoff0, self.ivoff1 = nctx.ivoff0, nctx.ivoff1
+        self.toep_m = nctx.toep_m
+        self.f_m = nctx.f_m.reshape(1, ND)
+        self.toep_p = nctx.toep_p
+        self.f_p = nctx.f_p.reshape(1, NC)
+        self.p_pad = nctx.p_pad.reshape(1, NL + 2)
+        self._eval_kernel = make_eval_kernel(nctx.m, nctx.mu26, nctx.mu27)
+        self._combine_kernel = make_combine_kernel(
+            nctx.m, nctx.mprime, nctx.mu26, nctx.mu27, nctx.biasc,
+            nctx.inv12s)
+        # per-launch-site jitted dispatchers (see _launch); mutate
+        # ``block`` only before the first op on a ctx — traced programs
+        # bake the grid plan per input shape
+        self._jits: dict = {}
+
+    @property
+    def mctx(self):
+        return self.nctx.mctx
+
+
+@functools.lru_cache(maxsize=None)
+def make_pallas_ctx(p: int) -> PallasCtx:
+    return PallasCtx(p)
+
+
+def _row0(i):
+    return (i, 0)
+
+
+def _pin(nd, i):
+    return (0,) * nd
+
+
+def _const_specs(arrays):
+    """Whole-array BlockSpecs pinned to block (0, ..): the NTT/Toeplitz
+    constants are grid-invariant and stay resident in VMEM."""
+    return [pl.BlockSpec(a.shape, functools.partial(_pin, a.ndim))
+            for a in arrays]
+
+
+def _block_plan(ctx: PallasCtx, b: int) -> tuple[int, int]:
+    """Rows per grid step and padded row count: small batches run as one
+    pow2-padded block, large ones as a 1-D grid of EGTPU_PALLAS_BLOCK
+    row tiles (zero rows are valid inputs at every stage)."""
+    bb = min(ctx.block, max(8, _pow2ceil(b)))
+    return bb, -(-b // bb) * bb
+
+
+def _launch(ctx: PallasCtx, name: str, fn):
+    """One jitted dispatcher per (ctx, launch site).  Callers already
+    under jit (group_jax's op programs) inline it as a nested jit;
+    outside-jit callers — PowRadix hat-table builds, interpret-mode
+    tests — compile the launch once per input shape instead of
+    re-tracing the whole pallas_call (in interpret mode, the whole
+    kernel emulation) on every call."""
+    try:
+        return ctx._jits[name]
+    except KeyError:
+        return ctx._jits.setdefault(name, jax.jit(fn))
+
+
+def _eval2(ctx: PallasCtx, x: jax.Array):
+    return _launch(ctx, "eval2", functools.partial(_eval2_impl, ctx))(x)
+
+
+def _combine(ctx: PallasCtx, a0, a1, b0, b1) -> jax.Array:
+    return _launch(ctx, "combine",
+                   functools.partial(_combine_impl, ctx))(a0, a1, b0, b1)
+
+
+def _eval2_impl(ctx: PallasCtx, x: jax.Array):
+    """(B, NL) canonical limbs -> per-prime forward evaluations, two
+    (B, NC) uint32 arrays in [0, m_t)."""
+    b = x.shape[0]
+    bb, bp = _block_plan(ctx, b)
+    if bp != b:
+        x = jnp.pad(x, [(0, bp - b), (0, 0)])
+    consts = (ctx.vlo, ctx.vhi, ctx.evoff0, ctx.evoff1)
+    h0, h1 = pl.pallas_call(
+        ctx._eval_kernel,
+        grid=(bp // bb,),
+        in_specs=[pl.BlockSpec((bb, NL), _row0)] + _const_specs(consts),
+        out_specs=(pl.BlockSpec((bb, NC), _row0),
+                   pl.BlockSpec((bb, NC), _row0)),
+        out_shape=(jax.ShapeDtypeStruct((bp, NC), jnp.uint32),
+                   jax.ShapeDtypeStruct((bp, NC), jnp.uint32)),
+        interpret=ctx.interpret,
+    )(x, *consts)
+    return h0[:b], h1[:b]
+
+
+def _combine_impl(ctx: PallasCtx, a0, a1, b0, b1) -> jax.Array:
+    """Per-prime evaluations of both operands (each (B, NC)) ->
+    canonical (B, NL) limbs of a·b·R^{-1} mod p."""
+    b = a0.shape[0]
+    bb, bp = _block_plan(ctx, b)
+    if bp != b:
+        pads = [(0, bp - b), (0, 0)]
+        a0, a1, b0, b1 = (jnp.pad(v, pads) for v in (a0, a1, b0, b1))
+    consts = (ctx.iv0, ctx.iv1, ctx.ivoff0, ctx.ivoff1, ctx.toep_m,
+              ctx.f_m, ctx.toep_p, ctx.f_p, ctx.p_pad)
+    out = pl.pallas_call(
+        ctx._combine_kernel,
+        grid=(bp // bb,),
+        in_specs=([pl.BlockSpec((bb, NC), _row0)] * 4
+                  + _const_specs(consts)),
+        out_specs=pl.BlockSpec((bb, NL), _row0),
+        out_shape=jax.ShapeDtypeStruct((bp, NL), jnp.uint32),
+        interpret=ctx.interpret,
+    )(a0, a1, b0, b1, *consts)
+    return out[:b]
+
+
+# ---------------------------------------------------------------------------
+# public ops (drop-in for ntt_mxu / bignum_jax signatures)
+# ---------------------------------------------------------------------------
+
+def montmul(ctx: PallasCtx, a: jax.Array, b: jax.Array) -> jax.Array:
+    """Batched Montgomery product a·b·R^{-1} mod p: one eval launch over
+    the concatenated operands, one combine launch."""
+    shape = a.shape
+    a2 = a.reshape(-1, NL)
+    b2 = jnp.broadcast_to(b, shape).reshape(-1, NL)
+    k = a2.shape[0]
+    h0, h1 = _eval2(ctx, jnp.concatenate([a2, b2], axis=0))
+    return _combine(ctx, h0[:k], h1[:k], h0[k:], h1[k:]).reshape(shape)
+
+
+def montsqr(ctx: PallasCtx, a: jax.Array) -> jax.Array:
+    """Batched Montgomery square (one eval launch instead of two)."""
+    shape = a.shape
+    h0, h1 = _eval2(ctx, a.reshape(-1, NL))
+    return _combine(ctx, h0, h1, h0, h1).reshape(shape)
+
+
+def montmul_shared(ctx: PallasCtx, sel: jax.Array,
+                   base: jax.Array) -> jax.Array:
+    """(B, k, NL) × (B, NL) products sel[:, j]·base: the shared operand
+    is evaluated ONCE (in the same launch as the buckets) and its
+    evaluations broadcast across k — same saving as
+    ``ntt_mxu.montmul_shared`` for the Yao bucket multiply."""
+    B, k, n = sel.shape
+    h0, h1 = _eval2(ctx, jnp.concatenate([sel.reshape(B * k, n), base],
+                                         axis=0))
+    s0, s1 = h0[:B * k], h1[:B * k]
+    bx0 = jnp.broadcast_to(h0[B * k:][:, None, :],
+                           (B, k, NC)).reshape(B * k, NC)
+    bx1 = jnp.broadcast_to(h1[B * k:][:, None, :],
+                           (B, k, NC)).reshape(B * k, NC)
+    return _combine(ctx, s0, s1, bx0, bx1).reshape(B, k, n)
+
+
+def nttfwd(ctx: PallasCtx, a: jax.Array) -> jax.Array:
+    """(B, NL) limbs -> (B, 2, NC) forward evaluations (PowRadix tables
+    store this layout; see ``ntt_mxu.nttfwd``)."""
+    h0, h1 = _eval2(ctx, a)
+    return jnp.stack([h0, h1], axis=1)
+
+
+def montmul_hat(ctx: PallasCtx, a: jax.Array, bh: jax.Array) -> jax.Array:
+    """Montgomery product of canonical a (B, NL) with a pre-evaluated
+    operand bh (B, 2, NC) — the fixed-base ladder's table-row multiply,
+    skipping the table operand's forward NTT."""
+    a0, a1 = _eval2(ctx, a)
+    return _combine(ctx, a0, a1, bh[..., 0, :], bh[..., 1, :])
+
+
+def mont_pow(ctx: PallasCtx, base_mont: jax.Array, exp: jax.Array,
+             exp_bits: int) -> jax.Array:
+    return bn.mont_pow(ctx.mctx, base_mont, exp, exp_bits,
+                       montmul_fn=functools.partial(montmul, ctx),
+                       montsqr_fn=functools.partial(montsqr, ctx))
+
+
+def powmod(ctx: PallasCtx, base: jax.Array, exp: jax.Array,
+           exp_bits: int) -> jax.Array:
+    return bn.powmod(ctx.mctx, base, exp, exp_bits,
+                     montmul_fn=functools.partial(montmul, ctx),
+                     montsqr_fn=functools.partial(montsqr, ctx))
+
+
+def mulmod(ctx: PallasCtx, a: jax.Array, b: jax.Array) -> jax.Array:
+    """Plain modular product a·b mod p."""
+    return montmul(ctx, montmul(ctx, a, b),
+                   jnp.broadcast_to(ctx.mctx.r2_mod_p, a.shape))
+
+
+def mont_prod_tree(ctx: PallasCtx, x: jax.Array) -> jax.Array:
+    return bn.mont_prod_tree(ctx.mctx, x,
+                             montmul_fn=functools.partial(montmul, ctx))

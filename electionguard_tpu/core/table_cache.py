@@ -1,0 +1,145 @@
+"""Persistent on-disk cache for host-precomputed setup tables.
+
+The production group pays ~3 minutes of host setup per process
+(BENCH_r05: setup_s 187.9) rebuilding arrays that are pure functions of
+the group: the NTT engine's Vandermonde/Toeplitz constants
+(``ntt_mxu._build_ntt_arrays``) and the PowRadix fixed-base tables
+(~8k modmuls of 4096-bit Python ints per base, plus their NTT-evaluated
+twins).  This module persists those arrays under a directory named by
+the ``EGTPU_TABLE_CACHE`` knob so every process after the first starts
+warm.
+
+Contract:
+
+* **keyed by fingerprint** — sha256 over a canonical JSON blob naming
+  the table kind, a format ``VERSION``, and every input the build
+  depends on (group modulus digest, base digest, window/limb geometry).
+  Any mismatch — including a stale format version — is a miss, never a
+  wrong answer.
+* **torn-write safe** — entries are written to a ``mkstemp`` temp file
+  in the same directory and ``os.replace``'d into place (atomic on
+  POSIX); the full fingerprint is embedded IN the payload and re-checked
+  on load, so a partial or corrupt file (unreadable npz, truncated
+  array set, foreign fingerprint) degrades to a rebuild.
+* **always optional** — unset/empty knob disables everything; any I/O
+  error on load or store logs a warning and falls back to recompute.
+
+``stats()`` exposes hit/miss/write counters so bench.py can report
+whether a run was warm or cold.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import logging
+import os
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+from electionguard_tpu.utils import knobs
+
+log = logging.getLogger(__name__)
+
+VERSION = 1
+
+_stats = {"hits": 0, "misses": 0, "writes": 0, "errors": 0}
+
+
+def stats() -> dict:
+    """Copy of the process-lifetime cache counters."""
+    return dict(_stats)
+
+
+def reset_stats() -> None:
+    for k in _stats:
+        _stats[k] = 0
+
+
+def cache_dir() -> Optional[str]:
+    """The configured cache directory, or None when caching is off."""
+    return knobs.get_str("EGTPU_TABLE_CACHE") or None
+
+
+def int_digest(x: int) -> str:
+    """Stable digest of an arbitrarily large nonnegative int (group
+    moduli, table bases) — keeps fingerprints short and canonical."""
+    nbytes = max(1, (x.bit_length() + 7) // 8)
+    return hashlib.sha256(x.to_bytes(nbytes, "little")).hexdigest()
+
+
+def fingerprint(kind: str, **fields) -> str:
+    """sha256 over the canonical JSON of (VERSION, kind, fields)."""
+    blob = json.dumps({"version": VERSION, "kind": kind, **fields},
+                      sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _entry_path(d: str, kind: str, fp: str) -> str:
+    return os.path.join(d, f"{kind}-{fp[:32]}.npz")
+
+
+def load(kind: str, fp: str) -> Optional[dict]:
+    """The cached array dict for (kind, fingerprint), or None on any
+    miss — absent, torn, corrupt, or fingerprint-mismatched entries all
+    land here and the caller rebuilds."""
+    d = cache_dir()
+    if d is None:
+        return None
+    path = _entry_path(d, kind, fp)
+    try:
+        with np.load(path) as z:
+            if z["__fingerprint__"].tobytes().decode() != fp:
+                _stats["misses"] += 1
+                return None
+            arrays = {k: np.asarray(z[k]) for k in z.files
+                      if k != "__fingerprint__"}
+    except FileNotFoundError:
+        _stats["misses"] += 1
+        return None
+    except Exception as e:  # torn write, bad zip, missing key, ...
+        _stats["errors"] += 1
+        _stats["misses"] += 1
+        log.warning("table cache: unreadable entry %s (%s); rebuilding",
+                    path, e)
+        return None
+    _stats["hits"] += 1
+    return arrays
+
+
+def store(kind: str, fp: str, arrays: dict) -> None:
+    """Atomically persist ``arrays`` (str -> numpy) under (kind, fp).
+    Best-effort: failures warn and leave the cache unchanged."""
+    d = cache_dir()
+    if d is None:
+        return
+    path = _entry_path(d, kind, fp)
+    tmp = None
+    try:
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=f".{kind}-",
+                                   suffix=".tmp")
+        # uncompressed: hat tables are 64 MiB and load time matters more
+        # than disk; savez needs a real file object for the zip footer
+        buf = io.BytesIO()
+        np.savez(buf,
+                 __fingerprint__=np.frombuffer(fp.encode(),
+                                               dtype=np.uint8),
+                 **{k: np.asarray(v) for k, v in arrays.items()})
+        with os.fdopen(fd, "wb") as f:
+            f.write(buf.getvalue())
+        os.replace(tmp, path)
+        tmp = None
+        _stats["writes"] += 1
+    except Exception as e:
+        _stats["errors"] += 1
+        log.warning("table cache: failed to store %s (%s)", path, e)
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

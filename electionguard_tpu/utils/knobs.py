@@ -39,7 +39,8 @@ class Knob:
 
 KNOBS: tuple[Knob, ...] = (
     Knob("EGTPU_BIGNUM", "str", "auto",
-         "Bignum kernel backend: auto|ntt|cios (core/group_jax)."),
+         "Bignum kernel backend: auto|pallas|ntt|cios; auto = pallas on "
+         "TPU, cios elsewhere (core/group_jax)."),
     Knob("EGTPU_CHAOS_HOLD_AFTER_BALLOTS", "int", None,
          "Chaos hook: the serving worker holds the device after N "
          "ballots so a SIGKILL lands mid-batch (cli/run_encryption_"
@@ -91,6 +92,13 @@ KNOBS: tuple[Knob, ...] = (
          "Span-export dir; enables tracing (obs/trace)."),
     Knob("EGTPU_OBS_TRACE_ID", "str", None,
          "Join an existing trace id instead of minting one (obs/trace)."),
+    Knob("EGTPU_PALLAS_BLOCK", "int", "128",
+         "Rows per Pallas kernel grid step; bounds the fused kernels' "
+         "VMEM working set (core/pallas)."),
+    Knob("EGTPU_PALLAS_INTERPRET", "flag", None,
+         "Allow the pallas backend off-TPU by running its kernels in "
+         "interpret mode (slow; for differential testing — "
+         "core/group_jax)."),
     Knob("EGTPU_PROCESS_ID", "int", None,
          "jax.distributed process id (parallel/distributed)."),
     Knob("EGTPU_PROFILE", "path", None,
@@ -122,6 +130,10 @@ KNOBS: tuple[Knob, ...] = (
     Knob("EGTPU_SHA_DEVICE_MIN", "int", "65536",
          "Min rows before the ballot-code SHA batch runs on the device "
          "(ballot/code_batch)."),
+    Knob("EGTPU_TABLE_CACHE", "path", None,
+         "On-disk cache dir for host-precomputed setup tables (NttCtx "
+         "constants, PowRadix tables), keyed by group fingerprint; "
+         "empty/unset = rebuild every process (core/table_cache)."),
     Knob("EGTPU_TILE", "int", "4096",
          "Row cap per device dispatch; bounds compile count AND peak "
          "memory (core/group_jax)."),

@@ -15,6 +15,9 @@ import os
 from electionguard_tpu.utils.platform import detach_axon  # noqa: E402
 
 detach_axon()
+# Hermetic setup tables: never read/write an ambient on-disk table cache
+# from tests (individual tests opt back in with a tmp_path dir).
+os.environ.setdefault("EGTPU_TABLE_CACHE", "")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

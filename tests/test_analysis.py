@@ -222,6 +222,52 @@ def test_jit_hygiene_fires(tmp_path):
     assert sorted(_lines(report, "jit-hygiene")) == [7, 12, 17, 21]
 
 
+def test_jit_hygiene_walks_pallas_kernel_bodies(tmp_path):
+    # a kernel handed straight to pl.pallas_call is jitted code: the
+    # host sync inside it must fire at its exact line
+    project = _project(tmp_path, {"kern.py": """\
+        import jax
+        from jax.experimental import pallas as pl
+
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * x_ref[...].max().item()
+
+
+        def launch(x):
+            return pl.pallas_call(kernel, out_shape=x)(x)
+    """})
+    report = _run(project, ["jit-hygiene"])
+    assert _lines(report, "jit-hygiene") == [6]
+
+
+def test_jit_hygiene_resolves_pallas_factory_indirection(tmp_path):
+    # the factory idiom the core/pallas engine uses: the kernel def is
+    # nested inside a maker, bound to an attribute at ctx-build time,
+    # and only the attribute reaches pallas_call.  The walk must still
+    # reach the nested body.
+    project = _project(tmp_path, {"eng.py": """\
+        from jax.experimental import pallas as pl
+
+
+        def make_kernel(m):
+            def kernel(x_ref, o_ref):
+                o_ref[...] = int(x_ref[...].sum()) % m
+            return kernel
+
+
+        class Ctx:
+            def __init__(self, m):
+                self._kernel = make_kernel(m)
+
+
+        def launch(ctx, x):
+            return pl.pallas_call(ctx._kernel, out_shape=x)(x)
+    """})
+    report = _run(project, ["jit-hygiene"])
+    assert _lines(report, "jit-hygiene") == [6]
+
+
 def test_jit_hygiene_construction_time_jit_is_clean(tmp_path):
     # the sharded-plane idiom: jit bound once at __init__ time
     project = _project(tmp_path, {"plane.py": """\

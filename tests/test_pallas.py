@@ -1,0 +1,226 @@
+"""Differential tests for the fused Pallas backend (core/pallas).
+
+Everything runs the kernels under ``pallas_call(..., interpret=True)``
+on the CPU backend — slow but bit-exact emulation of the kernel bodies
+— so equality against the VPU CIOS kernel (``bignum_jax``) and the
+unfused MXU engine (``ntt_mxu``) is asserted limb-for-limb, never
+approximately.  Batches stay tiny and exponent ladders use reduced
+exp_bits (the ``test_ntt_mxu`` sizing); the backend fallback chain and
+the compile-once dispatch guarantee are pinned alongside the math.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from electionguard_tpu.core import bignum_jax as bn
+from electionguard_tpu.core import ntt_mxu as nt
+from electionguard_tpu.core.group_jax import JaxGroupOps, _default_backend
+from electionguard_tpu.core.pallas import engine as pe
+
+
+@pytest.fixture(scope="module")
+def pctx(pgroup):
+    return pe.make_pallas_ctx(pgroup.p)
+
+
+def _rand_elems(g, k, seed=0):
+    rng = np.random.default_rng(seed)
+    out = [pow(g.g, int.from_bytes(rng.bytes(32), "big") % g.q, g.p)
+           for _ in range(k - 4)]
+    R = 1 << 4096
+    return out + [0, 1, g.p - 1, (R - 1) % g.p]
+
+
+def _limbs(xs):
+    return jnp.asarray(bn.ints_to_limbs(xs, nt.NL))
+
+
+# ---------------------------------------------------------------------------
+# kernel-level differentials (production group, interpret mode)
+# ---------------------------------------------------------------------------
+
+def test_montmul_montsqr_bit_identical(pgroup, pctx):
+    g = pgroup
+    A = _limbs(_rand_elems(g, 6, seed=1))
+    B = _limbs(_rand_elems(g, 6, seed=2))
+    assert pctx.interpret  # CPU backend -> interpret-mode launches
+    assert bool(jnp.all(pe.montmul(pctx, A, B)
+                        == bn.montmul(pctx.mctx, A, B)))
+    assert bool(jnp.all(pe.montsqr(pctx, A)
+                        == bn.montmul(pctx.mctx, A, A)))
+
+
+def test_montmul_matches_ntt_engine(pgroup, pctx):
+    nctx = nt.make_ntt_ctx(pgroup.p)
+    A = _limbs(_rand_elems(pgroup, 6, seed=3))
+    B = _limbs(_rand_elems(pgroup, 6, seed=4))
+    assert bool(jnp.all(pe.montmul(pctx, A, B)
+                        == nt.montmul(nctx, A, B)))
+
+
+def test_montmul_shared_matches_montmul(pgroup, pctx):
+    A = _limbs(_rand_elems(pgroup, 4, seed=5))
+    B = _limbs(_rand_elems(pgroup, 4, seed=6))
+    C = _limbs(_rand_elems(pgroup, 4, seed=7))
+    sel = jnp.stack([A, B, C], axis=1)              # (4, 3, NL)
+    out = pe.montmul_shared(pctx, sel, B)
+    for j in range(3):
+        assert bool(jnp.all(out[:, j] == pe.montmul(pctx, sel[:, j], B)))
+
+
+def test_nttfwd_and_hat_paths(pgroup, pctx):
+    nctx = nt.make_ntt_ctx(pgroup.p)
+    A = _limbs(_rand_elems(pgroup, 6, seed=8))
+    B = _limbs(_rand_elems(pgroup, 6, seed=9))
+    bh = pe.nttfwd(pctx, B)
+    # forward evaluations are bit-identical to the unfused engine, so
+    # hat tables are interchangeable between the ntt and pallas backends
+    assert bool(jnp.all(bh == nt.nttfwd(nctx, B)))
+    assert bool(jnp.all(pe.montmul_hat(pctx, A, bh)
+                        == bn.montmul(pctx.mctx, A, B)))
+
+
+def test_mont_pow_reduced_bits(pgroup, pctx):
+    g = pgroup
+    B = _limbs(_rand_elems(g, 6, seed=10))
+    rng = np.random.default_rng(11)
+    exps = [int(e) for e in rng.integers(0, 1 << 32, size=6)]
+    E = jnp.asarray(bn.ints_to_limbs(exps, 2))
+    got = pe.powmod(pctx, B, E, 32)
+    want = bn.powmod(pctx.mctx, B, E, 32)
+    assert bool(jnp.all(got == want))
+
+
+def test_grid_blocking_and_odd_batches(pgroup):
+    # a fresh ctx (not the lru-shared one) so mutating block is safe:
+    # 17 rows with 8-row blocks = a 3-step grid with a padded tail
+    ctx = pe.PallasCtx(pgroup.p)
+    ctx.block = 8
+    A = _limbs(_rand_elems(pgroup, 17, seed=12))
+    B = _limbs(_rand_elems(pgroup, 17, seed=13))
+    assert bool(jnp.all(pe.montmul(ctx, A, B)
+                        == bn.montmul(ctx.mctx, A, B)))
+    # odd batch below one block pads to the pow2 bucket
+    assert bool(jnp.all(pe.montmul(ctx, A[:5], B[:5])
+                        == bn.montmul(ctx.mctx, A[:5], B[:5])))
+
+
+# ---------------------------------------------------------------------------
+# backend selection / fallback chain
+# ---------------------------------------------------------------------------
+
+def test_default_backend_accepts_pallas(monkeypatch):
+    monkeypatch.setenv("EGTPU_BIGNUM", "pallas")
+    assert _default_backend() == "pallas"
+    monkeypatch.setenv("EGTPU_BIGNUM", "bogus")
+    with pytest.raises(ValueError, match="pallas"):
+        _default_backend()
+
+
+def test_fallback_tiny_group_to_cios(tgroup):
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        ops = JaxGroupOps(tgroup, backend="pallas")
+    assert ops.backend == "cios"
+    assert any("falling back to cios" in str(x.message) for x in w)
+    # and the degraded backend still computes correctly
+    assert ops.mulmod_ints([3, 5], [7, 11]) \
+        == [21 % tgroup.p, 55 % tgroup.p]
+
+
+def test_fallback_no_tpu_no_interpret_to_ntt(pgroup, monkeypatch):
+    monkeypatch.delenv("EGTPU_PALLAS_INTERPRET", raising=False)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        ops = JaxGroupOps(pgroup, backend="pallas")
+    assert ops.backend == "ntt"
+    assert any("EGTPU_PALLAS_INTERPRET" in str(x.message) for x in w)
+
+
+def test_unknown_backend_raises(tgroup):
+    with pytest.raises(ValueError, match="unknown bignum backend"):
+        JaxGroupOps(tgroup, backend="cuda")
+
+
+# ---------------------------------------------------------------------------
+# JaxGroupOps-level: zero call-site changes, tables, compile-once
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def pops(pgroup):
+    """Production-group ops on the pallas backend (interpret mode)."""
+    import os
+    old = os.environ.get("EGTPU_PALLAS_INTERPRET")
+    os.environ["EGTPU_PALLAS_INTERPRET"] = "1"
+    try:
+        yield JaxGroupOps(pgroup, backend="pallas")
+    finally:
+        if old is None:
+            os.environ.pop("EGTPU_PALLAS_INTERPRET", None)
+        else:
+            os.environ["EGTPU_PALLAS_INTERPRET"] = old
+
+
+def test_ops_pallas_backend_selected(pops):
+    assert pops.backend == "pallas"
+    assert pops._ms is not None and pops._mm_shared is not None
+    assert pops._mm_hat is not None and pops._nttfwd is not None
+
+
+def test_ops_mulmod_ints(pgroup, pops):
+    xs = _rand_elems(pgroup, 5, seed=20)
+    ys = _rand_elems(pgroup, 5, seed=21)
+    assert pops.mulmod_ints(xs, ys) \
+        == [x * y % pgroup.p for x, y in zip(xs, ys)]
+
+
+def test_ops_hat_tables_built_by_pallas_nttfwd(pgroup, pops):
+    # the PowRadix hat table is built through pallas nttfwd with zero
+    # call-site changes, and matches the independent ntt-engine
+    # transform row-for-row (cross-engine, not circular).  The full
+    # jitted g_pow ladder is exercised on-chip by bench_bignum --ops
+    # fixed; compiling its 32 inlined interpret kernels here costs
+    # minutes of XLA time for no extra coverage.
+    hat = pops.fixed_table_hat(pgroup.g)
+    assert hat is not None
+    assert hat.shape == (pops.nwin8, 256, 2, nt.NC)
+    nctx = nt.make_ntt_ctx(pgroup.p)
+    rows = pops.g_table.reshape(-1, nt.NL)[1:9]
+    assert bool(jnp.all(hat.reshape(-1, 2, nt.NC)[1:9]
+                        == nt.nttfwd(nctx, rows)))
+    # one hat-row ladder step == the plain montmul against that row
+    a = _limbs(_rand_elems(pgroup, 8, seed=24))
+    assert bool(jnp.all(pops._mm_hat(a, hat.reshape(-1, 2, nt.NC)[1:9])
+                        == bn.montmul(pops.ctx, a, rows)))
+
+
+def test_ops_multi_pow_shared_reduced_bits(pgroup, pops):
+    B = _limbs(_rand_elems(pgroup, 4, seed=22))
+    rng = np.random.default_rng(23)
+    exps = rng.integers(0, 1 << 16, size=(4, 3))
+    E = jnp.asarray(np.stack(
+        [bn.ints_to_limbs([int(e) for e in row], 1) for row in exps]))
+    out = bn.multi_powmod_shared(pops.ctx, B, E, 16,
+                                 montmul_fn=pops._mm,
+                                 montsqr_fn=pops._ms,
+                                 montmul_shared_fn=pops._mm_shared)
+    ints = bn.limbs_to_ints(np.asarray(out).reshape(-1, nt.NL))
+    bi = _rand_elems(pgroup, 4, seed=22)
+    want = [pow(bi[i], int(exps[i, j]), pgroup.p)
+            for i in range(4) for j in range(3)]
+    assert ints == want
+
+
+def test_second_dispatch_compiles_nothing(pgroup, pops):
+    from electionguard_tpu.obs import jaxmon
+    jaxmon.install()
+    a = _limbs(_rand_elems(pgroup, 4, seed=30))
+    b = _limbs(_rand_elems(pgroup, 4, seed=31))
+    np.asarray(pops.mulmod(a, b))            # warm the (op, bucket) pair
+    before = jaxmon.compile_count()
+    np.asarray(pops.mulmod(b, a))            # same bucket, new data
+    assert jaxmon.compile_count() == before

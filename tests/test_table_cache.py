@@ -1,0 +1,115 @@
+"""Persistent setup-table cache (core/table_cache): fingerprint keying,
+atomic/torn-write safety, and the NttCtx / PowRadix integration."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from electionguard_tpu.core import ntt_mxu
+from electionguard_tpu.core import table_cache as tc
+from electionguard_tpu.core.group_jax import JaxGroupOps
+
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    d = tmp_path / "tables"
+    monkeypatch.setenv("EGTPU_TABLE_CACHE", str(d))
+    tc.reset_stats()
+    yield str(d)
+    tc.reset_stats()
+
+
+def test_disabled_by_default(monkeypatch):
+    monkeypatch.setenv("EGTPU_TABLE_CACHE", "")
+    assert tc.cache_dir() is None
+    assert tc.load("kind", "00" * 32) is None
+    tc.store("kind", "00" * 32, {"a": np.arange(3)})  # no-op, no error
+
+
+def test_fingerprint_covers_every_field():
+    base = tc.fingerprint("k", p="a", n=4)
+    assert base == tc.fingerprint("k", n=4, p="a")      # order-free
+    assert base != tc.fingerprint("k", p="a", n=5)
+    assert base != tc.fingerprint("other", p="a", n=4)
+
+
+def test_int_digest_large_ints():
+    a, b = (1 << 4095) + 7, (1 << 4095) + 9
+    assert tc.int_digest(a) != tc.int_digest(b)
+    assert tc.int_digest(a) == tc.int_digest(a)
+    assert tc.int_digest(0)  # zero-safe
+
+
+def test_store_load_round_trip(cache_dir):
+    arrays = {"x": np.arange(10, dtype=np.int32),
+              "y": np.ones((2, 3), dtype=np.uint32)}
+    fp = tc.fingerprint("demo", n=1)
+    tc.store("demo", fp, arrays)
+    assert tc.stats()["writes"] == 1
+    got = tc.load("demo", fp)
+    assert got is not None and tc.stats()["hits"] == 1
+    assert sorted(got) == ["x", "y"]
+    assert np.array_equal(got["x"], arrays["x"])
+    assert np.array_equal(got["y"], arrays["y"])
+    assert got["y"].dtype == np.uint32
+    # no temp files left behind (mkstemp names start with a dot)
+    assert not glob.glob(os.path.join(cache_dir, ".*.tmp"))
+
+
+def test_miss_on_absent_and_foreign_fingerprint(cache_dir):
+    fp1 = tc.fingerprint("demo", n=1)
+    fp2 = tc.fingerprint("demo", n=2)
+    assert tc.load("demo", fp1) is None          # absent
+    tc.store("demo", fp1, {"x": np.arange(3)})
+    assert tc.load("demo", fp2) is None          # different key
+    # same path prefix but embedded fingerprint mismatch -> miss
+    src = glob.glob(os.path.join(cache_dir, "demo-*.npz"))[0]
+    dst = os.path.join(cache_dir, f"demo-{fp2[:32]}.npz")
+    os.replace(src, dst)
+    assert tc.load("demo", fp2) is None
+
+
+def test_torn_write_degrades_to_rebuild(cache_dir):
+    fp = tc.fingerprint("demo", n=1)
+    tc.store("demo", fp, {"x": np.arange(3)})
+    path = glob.glob(os.path.join(cache_dir, "demo-*.npz"))[0]
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:len(blob) // 2])   # truncate mid-file
+    tc.reset_stats()
+    assert tc.load("demo", fp) is None
+    s = tc.stats()
+    assert s["errors"] == 1 and s["misses"] == 1 and s["hits"] == 0
+
+
+def test_make_ntt_ctx_cache_round_trip(cache_dir, pgroup):
+    p = pgroup.p
+    ntt_mxu.make_ntt_ctx.cache_clear()
+    cold = ntt_mxu.make_ntt_ctx(p)
+    assert tc.stats()["writes"] == 1 and tc.stats()["hits"] == 0
+    ntt_mxu.make_ntt_ctx.cache_clear()
+    warm = ntt_mxu.make_ntt_ctx(p)
+    assert tc.stats()["hits"] == 1
+    # full NttCtx equality: arrays bit-for-bit, statics exactly
+    assert cold.m == warm.m and cold.mprime == warm.mprime
+    assert cold.mu26 == warm.mu26 and cold.mu27 == warm.mu27
+    assert cold.biasc == warm.biasc and cold.inv12s == warm.inv12s
+    for f in ("V0", "V1", "iV0", "iV1", "evoff0", "evoff1", "ivoff0",
+              "ivoff1", "toep_m", "f_m", "toep_p", "f_p", "p_pad"):
+        a, b = getattr(cold, f), getattr(warm, f)
+        assert a.dtype == b.dtype and bool(jnp.all(a == b)), f
+    ntt_mxu.make_ntt_ctx.cache_clear()
+
+
+def test_powradix_tables_cache_round_trip(cache_dir, tgroup):
+    ops_cold = JaxGroupOps(tgroup)           # writes powradix entries
+    writes = tc.stats()["writes"]
+    assert writes >= 1
+    ops_warm = JaxGroupOps(tgroup)
+    assert tc.stats()["hits"] >= 1
+    assert tc.stats()["writes"] == writes    # nothing rebuilt
+    assert bool(jnp.all(ops_cold.g_table == ops_warm.g_table))
+    assert ops_cold.g_pow_ints([7]) == ops_warm.g_pow_ints([7])

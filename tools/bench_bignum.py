@@ -4,6 +4,16 @@ Times the primitive batch kernels at production shapes so kernel work can be
 iterated on without a full bench.py run.  Usage:
 
     python tools/bench_bignum.py [--batch 512] [--ops powmod,fixed,mulmod]
+    python tools/bench_bignum.py --backend all --json BENCH_BIGNUM.json
+
+Without ``--backend`` the session-default backend is timed through the full
+legacy op set (mulmod/powmod/fixed/fixedmulti/residue/fused).  With
+``--backend cios|ntt|pallas|all`` the shared ``core.bignum_bench`` helper
+times mulmod/powmod/fixed per requested backend and emits labeled rows
+(requested vs effective backend, batch, exp_bits, platform); ``--json``
+writes them as the tracked roofline artifact.  Off-TPU, pallas rows run the
+kernels in interpret mode (slow): batch/reps/exp-bits default down so a
+``--backend pallas`` run finishes in about a minute instead of hours.
 """
 
 from __future__ import annotations
@@ -30,15 +40,85 @@ def _timeit(fn, *args, reps=3):
     return (time.perf_counter() - t0) / reps
 
 
+def _backend_mode(args) -> int:
+    """--backend path: labeled per-backend rows via core.bignum_bench."""
+    import json
+
+    import jax
+
+    from electionguard_tpu.core import bignum_bench
+    from electionguard_tpu.core.group import production_group
+
+    backends = (("cios", "ntt", "pallas") if args.backend == "all"
+                else (args.backend,))
+    on_tpu = jax.default_backend() == "tpu"
+    if "pallas" in backends and not on_tpu:
+        # measure the real kernels (emulated) instead of the ntt
+        # fallback; interpret launches are ~2.5 s each, so shrink the
+        # run unless the caller sized it explicitly
+        os.environ.setdefault("EGTPU_PALLAS_INTERPRET", "1")
+        if args.batch is None:
+            args.batch = 8
+        if args.reps is None:
+            args.reps = 1
+        if args.exp_bits is None:
+            args.exp_bits = 32
+        print("off-TPU pallas: interpret mode, defaults reduced to "
+              f"batch={args.batch} reps={args.reps} "
+              f"exp_bits={args.exp_bits}")
+    batch = args.batch if args.batch is not None else 512
+    reps = args.reps if args.reps is not None else 3
+    ops = tuple(o for o in args.ops.split(",")
+                if o in bignum_bench.DEFAULT_OPS)
+    rows = []
+    for backend in backends:
+        bops = ops
+        if backend == "pallas" and not on_tpu and "fixed" in bops:
+            # the fixed-base hat-table build alone is ~8k emulated
+            # kernel launches; keep interpret runs tractable
+            print("off-TPU pallas: skipping fixed (hat-table build is "
+                  "hours in interpret mode)")
+            bops = tuple(o for o in bops if o != "fixed")
+        got = bignum_bench.backend_rows(
+            production_group(), backend, batch=batch, ops=bops,
+            exp_bits=args.exp_bits, reps=reps)
+        rows.extend(got)
+        for r in got:
+            eff = ("" if r["effective"] == r["backend"]
+                   else f" (degraded to {r['effective']})")
+            bits = f" exp_bits={r['exp_bits']}" if r["exp_bits"] else ""
+            print(f"{r['backend']:>6}:{r['op']:<7} "
+                  f"{r['sec_per_call'] * 1e3:10.2f} ms  "
+                  f"{r['per_s']:12.1f} el/s{bits}{eff}")
+    if args.json:
+        blob = {"platform": jax.devices()[0].platform, "rows": rows}
+        with open(args.json, "w") as f:
+            json.dump(blob, f, indent=1)
+        print(f"wrote {len(rows)} rows -> {args.json}")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--ops", default="mulmod,powmod,fixed,fixedmulti,residue")
+    ap.add_argument("--backend", default=None,
+                    choices=["cios", "ntt", "pallas", "all"],
+                    help="time these backends via core.bignum_bench "
+                         "(labeled rows) instead of the session default")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write the per-backend rows as JSON "
+                         "(requires --backend)")
+    ap.add_argument("--exp-bits", dest="exp_bits", type=int, default=None,
+                    help="reduced powmod ladder width (--backend mode)")
+    ap.add_argument("--reps", type=int, default=None)
     args = ap.parse_args()
-    B = args.batch
-    which = set(args.ops.split(","))
     from electionguard_tpu.utils import enable_compile_cache
     enable_compile_cache()
+    if args.backend:
+        return _backend_mode(args)
+    B = args.batch if args.batch is not None else 512
+    which = set(args.ops.split(","))
 
     from electionguard_tpu.core import bignum_jax as bn
     from electionguard_tpu.core.group import production_group
