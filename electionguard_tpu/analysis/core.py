@@ -150,7 +150,7 @@ def load_default_passes() -> None:
     from electionguard_tpu.analysis import (env_knobs,  # noqa: F401
                                             jit_hygiene, lock_discipline,
                                             no_bare_print, rpc_contract,
-                                            secret_taint)
+                                            secret_taint, wall_clock)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,87 @@
+"""wall-clock-discipline: library code reads time through ``utils/clock``.
+
+A direct ``time.time()`` / ``time.monotonic()`` / ``time.sleep()`` in
+library code bypasses the clock seam, which makes that code path
+invisible to the deterministic simulator (``electionguard_tpu/sim``):
+under sim it would read the REAL clock and sleep REAL seconds, breaking
+both determinism and the no-real-sleeps speed contract.  Route through
+``utils.clock`` (``clock.now() / clock.monotonic() / clock.sleep()`` and
+the waiting helpers) instead.
+
+Exempt: ``utils/clock.py`` itself (the seam's one legitimate home),
+``cli/`` entry points (process lifetime is outside any simulation), and
+bench harnesses (``*bench*.py`` — they measure the real wall clock by
+definition).  The ns/perf-counter variants are flagged too: a library
+timestamp is a library timestamp regardless of unit.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from electionguard_tpu.analysis import core
+
+#: subpackages that legitimately touch the real clock
+EXEMPT_DIRS = ("cli",)
+
+#: the seam itself — the only library file allowed direct access
+SEAM_SUFFIX = "utils/clock.py"
+
+#: ``time`` module members that read or consume wall time
+BANNED = frozenset({"time", "monotonic", "sleep", "time_ns",
+                    "monotonic_ns", "perf_counter", "perf_counter_ns"})
+
+RULE = "wall-clock-discipline"
+
+
+def _time_aliases(tree: ast.Module) -> tuple[set[str], dict[str, str]]:
+    """(module aliases of ``time``, local name -> banned member) from
+    the file's imports."""
+    mod_aliases: set[str] = set()
+    from_names: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    mod_aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in BANNED:
+                    from_names[a.asname or a.name] = a.name
+    return mod_aliases, from_names
+
+
+@core.register(RULE, doc="direct time.time/monotonic/sleep in library "
+                         "code (route through the utils/clock seam so "
+                         "the deterministic simulator controls it)")
+def run(project: core.Project) -> Iterator[core.Finding]:
+    for f in project.files():
+        parts = project.package_rel_parts(f)
+        if parts and parts[0] in EXEMPT_DIRS:
+            continue
+        if f.rel.endswith(SEAM_SUFFIX):
+            continue
+        if "bench" in parts[-1]:
+            continue
+        mod_aliases, from_names = _time_aliases(f.tree)
+        if not mod_aliases and not from_names:
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            member = None
+            if (isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in mod_aliases
+                    and node.func.attr in BANNED):
+                member = node.func.attr
+            elif (isinstance(node.func, ast.Name)
+                    and node.func.id in from_names):
+                member = from_names[node.func.id]
+            if member is not None:
+                yield core.Finding(
+                    RULE, f.rel, node.lineno,
+                    f"direct time.{member}() in library code: use the "
+                    f"utils/clock seam so the deterministic simulator "
+                    f"can virtualize it")

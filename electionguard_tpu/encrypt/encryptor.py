@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
-import time
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -44,6 +43,7 @@ from electionguard_tpu.crypto.chaum_pedersen import (
     ConstantChaumPedersenProof, DisjunctiveChaumPedersenProof)
 from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
 from electionguard_tpu.publish.election_record import ElectionInitialized
+from electionguard_tpu.utils import clock
 
 
 @dataclass
@@ -431,7 +431,7 @@ class BatchEncryptor:
 
         out: list[EncryptedBallot] = []
         prev_code = code_seed
-        timestamp = int(time.time()) if timestamp is None else int(timestamp)
+        timestamp = int(clock.now()) if timestamp is None else int(timestamp)
         # the ballot crypto hash is chain-independent, so the whole batch
         # hashes in a few device dispatches; only the (cheap) code chain
         # itself is sequential

@@ -28,6 +28,35 @@ from electionguard_tpu.keyceremony.trustee import commitment_product
 from electionguard_tpu.publish.election_record import (ElectionConfig,
                                                        ElectionInitialized,
                                                        GuardianRecord)
+from electionguard_tpu.utils import clock
+
+# A transport-dead step is re-attempted at the PROTOCOL level before the
+# ceremony is abandoned: one rpc's bounded retries span well under a
+# second of backoff, while a crashed-and-restarting guardian is gone for
+# seconds — compound faults (found by the deterministic simulator, seeds
+# 77/347) exhaust the rpc budget and used to abort the whole ceremony.
+# Safe because every exchange step is idempotent: sends are pure
+# recomputes, receives overwrite by sender id behind a WAL checkpoint,
+# and a challenge replays the same audited reveal.
+TRANSPORT_RETRY_ROUNDS = 3
+TRANSPORT_RETRY_PAUSE_S = 2.0
+
+
+def _transport_dead(outcome) -> bool:
+    return (isinstance(outcome, Result) and not outcome.ok
+            and outcome.transport)
+
+
+def _step(fn):
+    """Run one exchange step, re-attempting transport deaths after a
+    pause long enough for a peer to restart."""
+    outcome = fn()
+    for _ in range(TRANSPORT_RETRY_ROUNDS - 1):
+        if not _transport_dead(outcome):
+            break
+        clock.sleep(TRANSPORT_RETRY_PAUSE_S)
+        outcome = fn()
+    return outcome
 
 
 @dataclass
@@ -95,7 +124,7 @@ def _key_ceremony_exchange(
     set_phase("keyceremony-round1")
     all_keys: dict[str, PublicKeys] = {}
     for t in trustees:
-        keys = t.send_public_keys()
+        keys = _step(t.send_public_keys)
         if isinstance(keys, Result):
             return Result.Err(f"{t.id} sendPublicKeys: {keys.error}")
         # identity binding: a (possibly remote) trustee must answer with the
@@ -116,7 +145,7 @@ def _key_ceremony_exchange(
         for other_id, keys in all_keys.items():
             if other_id == t.id:
                 continue
-            res = t.receive_public_keys(keys)
+            res = _step(lambda: t.receive_public_keys(keys))
             if not res.ok:
                 return Result.Err(
                     f"{t.id} rejected keys of {other_id}: {res.error}")
@@ -127,12 +156,12 @@ def _key_ceremony_exchange(
         for receiver in trustees:
             if sender.id == receiver.id:
                 continue
-            share = sender.send_secret_key_share(receiver.id)
+            share = _step(lambda: sender.send_secret_key_share(receiver.id))
             if isinstance(share, Result):
                 return Result.Err(
                     f"{sender.id} sendSecretKeyShare({receiver.id}): "
                     f"{share.error}")
-            res = receiver.receive_secret_key_share(share)
+            res = _step(lambda: receiver.receive_secret_key_share(share))
             if not res.ok and res.transport:
                 # transport death, not a rejection: the receiver never
                 # answered (its bounded retries are exhausted).  Abort —
@@ -146,7 +175,8 @@ def _key_ceremony_exchange(
             if not res.ok:
                 # challenge path: sender must reveal the coordinate; everyone
                 # can check it against the public commitments.
-                challenge = sender.challenge_share(receiver.id)
+                challenge = _step(
+                    lambda: sender.challenge_share(receiver.id))
                 if isinstance(challenge, Result):
                     return Result.Err(
                         f"{sender.id} failed challenge for {receiver.id}: "
@@ -160,7 +190,8 @@ def _key_ceremony_exchange(
                         f"share for {receiver.id} does not match its "
                         f"commitments (original: {res.error})")
                 # coordinate is publicly verified; receiver ingests it
-                accept = receiver.receive_challenged_share(challenge)
+                accept = _step(
+                    lambda: receiver.receive_challenged_share(challenge))
                 if not accept.ok:
                     return Result.Err(
                         f"{receiver.id} rejects {sender.id}'s challenged "

@@ -27,7 +27,6 @@ import json
 import logging
 import os
 import threading
-import time
 from typing import Optional
 
 import grpc
@@ -40,7 +39,7 @@ from electionguard_tpu.obs import REGISTRY, set_phase, span
 from electionguard_tpu.publish import pb, serialize
 from electionguard_tpu.publish.publisher import Consumer, Publisher
 from electionguard_tpu.remote import rpc_util
-from electionguard_tpu.utils import knobs
+from electionguard_tpu.utils import clock, knobs
 
 log = logging.getLogger("mixfed.coordinator")
 
@@ -165,11 +164,11 @@ class MixCoordinator:
 
     def wait_for_servers(self, n: int, timeout: float = 300.0,
                          poll: float = 0.25) -> bool:
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        deadline = clock.monotonic() + timeout
+        while clock.monotonic() < deadline:
             if self.ready() >= n:
                 return True
-            time.sleep(poll)
+            clock.sleep(poll)
         return False
 
     # ---- stage driver ------------------------------------------------

@@ -40,7 +40,7 @@ from electionguard_tpu.mixnet.stage import run_stage
 from electionguard_tpu.obs import REGISTRY, set_phase, span
 from electionguard_tpu.publish import pb, serialize
 from electionguard_tpu.remote import rpc_util
-from electionguard_tpu.utils import knobs
+from electionguard_tpu.utils import clock, knobs
 
 log = logging.getLogger("mixfed.server")
 
@@ -273,7 +273,7 @@ class MixServerServer:
     # ---- process lifecycle -------------------------------------------
 
     def wait_until_finished(self, timeout: Optional[float] = None) -> bool:
-        if not self._done.wait(timeout):
+        if not clock.wait_event(self._done, timeout):
             return False
         self.server.stop(grace=1)
         return bool(self._all_ok)

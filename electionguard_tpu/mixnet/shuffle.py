@@ -55,6 +55,22 @@ def get_shuffler(group: GroupContext, public_key: int) -> "Shuffler":
     return Shuffler(group, public_key)
 
 
+def _fused_reenc(ops):
+    """ONE jitted (k_table, a, b, r) → (A·g^r, B·K^r) program per ops
+    instance, shared by every Shuffler on that group.  The key table is
+    a traced ARGUMENT, not a closure constant — baking K into the
+    program would recompile the fused pipeline for every election key
+    (a multi-second stall per fresh key ceremony)."""
+    jfn = getattr(ops, "_reenc_fused_j", None)
+    if jfn is None:
+        def _impl(kt, a, b, r):
+            gr = ops._fixed_pow_impl(ops.g_table, r)
+            kr = ops._fixed_pow_impl(kt, r)
+            return ops._mulmod_impl(a, gr), ops._mulmod_impl(b, kr)
+        jfn = ops._reenc_fused_j = jax.jit(_impl)
+    return jfn
+
+
 class Shuffler:
     """Re-encryption engine for one (group, public key) pair.
 
@@ -72,14 +88,7 @@ class Shuffler:
         self.eops = jax_exp_ops(group)
         self._sharded = hasattr(self.ops, "mesh")
         self._k_table = self.ops.fixed_table(public_key)
-        self._reenc_j = None if self._sharded else jax.jit(self._reenc_impl)
-
-    def _reenc_impl(self, a, b, r):
-        """One fused program: (A·g^r, B·K^r) for a tile of elements."""
-        ops = self.ops
-        gr = ops._fixed_pow_impl(ops.g_table, r)
-        kr = ops._fixed_pow_impl(self._k_table, r)
-        return ops._mulmod_impl(a, gr), ops._mulmod_impl(b, kr)
+        self._reenc_j = None if self._sharded else _fused_reenc(self.ops)
 
     def reencrypt(self, pads_l: np.ndarray, datas_l: np.ndarray,
                   r_l: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -92,7 +101,9 @@ class Shuffler:
             kr = ops.base_pow(self.public_key, r_l)
             return (np.asarray(ops.mulmod(pads_l, gr)),
                     np.asarray(ops.mulmod(datas_l, kr)))
-        out = run_tiled_multi(self._reenc_j, [pads_l, datas_l, r_l],
+        kt = self._k_table
+        out = run_tiled_multi(lambda a, b, r: self._reenc_j(kt, a, b, r),
+                              [pads_l, datas_l, r_l],
                               [True, True, False])
         return np.asarray(out[0]), np.asarray(out[1])
 

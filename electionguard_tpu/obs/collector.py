@@ -41,12 +41,12 @@ import json
 import logging
 import os
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Optional
 
 from electionguard_tpu.obs import assemble, registry, slog, trace
 from electionguard_tpu.obs import slo as slo_mod
+from electionguard_tpu.utils import clock
 
 log = logging.getLogger("egtpu.obs.collector")
 
@@ -136,7 +136,7 @@ class ObsCollector:
 
     def push_telemetry(self, batch, context=None):
         from electionguard_tpu.publish import pb
-        now = time.monotonic()
+        now = clock.monotonic()
         key = (batch.proc, int(batch.pid))
         hb = batch.heartbeat
         with self._lock:
@@ -243,7 +243,7 @@ class ObsCollector:
 
     def get_fleet_status(self, request=None, context=None):
         from electionguard_tpu.publish import pb
-        now = time.monotonic()
+        now = clock.monotonic()
         with self._lock:
             resp = pb.msg("FleetStatusResponse")(
                 health=self._health,
@@ -282,7 +282,7 @@ class ObsCollector:
         trace.add_export_hook(self._ingest_own_span)
         self._eval_thread = threading.Thread(
             target=self._eval_loop, daemon=True, name="obs-collector-eval")
-        self._eval_thread.start()
+        clock.start_thread(self._eval_thread)
 
     def stop(self) -> None:
         if self._stop.is_set():
@@ -290,18 +290,18 @@ class ObsCollector:
         self._stop.set()
         t = self._eval_thread
         if t is not None and t is not threading.current_thread():
-            t.join(timeout=5.0)
+            clock.join_thread(t, timeout=5.0)
         self._assemble_live()
         trace.remove_export_hook(self._ingest_own_span)
 
     def _eval_loop(self) -> None:
         last_assemble = 0.0
-        while not self._stop.wait(self.tick_s):
+        while not clock.wait_event(self._stop, self.tick_s):
             try:
                 self.evaluate_once()
             except Exception:  # noqa: BLE001 — the loop must survive
                 log.exception("slo evaluation failed")
-            now = time.monotonic()
+            now = clock.monotonic()
             if now - last_assemble >= self.assemble_every_s:
                 last_assemble = now
                 try:
@@ -313,7 +313,7 @@ class ObsCollector:
         """One SLO tick (public for tests and the chaos harness):
         evaluate, emit the ``slo.eval`` span, turn fired alerts into
         ``slo.alert`` spans and fleet-state transitions."""
-        now = time.monotonic() if now is None else now
+        now = clock.monotonic() if now is None else now
         hb_cfg = self.engine.config["heartbeat"]
         window = hb_cfg["interval_s"] * hb_cfg["miss_threshold"]
         with self._lock:
@@ -454,7 +454,7 @@ class TelemetryClient:
         self._stub = rpc_util.Stub(
             rpc_util.make_plain_channel(addr), "ObsCollectorService")
         self._seq = 0
-        self._t0 = time.monotonic()
+        self._t0 = clock.monotonic()
         self._status = "STARTING"
         self._phase = ""
         self._stop = threading.Event()
@@ -489,7 +489,7 @@ class TelemetryClient:
         slog.add_hook(self._on_log)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="obs-telemetry-push")
-        self._thread.start()
+        clock.start_thread(self._thread)
         atexit.register(self.close)
 
     def close(self) -> None:
@@ -503,7 +503,7 @@ class TelemetryClient:
         slog.remove_hook(self._on_log)
         t = self._thread
         if t is not None and t is not threading.current_thread():
-            t.join(timeout=2.0)
+            clock.join_thread(t, timeout=2.0)
         try:
             self._push_once(timeout=3.0)
         except Exception:  # noqa: BLE001 — exit must not fail on telemetry
@@ -512,7 +512,7 @@ class TelemetryClient:
     # ---- pusher thread -----------------------------------------------
 
     def _run(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        while not clock.wait_event(self._stop, self.interval_s):
             try:
                 self._push_once()
             except Exception:  # noqa: BLE001 — telemetry is best-effort
@@ -538,7 +538,7 @@ class TelemetryClient:
             metrics_json=json.dumps(snap),
             heartbeat=pb.msg("ObsHeartbeat")(
                 status=self._status,
-                uptime_s=time.monotonic() - self._t0,
+                uptime_s=clock.monotonic() - self._t0,
                 queue_depth=int(_sum_gauge(snap, "queue_depth")),
                 phase=self._phase,
                 dropped_total=self._dropped.value))

@@ -38,10 +38,11 @@ import json
 import os
 import sys
 import threading
-import time
 from typing import Optional
 
 import grpc
+
+from electionguard_tpu.utils import clock
 
 MD_TRACE_ID = "egtpu-trace-id"
 MD_SPAN_ID = "egtpu-span-id"
@@ -68,7 +69,7 @@ _ctx: contextvars.ContextVar = contextvars.ContextVar(
 
 
 def _now_us() -> int:
-    return time.time_ns() // 1000
+    return int(clock.now() * 1e6)
 
 
 def _new_id(nbytes: int = 8) -> str:

@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Optional, Sequence, Union
 
 import grpc
@@ -37,6 +36,7 @@ from electionguard_tpu.decrypt.trustee import DecryptingTrustee
 from electionguard_tpu.keyceremony.interface import Result
 from electionguard_tpu.publish import pb, serialize
 from electionguard_tpu.remote import rpc_util
+from electionguard_tpu.utils import clock
 
 log = logging.getLogger("egtpu.remote.decrypt")
 
@@ -187,11 +187,11 @@ class DecryptionCoordinator:
 
     def wait_for_registrations(self, timeout: float = 300.0,
                                poll: float = 0.25) -> bool:
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        deadline = clock.monotonic() + timeout
+        while clock.monotonic() < deadline:
             if self.ready() == self.navailable:
                 return True
-            time.sleep(poll)
+            clock.sleep(poll)
         return False
 
     def mark_started(self):
@@ -317,7 +317,7 @@ class DecryptingTrusteeServer:
         return pb.msg("BoolResponse")(ok=True)
 
     def wait_until_finished(self, timeout: Optional[float] = None) -> Optional[bool]:
-        if not self._done.wait(timeout):
+        if not clock.wait_event(self._done, timeout):
             return None
         self.server.stop(grace=1)
         return self._all_ok

@@ -23,7 +23,6 @@ import json
 import logging
 import os
 import threading
-import time
 from typing import Optional, Union
 
 import grpc
@@ -38,6 +37,7 @@ from electionguard_tpu.keyceremony.interface import (KeyCeremonyTrusteeIF,
 from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
 from electionguard_tpu.publish import pb, serialize
 from electionguard_tpu.remote import rpc_util
+from electionguard_tpu.utils import clock
 
 log = logging.getLogger("egtpu.remote.keyceremony")
 
@@ -241,11 +241,11 @@ class KeyCeremonyCoordinator:
 
     def wait_for_registrations(self, timeout: float = 300.0,
                                poll: float = 0.25) -> bool:
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        deadline = clock.monotonic() + timeout
+        while clock.monotonic() < deadline:
             if self.ready() == self.n:
                 return True
-            time.sleep(poll)
+            clock.sleep(poll)
         return False
 
     def run_key_ceremony(self, trustee_out_dir: str) -> Union[KeyCeremonyResults, Result]:
@@ -358,12 +358,34 @@ class KeyCeremonyTrusteeServer:
         # reclaim its registration; a relaunch WITHOUT state does not.
         self._reg_nonce = (bytes.fromhex(resume["nonce"]) if resume
                            else os.urandom(16))
-        reg = RemoteKeyCeremonyProxy(coordinator_url)
-        try:
-            resp = reg.register_trustee(guardian_id, self.url, group,
-                                        nonce=self._reg_nonce)
-        finally:
-            reg.close()
+        # Registration rides out more than one rpc's bounded retries:
+        # dying here wedges the WHOLE ceremony — the coordinator may
+        # already have committed this registration (lost response) and
+        # will dial back into a server whose trustee never materializes
+        # (deterministic-simulation seed 108).  The nonce makes every
+        # re-attempt an idempotent replay, so keep trying on a fresh
+        # channel with a pause that covers a coordinator still starting.
+        resp = None
+        last_err: Optional[Exception] = None
+        for round_no in range(4):
+            if round_no:
+                clock.sleep(1.5 * round_no)
+            reg = RemoteKeyCeremonyProxy(coordinator_url)
+            try:
+                resp = reg.register_trustee(guardian_id, self.url, group,
+                                            nonce=self._reg_nonce)
+                break
+            except grpc.RpcError as e:
+                last_err = e
+                log.warning("trustee %s registration attempt %d died "
+                            "(%s); re-registering", guardian_id,
+                            round_no + 1, e.code())
+            finally:
+                reg.close()
+        if resp is None:
+            self.server.stop(grace=0)
+            raise RuntimeError(
+                f"registration failed after retries: {last_err}")
         err = resp.error or rpc_util.check_group_constants(
             group, resp.constants)
         if err:
@@ -414,7 +436,7 @@ class KeyCeremonyTrusteeServer:
         group that construction (polynomial commitments + Schnorr proofs)
         takes long enough that the coordinator's first sendPublicKeys can
         land in the gap.  Block the rpc briefly instead of racing."""
-        if self._ready.wait(timeout=60.0):
+        if clock.wait_event(self._ready, timeout=60.0):
             return self.trustee
         return None
 
@@ -540,7 +562,7 @@ class KeyCeremonyTrusteeServer:
     def wait_until_finished(self, timeout: Optional[float] = None) -> Optional[bool]:
         """Block until the coordinator calls finish (reference:
         blockUntilShutdown, RunRemoteTrustee.java:141-172)."""
-        if not self._done.wait(timeout):
+        if not clock.wait_event(self._done, timeout):
             return None
         self.server.stop(grace=1)
         return self._all_ok

@@ -15,7 +15,6 @@ import logging
 import os
 import random
 import socket
-import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
@@ -26,13 +25,32 @@ from electionguard_tpu.obs import registry as obs_registry
 from electionguard_tpu.obs import trace as obs_trace
 from electionguard_tpu.publish import pb
 from electionguard_tpu.testing import faults
+from electionguard_tpu.utils import clock
 
 MAX_TRUSTEE_MESSAGE = 51 * 1000 * 1000   # key exchange / batch decrypt plane
 MAX_REGISTRATION_MESSAGE = 2000          # registration plane
 
-# test seams: the chaos/retry tests record sleeps and pin the jitter
-_sleep = time.sleep
+# test seams: the chaos/retry tests record sleeps and pin the jitter;
+# _sleep routes through the clock seam so backoff waits are virtual
+# under the deterministic simulator
+_sleep = clock.sleep
 _uniform = random.uniform
+
+# transport seam: the deterministic simulator (electionguard_tpu/sim)
+# installs an in-memory transport here; None = real gRPC.  Channels and
+# servers made while a transport is installed live entirely in-process.
+_transport = None
+
+
+def set_transport(transport) -> None:
+    """Install (or with None, remove) the in-memory transport every
+    subsequent make_channel/make_server call routes through."""
+    global _transport
+    _transport = transport
+
+
+def transport():
+    return _transport
 
 
 def _env_float(name: str, default: float) -> float:
@@ -163,14 +181,14 @@ def _observe_server(service_name: str, method: str, fn: Callable) -> Callable:
 
     def observed(request, context):
         calls.inc()
-        t0 = time.monotonic()
+        t0 = clock.monotonic()
         try:
             return fn(request, context)
         except BaseException:   # includes context.abort's control flow
             errors.inc()
             raise
         finally:
-            latency.observe((time.monotonic() - t0) * 1e3)
+            latency.observe((clock.monotonic() - t0) * 1e3)
 
     return observed
 
@@ -266,10 +284,10 @@ class Stub:
             timeout = deadline_for(method)
         calls, retries, backoff_s = self._metrics[method]
         calls.inc()
-        deadline = time.monotonic() + timeout
+        deadline = clock.monotonic() + timeout
         attempt = 0
         while True:
-            remaining = deadline - time.monotonic()
+            remaining = deadline - clock.monotonic()
             wfr = attempt > 0
             per_try = max(0.001, min(remaining, pol.connect_window)
                           if wfr else remaining)
@@ -291,7 +309,7 @@ class Stub:
                          "code": code.name if code else "UNKNOWN"}).inc()
                     raise
                 wait = pol.backoff(attempt)
-                if (deadline - time.monotonic() <= wait
+                if (deadline - clock.monotonic() <= wait
                         or self._retry_spent + wait > pol.budget):
                     obs_registry.REGISTRY.counter(
                         "rpc_client_failures_total",
@@ -351,7 +369,13 @@ def make_channel(url: str, max_message: int = MAX_TRUSTEE_MESSAGE,
     channel is wrapped with the plan's client interceptor; when tracing
     is on (EGTPU_OBS_TRACE / obs.trace.enable), the trace interceptor
     wraps OUTSIDE the fault one, so client spans see injected faults as
-    the real rpc outcomes they simulate.  Both are identity when off."""
+    the real rpc outcomes they simulate.  Both are identity when off.
+
+    Under an installed sim transport the channel is in-memory; the sim
+    channel applies the active fault plan's client rules itself
+    (grpc.intercept_channel needs a real grpc.Channel)."""
+    if _transport is not None:
+        return _transport.channel(url, max_message)
     return obs_trace.intercept_channel(
         faults.intercept_channel(grpc.insecure_channel(url, options=[
             ("grpc.max_receive_message_length", max_message),
@@ -366,6 +390,8 @@ def make_plain_channel(url: str, max_message: int = MAX_TRUSTEE_MESSAGE,
     hatch.  Telemetry pushes must observe injected faults, not suffer
     them, and must not trace themselves (each client span export would
     trigger another push — unbounded recursion)."""
+    if _transport is not None:
+        return _transport.channel(url, max_message, plain=True)
     return grpc.insecure_channel(url, options=[
         ("grpc.max_receive_message_length", max_message),
         ("grpc.max_send_message_length", max_message),
@@ -376,6 +402,8 @@ def make_plain_channel(url: str, max_message: int = MAX_TRUSTEE_MESSAGE,
 def make_server(port: int, max_message: int = MAX_TRUSTEE_MESSAGE,
                 max_workers: int = 8) -> tuple[grpc.Server, int]:
     """Server on ``port`` (0 = pick a free one); returns (server, port)."""
+    if _transport is not None:
+        return _transport.server(port, max_message)
     from concurrent import futures
 
     server = grpc.server(
@@ -391,6 +419,8 @@ def make_server(port: int, max_message: int = MAX_TRUSTEE_MESSAGE,
 def find_free_port() -> int:
     """Probe a free TCP port (the reference probes with ServerSocket —
     RunRemoteTrustee.java:126-136)."""
+    if _transport is not None:
+        return _transport.free_port()
     with socket.socket() as s:
         s.bind(("", 0))
         return s.getsockname()[1]

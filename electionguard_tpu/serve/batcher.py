@@ -27,13 +27,13 @@ Inference-server semantics rather than offline-loop semantics:
 from __future__ import annotations
 
 import threading
-import time
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 from electionguard_tpu.ballot.plaintext import PlaintextBallot
+from electionguard_tpu.utils import clock
 
 
 class QueueFullError(Exception):
@@ -52,7 +52,7 @@ class PendingRequest:
     ballot: PlaintextBallot
     spoil: bool = False
     future: Future = field(default_factory=Future)
-    t_enqueue: float = field(default_factory=time.monotonic)
+    t_enqueue: float = field(default_factory=clock.monotonic)
 
 
 def _default_buckets(max_batch: int) -> tuple[int, ...]:
@@ -118,24 +118,24 @@ class DynamicBatcher:
         (the worker's exit signal); an idle ``timeout`` (seconds) returns
         [] so callers can interleave housekeeping.
         """
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else clock.monotonic() + timeout
         with self._cv:
             while True:
                 if self._q:
                     if (len(self._q) >= self.max_batch or self._closed):
                         break
                     due = self._q[0].t_enqueue + self.max_wait
-                    wait = due - time.monotonic()
+                    wait = due - clock.monotonic()
                     if wait <= 0:
                         break
                 else:
                     if self._closed:
                         return None
-                    if deadline is not None and time.monotonic() >= deadline:
+                    if deadline is not None and clock.monotonic() >= deadline:
                         return []
                     wait = None if deadline is None else \
-                        deadline - time.monotonic()
-                self._cv.wait(wait)
+                        deadline - clock.monotonic()
+                clock.cv_wait(self._cv, wait)
             n = min(self.max_batch, len(self._q))
             batch = [self._q.popleft() for _ in range(n)]
             self._cv.notify_all()

@@ -39,6 +39,7 @@ from electionguard_tpu.serve.batcher import (DrainingError, DynamicBatcher,
                                              QueueFullError)
 from electionguard_tpu.serve.metrics import ServiceMetrics
 from electionguard_tpu.serve.worker import EncryptionWorker, InvalidBallotError
+from electionguard_tpu.utils import clock
 
 log = logging.getLogger("serve.service")
 
@@ -106,7 +107,7 @@ class EncryptionService:
             # compile every (program, bucket) pair before the first
             # request: under load the compile counter stays flat
             self.worker.prewarm()
-        self.worker.start()
+        clock.start_thread(self.worker)
         if gap:
             self._status = "RECOVERING"
             self._replay_gap(gap)
@@ -168,7 +169,6 @@ class EncryptionService:
         admission order, BEFORE the server accepts new requests — the
         recovered stream continues the code chain exactly where the
         published record stops."""
-        import time
         log.warning("recovering %d admitted-but-unpublished ballots "
                     "from the journal", len(gap))
         futures = []
@@ -180,10 +180,10 @@ class EncryptionService:
                                                         spoil=e.spoil)))
                     break
                 except QueueFullError:
-                    time.sleep(0.05)
+                    clock.sleep(0.05)
         for bid, fut in futures:
             try:
-                fut.result(timeout=_RESULT_TIMEOUT)
+                clock.wait_future(fut, _RESULT_TIMEOUT)
                 self.recovered_ballots += 1
                 self.metrics.inc("ballots_recovered")
             except InvalidBallotError as e:
@@ -231,7 +231,7 @@ class EncryptionService:
         if future is None:
             return Resp(error=error)
         try:
-            b = future.result(timeout=_RESULT_TIMEOUT)
+            b = clock.wait_future(future, _RESULT_TIMEOUT)
         except InvalidBallotError as e:
             return Resp(error=f"invalid ballot: {e}")
         except Exception as e:  # noqa: BLE001 — in-band, like the planes
@@ -293,7 +293,7 @@ class EncryptionService:
         obs.set_phase("draining")
         log.info("draining: %d requests queued", self.batcher.depth())
         self.batcher.close()
-        self.worker.join(timeout=_RESULT_TIMEOUT)
+        clock.join_thread(self.worker, _RESULT_TIMEOUT)
         if self._stream is not None:
             self._stream.close()
             self._stream = None
@@ -309,7 +309,7 @@ class EncryptionService:
                 self.journal = None
         # request threads blocked in _resolve still hold completed
         # futures; give them `grace` to serialize their responses
-        self.server.stop(grace=grace).wait(grace)
+        clock.wait_event(self.server.stop(grace=grace), grace)
         if self._metrics_httpd is not None:
             self._metrics_httpd.shutdown()
             self._metrics_httpd = None

@@ -37,6 +37,7 @@ from electionguard_tpu.encrypt.encryptor import BatchEncryptor
 from electionguard_tpu.obs import trace
 from electionguard_tpu.serve.batcher import DynamicBatcher, PendingRequest
 from electionguard_tpu.serve.metrics import ServiceMetrics
+from electionguard_tpu.utils import clock
 
 log = logging.getLogger("serve.worker")
 
@@ -111,21 +112,20 @@ class EncryptionWorker(threading.Thread):
             self._encrypt([], bucket)
 
     def run(self) -> None:
-        import time as _time
         while True:
             if self.hold is not None:
-                self.hold.wait()
+                clock.wait_event(self.hold)
             if (self.hold_after is not None
                     and self.metrics.get("ballots_encrypted")
                     >= self.hold_after):
                 log.warning("chaos hold: %d ballots encrypted, worker "
                             "wedged", self.hold_after)
-                threading.Event().wait()   # wedge until SIGKILL
+                clock.wait_event(threading.Event())   # wedge until SIGKILL
             batch = self.batcher.next_batch()
             if batch is None:
                 return
             try:
-                self._process(batch, _time.monotonic)
+                self._process(batch, clock.monotonic)
             except BaseException as e:  # noqa: BLE001 — keep serving
                 # _process already failed the batch's futures; a raise
                 # here would kill the one device owner and wedge every

@@ -1,0 +1,28 @@
+"""Deterministic simulation testing (DST) for the distributed planes.
+
+FoundationDB/TigerBeetle-style: the full multi-node workflow — key
+ceremony, encryption serving, federated mix cascade, compensated
+decryption — runs in ONE process under a cooperative scheduler on a
+virtual clock, with an in-memory transport standing in for gRPC.  One
+RNG seed fully determines the task interleaving, the per-message
+network behavior, and an auto-generated fault schedule; safety and
+liveness oracles check every run, and a failing seed's schedule shrinks
+to a minimal replayable repro.
+
+Entry points:
+
+* :func:`electionguard_tpu.sim.explore.run_sim` — one seed, one report;
+* :func:`electionguard_tpu.sim.explore.explore` — a seed sweep;
+* :func:`electionguard_tpu.sim.shrink.shrink` — minimize a failure;
+* :func:`electionguard_tpu.sim.harness.simulation` — test harness: the
+  clock + transport installed, no imposed workflow;
+* ``tools/sim_matrix.py`` — the CLI sweep runner (SIM_RESULTS.json).
+"""
+
+from electionguard_tpu.sim.explore import SimReport, explore, run_sim
+from electionguard_tpu.sim.harness import simulation
+from electionguard_tpu.sim.schedule import FaultEvent, generate_schedule
+from electionguard_tpu.sim.shrink import shrink
+
+__all__ = ["SimReport", "run_sim", "explore", "FaultEvent",
+           "generate_schedule", "shrink", "simulation"]

@@ -1,0 +1,367 @@
+"""The virtual cluster: the full multi-node workflow as sim tasks.
+
+One simulated run drives the same four distributed phases the real
+deployment runs across processes — with the SAME library classes, only
+the clock and the transport virtualized:
+
+1. **key ceremony** — a coordinator task plus one task per guardian
+   (``KeyCeremonyTrusteeServer`` with a resume file, so a scheduled
+   crash_after restarts the guardian mid-ceremony from its WAL);
+2. **encryption serving** — an ``EncryptionService`` task and voter
+   tasks submitting ballots through ``EncryptionClient`` (a retried
+   admission whose first copy committed is acked via the encryptor's
+   duplicate-id rejection — the ballot IS in the record);
+3. **federated mix** — a ``MixCoordinator`` task and stage servers plus
+   one hot spare, so a scheduled mix-server crash requeues its stage;
+4. **compensated decryption** — a ``DecryptionCoordinator`` and
+   ``navailable < n`` trustee tasks; the rest are compensated.
+
+The driver then assembles the election record and runs the full
+independent Verifier.  ``plant=...`` hooks inject known-bad behavior
+(a lost ballot on retry, a chain break, tampered ciphertexts/tallies, a
+wedge) so the test suite can prove each oracle actually fires.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+
+import grpc
+
+from electionguard_tpu.ballot.manifest import (BallotStyle, Candidate,
+                                               ContestDescription,
+                                               GeopoliticalUnit, Manifest,
+                                               Party, SelectionDescription)
+from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+from electionguard_tpu.core.dlog import DLog
+from electionguard_tpu.core.group import tiny_group
+from electionguard_tpu.decrypt.decryption import Decryption
+from electionguard_tpu.decrypt.trustee import read_trustee
+from electionguard_tpu.keyceremony.interface import Result
+from electionguard_tpu.mixfed.coordinator import MixCoordinator
+from electionguard_tpu.mixfed.server import MixServerServer
+from electionguard_tpu.mixnet.stage import rows_from_ballots
+from electionguard_tpu.publish.election_record import (DecryptionResult,
+                                                       ElectionConfig,
+                                                       ElectionRecord)
+from electionguard_tpu.publish.publisher import Consumer
+from electionguard_tpu.remote.decrypting_remote import (
+    DecryptionCoordinator, DecryptingTrusteeServer)
+from electionguard_tpu.remote.keyceremony_remote import (
+    KeyCeremonyCoordinator, KeyCeremonyTrusteeServer)
+from electionguard_tpu.serve.service import (EncryptionClient,
+                                             EncryptionService)
+from electionguard_tpu.sim import schedule as schedule_mod
+from electionguard_tpu.tally.accumulate import accumulate_ballots
+from electionguard_tpu.utils import clock, knobs
+from electionguard_tpu.verify.verifier import Verifier
+
+KC_PORT = 17111
+SERVE_PORT = 17211
+MIX_PORT = 17141
+DEC_PORT = 17711
+
+
+@dataclass
+class SimConfig:
+    """Virtual-cluster shape; defaults sized so a run takes ~100 ms of
+    real time (tiny group, few ballots) while still exercising every
+    protocol leg including compensation and the hot spare."""
+    n_guardians: int = 3
+    quorum: int = 2
+    navailable: int = 2
+    n_ballots: int = 4
+    n_voters: int = 2
+    n_mix_stages: int = 2
+    n_mix_servers: int = 3      # stages + 1 hot spare
+    horizon: float = field(
+        default_factory=lambda: knobs.get_float("EGTPU_SIM_HORIZON"))
+
+
+@dataclass
+class SimOutcome:
+    """Everything the oracles need from one run."""
+    navailable: int = 2
+    ballots: list = field(default_factory=list)      # submitted plaintext
+    acked: dict = field(default_factory=dict)        # ballot_id -> code|None
+    recorded: list = field(default_factory=list)     # published stream
+    tally_result: object = None
+    decryption_result: object = None
+    verify_result: object = None
+    completed: bool = False
+    liveness_error: str = ""
+    workflow_error: str = ""
+    task_errors: list = field(default_factory=list)
+
+
+class _MemStream:
+    """In-memory stand-in for the ``EncryptedBallotStream`` — the
+    authoritative published-ballot sequence for the oracles (the sim
+    serves with ``out_dir=None``: no journal fsync on the hot path)."""
+
+    def __init__(self):
+        self.ballots = []
+
+    def write(self, ballot) -> None:
+        self.ballots.append(ballot)
+
+    def flush(self) -> None:
+        pass
+
+
+def sim_manifest() -> Manifest:
+    """One contest, two selections — the smallest record the full
+    Verifier accepts (mirrors the test suite's tiny manifest)."""
+    sels = tuple(SelectionDescription(f"sel-{i}", i, f"cand-{i}")
+                 for i in range(2))
+    contest = ContestDescription("contest-0", 0, "gp-0", "one_of_m", 1,
+                                 "The Contest", sels)
+    return Manifest(
+        election_scope_id="sim-election", spec_version="tpu-1.0",
+        start_date="2026-07-01", end_date="2026-07-29",
+        geopolitical_units=(GeopoliticalUnit("gp-0", "District 0"),),
+        parties=(Party("party-0", "Party"),),
+        candidates=tuple(Candidate(f"cand-{i}", f"Candidate {i}")
+                         for i in range(2)),
+        contests=(contest,),
+        ballot_styles=(BallotStyle("style-0", ("gp-0",)),),
+    )
+
+
+def drive(cfg: SimConfig, sched, transport, plan, schedule, seed: int,
+          plant: frozenset, workdir: str, out: SimOutcome) -> None:
+    """The main task: spawn each phase's nodes, sequence via a shared
+    board, assemble + verify the record into ``out``."""
+    group = tiny_group()
+    manifest = sim_manifest()
+    out.navailable = cfg.navailable
+    board: dict = {}
+
+    def wait(pred, timeout: float, what: str) -> None:
+        if not sched.poll_until(pred, timeout):
+            raise RuntimeError(f"timed out waiting for {what} "
+                               f"(t={sched.now:.1f}s)")
+
+    # ---- crash/restart hook ------------------------------------------
+    def on_crash(srv, method: str) -> None:
+        node = srv.node
+        sched.kill_node(node)
+        if node.startswith("guardian-"):
+            downtime = schedule_mod.guardian_downtime(schedule)
+            resume = os.path.join(workdir, f"{node}.resume")
+
+            def restart(node=node, resume=resume, downtime=downtime):
+                clock.sleep(downtime)
+                s = KeyCeremonyTrusteeServer(
+                    group, node, f"localhost:{KC_PORT}",
+                    resume_file=resume)
+                s.wait_until_finished(timeout=150.0)
+
+            sched.spawn(f"{node}-restart", restart, node=node)
+        # a crashed mix server is NOT restarted: the hot spare takes
+        # its stage (coordinator requeue path)
+
+    transport.on_crash = on_crash
+
+    # ---- phase 1: key ceremony ---------------------------------------
+    def kc_task():
+        coord = KeyCeremonyCoordinator(group, cfg.n_guardians, cfg.quorum,
+                                       port=KC_PORT)
+        try:
+            if not coord.wait_for_registrations(timeout=90.0, poll=0.25):
+                raise RuntimeError("key ceremony registrations timed out")
+            results = coord.run_key_ceremony(workdir)
+            if isinstance(results, Result):
+                raise RuntimeError(f"key ceremony failed: {results.error}")
+            board["init"] = results.make_election_initialized(
+                ElectionConfig(manifest, cfg.n_guardians, cfg.quorum),
+                {"created_by": "sim"})
+        finally:
+            coord.shutdown("init" in board)
+
+    sched.spawn("kc", kc_task, node="kc")
+    for i in range(cfg.n_guardians):
+        gid = f"guardian-{i}"
+
+        def g_task(gid=gid):
+            s = KeyCeremonyTrusteeServer(
+                group, gid, f"localhost:{KC_PORT}",
+                resume_file=os.path.join(workdir, f"{gid}.resume"))
+            s.wait_until_finished(timeout=150.0)
+
+        sched.spawn(gid, g_task, node=gid)
+    wait(lambda: "init" in board, 150.0, "key ceremony")
+    init = board["init"]
+
+    # ---- phase 2: encryption serving ---------------------------------
+    ballots = list(RandomBallotProvider(
+        manifest, cfg.n_ballots, seed=seed % 100003 + 11).ballots())
+    out.ballots = ballots
+    stream = _MemStream()
+
+    def serve_task():
+        svc = EncryptionService(
+            init, group, port=SERVE_PORT, out_dir=None, max_batch=4,
+            max_wait_ms=4.0, prewarm=False,
+            seed=group.int_to_q(seed % (group.q - 2) + 1))
+        # the record stream the oracles audit (no out_dir => no file)
+        svc.worker.stream = stream
+        board["serve_up"] = True
+        wait(lambda: len(board.get("voters_done", ())) == cfg.n_voters,
+             150.0, "voters")
+        svc.drain(grace=0.25)
+        board["served"] = True
+
+    sched.spawn("serve", serve_task, node="serve")
+
+    def voter_task(vi: int, mine) -> None:
+        wait(lambda: board.get("serve_up"), 60.0, "serving plane")
+        client = EncryptionClient(f"localhost:{SERVE_PORT}", group)
+        try:
+            for b in mine:
+                for attempt in range(4):
+                    try:
+                        eb = client.encrypt(b, timeout=30.0)
+                        out.acked[b.ballot_id] = eb.code
+                        break
+                    except ValueError as e:
+                        if "duplicate" in str(e):
+                            # the retried copy of an admission whose
+                            # response was dropped: the first copy is
+                            # committed and recorded — that IS the ack
+                            out.acked[b.ballot_id] = None
+                            break
+                        raise
+                    except grpc.RpcError:
+                        if attempt == 3:
+                            raise
+                        clock.sleep(0.5 * (attempt + 1))
+        finally:
+            client.close()
+            board.setdefault("voters_done", set()).add(vi)
+
+    for vi in range(cfg.n_voters):
+        mine = ballots[vi::cfg.n_voters]
+        sched.spawn(f"voter-{vi}", lambda vi=vi, mine=mine:
+                    voter_task(vi, mine), node=f"voter-{vi}")
+    wait(lambda: board.get("served"), 200.0, "serving drained")
+
+    recorded = stream.ballots
+    if "lost-ballot" in plant and recorded and any(
+            m == "encryptBallot" and k == "drop_response"
+            for (_w, m, _n, k) in plan.injected):
+        # planted bug: the retry-dedup path "eats" the committed record
+        # entry — the classic exactly-once violation the oracle exists
+        # to catch
+        lost = recorded.pop()
+        sched.event("plant", f"lost-ballot {lost.ballot_id}")
+    if "chain-break" in plant and len(recorded) >= 2:
+        recorded[0], recorded[1] = recorded[1], recorded[0]
+        sched.event("plant", "chain-break")
+    if "tamper-ballot" in plant and recorded:
+        b = recorded[0]
+        c = b.contests[0]
+        s0, s1 = c.selections[0], c.selections[1]
+        tampered = (dataclasses.replace(s0, ciphertext=s1.ciphertext),
+                    dataclasses.replace(s1, ciphertext=s0.ciphertext),
+                    *c.selections[2:])
+        recorded[0] = dataclasses.replace(
+            b, contests=(dataclasses.replace(c, selections=tampered),))
+        sched.event("plant", "tamper-ballot")
+    out.recorded = list(recorded)
+
+    # ---- phase 3: tally + federated mix ------------------------------
+    tally_result = accumulate_ballots(init, out.recorded)
+    out.tally_result = tally_result
+    pads, datas = rows_from_ballots(out.recorded)
+    mix_dir = os.path.join(workdir, "mix")
+    os.makedirs(mix_dir, exist_ok=True)
+
+    def mix_task():
+        coord = MixCoordinator(group, mix_dir, port=MIX_PORT)
+        try:
+            if not coord.wait_for_servers(cfg.n_mix_servers, timeout=90.0):
+                raise RuntimeError("mix server registrations timed out")
+            coord.run_mix(init.joint_public_key.value,
+                          init.extended_base_hash, cfg.n_mix_stages,
+                          pads, datas)
+            board["mixed"] = True
+        finally:
+            coord.shutdown(board.get("mixed", False))
+
+    sched.spawn("mix", mix_task, node="mix")
+    for i in range(cfg.n_mix_servers):
+        def m_task(i=i):
+            s = MixServerServer(group, f"localhost:{MIX_PORT}",
+                                f"mix-{i}", shards=0)
+            s.wait_until_finished(timeout=200.0)
+
+        sched.spawn(f"mix-{i}", m_task, node=f"mix-{i}")
+    wait(lambda: board.get("mixed"), 250.0, "mix cascade")
+
+    # ---- phase 4: compensated decryption -----------------------------
+    guardian_ids = [g.guardian_id for g in init.guardians]
+    available = guardian_ids[:cfg.navailable]   # the rest are compensated
+    dlog = DLog(group, max_exponent=max(16, cfg.n_ballots + 2))
+
+    def dec_task():
+        coord = DecryptionCoordinator(group, cfg.navailable, port=DEC_PORT)
+        ok = False
+        try:
+            if not coord.wait_for_registrations(timeout=90.0):
+                raise RuntimeError("decryption registrations timed out")
+            coord.mark_started()
+            registered = {p.id for p in coord.proxies}
+            missing = [g for g in guardian_ids if g not in registered]
+            decryption = Decryption(group, init, coord.proxies, missing,
+                                    dlog)
+            decrypted = decryption.decrypt(tally_result.encrypted_tally)
+            out.decryption_result = DecryptionResult(
+                tally_result, decrypted,
+                tuple(decryption.get_available_guardians()))
+            ok = True
+            board["decrypted"] = True
+        finally:
+            coord.shutdown(ok)
+
+    sched.spawn("decrypt", dec_task, node="decrypt")
+    for idx, gid in enumerate(available):
+        def d_task(idx=idx, gid=gid):
+            trustee = read_trustee(
+                group, os.path.join(workdir, f"trustee-{gid}.json"))
+            s = DecryptingTrusteeServer(group, trustee,
+                                        f"localhost:{DEC_PORT}")
+            s.wait_until_finished(timeout=200.0)
+
+        sched.spawn(f"dec-{idx}", d_task, node=f"dec-{idx}")
+    wait(lambda: board.get("decrypted"), 250.0, "threshold decryption")
+
+    if "tamper-tally" in plant and out.decryption_result is not None:
+        dt = out.decryption_result.decrypted_tally
+        c0 = dt.contests[0]
+        s0 = c0.selections[0]
+        new_c0 = dataclasses.replace(
+            c0, selections=(dataclasses.replace(s0, tally=s0.tally + 1),
+                            *c0.selections[1:]))
+        out.decryption_result = dataclasses.replace(
+            out.decryption_result,
+            decrypted_tally=dataclasses.replace(
+                dt, contests=(new_c0, *dt.contests[1:])))
+        sched.event("plant", "tamper-tally")
+
+    if "wedge" in plant:
+        clock.sleep(cfg.horizon * 2)   # livelock: the horizon must trip
+
+    # ---- phase 5: record assembly + independent verification ---------
+    record = ElectionRecord(
+        election_init=init,
+        encrypted_ballots=list(out.recorded),
+        tally_result=tally_result,
+        decryption_result=out.decryption_result,
+        mix_stages=Consumer(mix_dir, group).read_mix_stages())
+    out.verify_result = Verifier(
+        record, group, mix_input_fn=lambda: (pads, datas)).verify()
+    out.completed = True
+    sched.event("workflow-complete", f"{len(out.recorded)} ballots")

@@ -1,0 +1,61 @@
+"""Light-weight simulation harness for tests.
+
+``simulation()`` installs the virtual clock and the in-memory transport
+for the duration of a ``with`` block, so a test can run ANY library code
+that speaks gRPC / sleeps / polls — coordinators, proxies, retry loops,
+fault injection — in virtual time with zero real sleeping:
+
+    with simulation(seed=3) as sim:
+        def body():
+            coord = KeyCeremonyCoordinator(group, 1, 1, port=0)
+            ...
+        sim.run(body)
+
+Unlike :func:`electionguard_tpu.sim.explore.run_sim` (the full-workflow
+explorer), the harness imposes no workflow, no fault schedule, and no
+oracles — the test IS the driver.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from electionguard_tpu.remote import rpc_util
+from electionguard_tpu.sim.scheduler import SimClock, SimScheduler
+from electionguard_tpu.sim.transport import NetModel, SimTransport
+from electionguard_tpu.utils import clock as clock_mod
+
+
+class Simulation:
+    """One installed virtual world; create via :func:`simulation`."""
+
+    def __init__(self, seed: int, horizon: float,
+                 net: Optional[NetModel] = None):
+        self.sched = SimScheduler(seed=seed, horizon=horizon)
+        self.net = net if net is not None else NetModel(
+            rng=random.Random(seed + 1))
+        self.transport = SimTransport(self.sched, self.net)
+
+    @property
+    def now(self) -> float:
+        return self.sched.now
+
+    def run(self, fn) -> None:
+        """Drive ``fn`` as the main task until it returns (its
+        exceptions propagate)."""
+        self.sched.run(fn)
+
+    def __enter__(self) -> "Simulation":
+        clock_mod.install(SimClock(self.sched))
+        rpc_util.set_transport(self.transport)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        rpc_util.set_transport(None)
+        clock_mod.uninstall()
+
+
+def simulation(seed: int = 0, horizon: float = 600.0,
+               net: Optional[NetModel] = None) -> Simulation:
+    return Simulation(seed, horizon, net)

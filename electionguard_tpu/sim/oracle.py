@@ -1,0 +1,109 @@
+"""Safety and liveness oracles checked after every simulated run.
+
+Each oracle states an invariant the election planes must hold under ANY
+survivable fault schedule; a violation message names the oracle first
+(``no_ballot_lost: ...``) so sweeps and shrink predicates can match on
+the class.
+
+* ``no_ballot_lost``   — every ballot a voter was acked for appears in
+  the published record exactly once (exactly-once admission: neither a
+  lost response nor a retry may lose or double-record a ballot);
+* ``chain_contiguous`` — the recorded ballot stream forms one unbroken
+  confirmation-code chain (each code seeds the next, every code valid);
+* ``verifier_green``   — the full independent Verifier accepts the
+  record, including V15 over the published mix cascade;
+* ``quorum_tally``     — the threshold-decrypted tally equals the
+  plaintext vote sums of the acked cast ballots, produced by exactly
+  ``navailable`` guardians with the rest compensated;
+* ``liveness``         — the workflow ran to completion inside the
+  virtual-time horizon with no deadlock and no task crash (reported by
+  the run framework via ``liveness_error`` / ``workflow_error``).
+"""
+
+from __future__ import annotations
+
+
+def check(outcome) -> list[str]:
+    """All oracle violations for one run's :class:`~electionguard_tpu.
+    sim.cluster.SimOutcome` (empty = the run is green)."""
+    v: list[str] = []
+    if outcome.liveness_error:
+        v.append(f"liveness: {outcome.liveness_error}")
+    if outcome.workflow_error:
+        v.append(f"liveness: workflow failed: {outcome.workflow_error}")
+    for name, err in outcome.task_errors:
+        v.append(f"liveness: task {name} crashed: {err!r}")
+    if not outcome.completed:
+        if not v:
+            v.append("liveness: run ended before the workflow completed")
+        return v  # downstream oracles need the full artifacts
+    v.extend(_no_ballot_lost(outcome))
+    v.extend(_chain_contiguous(outcome))
+    v.extend(_verifier_green(outcome))
+    v.extend(_quorum_tally(outcome))
+    return v
+
+
+def _no_ballot_lost(o) -> list[str]:
+    counts: dict[str, int] = {}
+    for b in o.recorded:
+        counts[b.ballot_id] = counts.get(b.ballot_id, 0) + 1
+    v = []
+    for bid in sorted(o.acked):
+        n = counts.get(bid, 0)
+        if n == 0:
+            v.append(f"no_ballot_lost: acked ballot {bid} missing from "
+                     f"the record")
+        elif n > 1:
+            v.append(f"no_ballot_lost: acked ballot {bid} recorded "
+                     f"{n} times")
+    return v
+
+
+def _chain_contiguous(o) -> list[str]:
+    v = []
+    for b in o.recorded:
+        if not b.is_valid_code():
+            v.append(f"chain_contiguous: ballot {b.ballot_id} has an "
+                     f"invalid confirmation code")
+    for prev, cur in zip(o.recorded, o.recorded[1:]):
+        if cur.code_seed != prev.code:
+            v.append(f"chain_contiguous: {cur.ballot_id} does not chain "
+                     f"from {prev.ballot_id}")
+            break
+    return v
+
+
+def _verifier_green(o) -> list[str]:
+    if o.verify_result is None:
+        return ["verifier_green: verifier never ran"]
+    if not o.verify_result.ok:
+        failed = sorted(k for k, ok in o.verify_result.checks.items()
+                        if not ok)
+        return [f"verifier_green: checks failed: {', '.join(failed)}"]
+    return []
+
+
+def _quorum_tally(o) -> list[str]:
+    v = []
+    dr = o.decryption_result
+    if dr is None:
+        return ["quorum_tally: no decryption result"]
+    if len(dr.decrypting_guardians) != o.navailable:
+        v.append(f"quorum_tally: decrypted with "
+                 f"{len(dr.decrypting_guardians)} guardians, expected "
+                 f"navailable={o.navailable}")
+    want: dict[tuple[str, str], int] = {}
+    acked_cast = [b for b in o.ballots if b.ballot_id in o.acked]
+    for b in acked_cast:
+        for c in b.contests:
+            for s in c.selections:
+                key = (c.contest_id, s.selection_id)
+                want[key] = want.get(key, 0) + s.vote
+    got = {(c.contest_id, s.selection_id): s.tally
+           for c in dr.decrypted_tally.contests for s in c.selections}
+    for key in sorted(want):
+        if got.get(key, 0) != want[key]:
+            v.append(f"quorum_tally: {key[0]}/{key[1]} decrypted to "
+                     f"{got.get(key, 0)}, plaintext sum is {want[key]}")
+    return v

@@ -1,0 +1,186 @@
+"""Seed-driven fault schedules: generation, FaultPlan assembly, JSON.
+
+A schedule is a flat list of :class:`FaultEvent` — the unit the
+shrinker removes.  ``generate_schedule(rng)`` draws a schedule that is
+*survivable by construction*: every event class it can emit is one the
+planes are built to ride out (bounded partitions inside the retry
+windows, crashes only of restartable guardians / spared mix servers,
+drops only on idempotent rpcs), so the liveness oracle ("the workflow
+completes before the horizon") is a real invariant, not a coin flip.
+
+Events map onto two carriers:
+
+* protocol faults (latency, drop_response, unavailable, crash) become
+  a ``testing.faults.FaultPlan`` — the SAME deterministic Nth-call
+  injection machinery the real chaos suite uses, firing at exact
+  protocol points;
+* link faults (partition, duplicate delivery, connection death) become
+  the transport's :class:`~electionguard_tpu.sim.transport.NetModel`.
+
+``to_json`` / ``from_json`` round-trip a schedule so a shrunk failing
+schedule is a replayable artifact (SIM_RESULTS.json, bug reports).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass
+
+from electionguard_tpu.sim.transport import NetModel, Partition
+from electionguard_tpu.testing.faults import FaultPlan, FaultRule
+
+# rpcs whose response can be dropped after the state change commits:
+# each has an explicit idempotent-replay path (registration nonces,
+# chunk overwrite, cross-batch ballot dedup, pure recompute)
+DROPPABLE = ("registerTrustee", "registerMixServer", "encryptBallot",
+             "receivePublicKeys", "receiveSecretKeyShare", "pushRows",
+             "shuffleStage")
+
+# transient client-side failures: every Stub retries UNAVAILABLE
+FLAKEABLE = DROPPABLE + ("sendPublicKeys", "sendSecretKeyShare",
+                         "pullRows", "directDecrypt",
+                         "compensatedDecrypt")
+
+# trustee-server rpcs whose handler checkpoints (WAL) before the
+# response, so a crash immediately after is restart-recoverable
+GUARDIAN_CRASH_POINTS = ("receivePublicKeys", "receiveSecretKeyShare",
+                         "receiveChallengedShare")
+
+# mix-server rpcs; a crashed mix server is replaced by the hot spare
+MIX_CRASH_POINTS = ("pushRows", "shuffleStage")
+
+# node pairs partitions may sever (every window is bounded well inside
+# the retry budget: 3 attempts x 5s connect windows + backoff)
+PARTITION_LINKS = (("kc", "guardian-0"), ("kc", "guardian-1"),
+                   ("kc", "guardian-2"), ("voter-0", "serve"),
+                   ("voter-1", "serve"), ("mix", "mix-0"),
+                   ("mix", "mix-1"), ("decrypt", "dec-0"))
+
+MAX_PARTITION_S = 4.0
+MAX_GUARDIAN_DOWNTIME_S = 3.0
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One schedulable fault.  ``kind`` selects which fields matter:
+
+    * ``latency``        — method, nth, seconds
+    * ``drop_response``  — method, nth
+    * ``unavailable``    — method, nth (client side)
+    * ``crash_guardian`` — method, nth, seconds (downtime before restart)
+    * ``crash_mix``      — method, nth
+    * ``partition``      — a, b, t0, seconds (duration)
+    * ``duplicate``      — seconds (delivery-duplication probability)
+    * ``conn_death``     — nth (global message index that dies in flight)
+    """
+    kind: str
+    method: str = ""
+    nth: int = 0
+    a: str = ""
+    b: str = ""
+    t0: float = 0.0
+    seconds: float = 0.0
+
+
+def generate_schedule(rng) -> list[FaultEvent]:
+    """Draw 0–4 survivable fault events from ``rng`` (random.Random)."""
+    events: list[FaultEvent] = []
+    kinds = (["latency"] * 3 + ["drop_response"] * 3 + ["unavailable"] * 2
+             + ["partition"] * 2 + ["crash_guardian", "crash_mix",
+                                    "duplicate", "conn_death"])
+    crashed_guardian = crashed_mix = False
+    for _ in range(rng.randint(0, 4)):
+        kind = rng.choice(kinds)
+        if kind == "latency":
+            events.append(FaultEvent(
+                "latency", method=rng.choice(FLAKEABLE),
+                nth=rng.randint(1, 4),
+                seconds=round(rng.uniform(0.05, 0.8), 3)))
+        elif kind == "drop_response":
+            events.append(FaultEvent(
+                "drop_response", method=rng.choice(DROPPABLE),
+                nth=rng.randint(1, 3)))
+        elif kind == "unavailable":
+            events.append(FaultEvent(
+                "unavailable", method=rng.choice(FLAKEABLE),
+                nth=rng.randint(1, 3)))
+        elif kind == "partition":
+            a, b = rng.choice(PARTITION_LINKS)
+            events.append(FaultEvent(
+                "partition", a=a, b=b,
+                t0=round(rng.uniform(0.0, 30.0), 3),
+                seconds=round(rng.uniform(0.5, MAX_PARTITION_S), 3)))
+        elif kind == "crash_guardian" and not crashed_guardian:
+            crashed_guardian = True
+            events.append(FaultEvent(
+                "crash_guardian",
+                method=rng.choice(GUARDIAN_CRASH_POINTS),
+                nth=rng.randint(1, 4),
+                seconds=round(rng.uniform(0.5, MAX_GUARDIAN_DOWNTIME_S),
+                              3)))
+        elif kind == "crash_mix" and not crashed_mix:
+            crashed_mix = True
+            events.append(FaultEvent(
+                "crash_mix", method=rng.choice(MIX_CRASH_POINTS),
+                nth=rng.randint(1, 2)))
+        elif kind == "duplicate":
+            events.append(FaultEvent(
+                "duplicate", seconds=round(rng.uniform(0.01, 0.08), 3)))
+        elif kind == "conn_death":
+            events.append(FaultEvent(
+                "conn_death", nth=rng.randint(5, 80)))
+    return events
+
+
+def to_fault_plan(events: list[FaultEvent]) -> FaultPlan:
+    """The protocol-fault slice of a schedule as a FaultPlan (the
+    caller wires ``plan.crash_cb`` to the transport)."""
+    rules = []
+    for e in events:
+        if e.kind == "latency":
+            rules.append(FaultRule(method=e.method, kind="latency",
+                                   on_calls=(e.nth,), latency_s=e.seconds,
+                                   where="server"))
+        elif e.kind == "drop_response":
+            rules.append(FaultRule(method=e.method, kind="drop_response",
+                                   on_calls=(e.nth,)))
+        elif e.kind == "unavailable":
+            rules.append(FaultRule(method=e.method, kind="unavailable",
+                                   on_calls=(e.nth,), where="client"))
+        elif e.kind in ("crash_guardian", "crash_mix"):
+            rules.append(FaultRule(method=e.method, kind="crash_after",
+                                   on_calls=(e.nth,)))
+    return FaultPlan(rules=rules)
+
+
+def net_model(events: list[FaultEvent], rng) -> NetModel:
+    """The link-fault slice of a schedule as the transport's NetModel."""
+    dup = 0.0
+    partitions = []
+    kills = set()
+    for e in events:
+        if e.kind == "duplicate":
+            dup = max(dup, e.seconds)
+        elif e.kind == "partition":
+            partitions.append(Partition(e.a, e.b, e.t0, e.seconds))
+        elif e.kind == "conn_death":
+            kills.add(e.nth)
+    return NetModel(rng=rng, dup_prob=dup, partitions=tuple(partitions),
+                    kill_msgs=frozenset(kills))
+
+
+def guardian_downtime(events: list[FaultEvent]) -> float:
+    """Restart delay for a scheduled guardian crash (default when the
+    schedule carries none — hand-built schedules in tests)."""
+    for e in events:
+        if e.kind == "crash_guardian" and e.seconds > 0:
+            return e.seconds
+    return 1.0
+
+
+def to_json(events: list[FaultEvent]) -> str:
+    return json.dumps([asdict(e) for e in events], sort_keys=True)
+
+
+def from_json(text: str) -> list[FaultEvent]:
+    return [FaultEvent(**d) for d in json.loads(text)]

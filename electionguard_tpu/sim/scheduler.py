@@ -1,0 +1,372 @@
+"""The deterministic cooperative scheduler and its virtual clock.
+
+Library code already blocks only through the ``utils/clock.py`` seam
+(sleep, event/condition waits, future results, thread start/join — the
+``wall-clock-discipline`` eglint pass enforces it), so this scheduler
+gets control at every point a task could block.  Tasks run on real OS
+threads but hold a single run token: exactly one task executes at a
+time, and it runs *atomically* until its next clock-seam call.  At that
+point it parks, the scheduler picks the next runnable task with its
+seeded RNG, and virtual time jumps straight to the earliest wake
+deadline when nothing is runnable — sleeps are free.
+
+Determinism argument: with one logical thread of control, the only
+scheduling freedom is WHICH parked task resumes next, and that choice
+is ``rng.choice`` over a list sorted by spawn order.  Everything else a
+run does (rpc payloads, fault firing, virtual delays) is a pure
+function of task execution plus the seeded net/fault RNG streams, so
+one seed reproduces one execution — attested by the sha256 event-trace
+hash, which covers every dispatch decision with its virtual timestamp.
+
+Liveness failures are first-class: a run whose tasks all park with no
+future wake is a deadlock, and a run whose virtual time would pass the
+horizon is a stuck protocol; both unwind every task (via
+:class:`TaskKilled`) and surface as oracle violations, never hangs.  A
+real-time watchdog catches the one thing cooperative scheduling cannot
+see — a task blocked in a primitive that bypassed the seam.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+from typing import Callable, Optional
+
+from electionguard_tpu.utils import clock as clock_mod
+
+#: virtual seconds a condition-variable wait parks before rechecking its
+#: predicate (Condition has no pollable state, so the sim quantizes it)
+CV_QUANTUM = 0.005
+
+#: real seconds the scheduler waits for the running task to yield before
+#: declaring it stuck outside the clock seam (native block / real bug)
+WATCHDOG_S = 60.0
+
+_NEW, _READY, _RUNNING, _PARKED, _DONE = range(5)
+
+
+class TaskKilled(BaseException):
+    """Unwinds a killed task at its next (or current) yield point.
+    BaseException so ``except Exception`` recovery paths in library
+    code cannot swallow a simulated crash."""
+
+
+class SimDeadlock(Exception):
+    """Every task parked, none with a future wake: genuine deadlock."""
+
+
+class SimHorizon(Exception):
+    """Virtual time would pass the horizon: the run is stuck/livelocked."""
+
+
+class SimStuck(Exception):
+    """A task failed to yield within the real-time watchdog — it blocked
+    outside the clock seam (a discipline bug, not a protocol bug)."""
+
+
+class _Task:
+    __slots__ = ("name", "node", "seq", "fn", "thread", "go", "state",
+                 "pred", "wake_at", "wait_ok", "killed", "error", "adopted")
+
+    def __init__(self, name: str, node: str, seq: int,
+                 fn: Optional[Callable] = None):
+        self.name = name
+        self.node = node
+        self.seq = seq
+        self.fn = fn
+        self.thread: Optional[threading.Thread] = None
+        self.go = threading.Event()
+        self.state = _NEW
+        self.pred: Optional[Callable[[], bool]] = None
+        self.wake_at: Optional[float] = None
+        self.wait_ok = True     # set by the scheduler before re-dispatch
+        self.killed = False
+        self.error: Optional[BaseException] = None
+        self.adopted = False
+
+
+class SimScheduler:
+    """One simulated run: spawn tasks, ``run(main)``, read the trace."""
+
+    def __init__(self, seed: int, horizon: float = 600.0):
+        self.rng = random.Random(seed)
+        self.horizon = horizon
+        self.now = 0.0
+        self.trace: list[tuple[int, str, str]] = []
+        self._tasks: list[_Task] = []
+        self._by_ident: dict[int, _Task] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._seq = 0
+        self._running: Optional[_Task] = None
+        self._finishing = False
+
+    # ---- trace -------------------------------------------------------
+    def event(self, kind: str, detail: str = "") -> None:
+        self.trace.append((int(self.now * 1e6), kind, detail))
+
+    def trace_hash(self) -> str:
+        h = hashlib.sha256()
+        for t_us, kind, detail in self.trace:
+            h.update(f"{t_us}|{kind}|{detail}\n".encode())
+        return h.hexdigest()
+
+    # ---- task management ---------------------------------------------
+    def spawn(self, name: str, fn: Callable[[], None],
+              node: Optional[str] = None) -> None:
+        """Create a task; it becomes runnable at the next dispatch."""
+        with self._lock:
+            task = _Task(name, node or name, self._seq, fn)
+            self._seq += 1
+            self._tasks.append(task)
+        task.thread = threading.Thread(
+            target=self._task_body, args=(task,), name=f"sim:{name}",
+            daemon=True)
+        task.thread.start()
+
+    def adopt_thread(self, thread: threading.Thread) -> None:
+        """Take over a library-created thread (``clock.start_thread``):
+        its run() joins the cooperative pool under the spawner's node, so
+        ``thread.is_alive()`` / ``join`` keep their real semantics."""
+        parent = self._current()
+        with self._lock:
+            task = _Task(thread.name, parent.node if parent else "driver",
+                         self._seq)
+            self._seq += 1
+            task.adopted = True
+            self._tasks.append(task)
+        orig_run = thread.run
+
+        def run():
+            with self._lock:
+                self._by_ident[threading.get_ident()] = task
+            task.thread = threading.current_thread()
+            task.go.wait()
+            try:
+                if not task.killed:
+                    orig_run()
+            except TaskKilled:
+                pass
+            except BaseException as e:       # noqa: BLE001 - surfaced below
+                if not task.killed:
+                    task.error = e
+            finally:
+                task.state = _DONE
+                self._wake.set()
+
+        thread.run = run
+        thread.start()
+
+    def _task_body(self, task: _Task) -> None:
+        with self._lock:
+            self._by_ident[threading.get_ident()] = task
+        task.go.wait()
+        try:
+            if not task.killed:
+                task.fn()
+        except TaskKilled:
+            pass
+        except BaseException as e:           # noqa: BLE001 - surfaced below
+            if not task.killed:
+                task.error = e
+        finally:
+            task.state = _DONE
+            self._wake.set()
+
+    def _current(self) -> Optional[_Task]:
+        with self._lock:
+            return self._by_ident.get(threading.get_ident())
+
+    def current_node(self) -> str:
+        t = self._current()
+        return t.node if t is not None else "driver"
+
+    def kill_node(self, node: str) -> None:
+        """Simulated crash: every task of ``node`` unwinds with
+        :class:`TaskKilled` at its current/next yield point."""
+        with self._lock:
+            for t in self._tasks:
+                if t.node == node and t.state != _DONE:
+                    t.killed = True
+        self.event("kill", node)
+
+    def task_errors(self) -> list[tuple[str, BaseException]]:
+        with self._lock:
+            return [(t.name, t.error) for t in self._tasks
+                    if t.error is not None]
+
+    # ---- yield points (called from inside tasks) ---------------------
+    def _yield(self, pred: Optional[Callable[[], bool]],
+               wake_at: Optional[float]) -> bool:
+        task = self._current()
+        if task is None:
+            raise RuntimeError("clock-seam call from outside the sim "
+                               "(scheduler thread or foreign thread)")
+        if task.killed:
+            raise TaskKilled()
+        task.pred = pred
+        task.wake_at = wake_at
+        task.go.clear()
+        task.state = _PARKED
+        self._wake.set()
+        task.go.wait()
+        if task.killed:
+            raise TaskKilled()
+        return task.wait_ok
+
+    def sleep(self, seconds: float) -> None:
+        self._yield(None, self.now + max(0.0, seconds))
+
+    def poll_until(self, pred: Callable[[], bool],
+                   timeout: Optional[float]) -> bool:
+        """Park until ``pred()`` holds (True) or the virtual timeout
+        expires (False).  The scheduler evaluates the predicate, so no
+        context switches burn while it is false."""
+        if pred():
+            return True
+        wake_at = None if timeout is None else self.now + max(0.0, timeout)
+        return self._yield(pred, wake_at)
+
+    # ---- the scheduler loop ------------------------------------------
+    def _runnable(self, t: _Task) -> bool:
+        if t.state == _NEW:
+            return True
+        if t.state != _PARKED:
+            return False
+        if t.killed:
+            return True
+        if t.pred is not None and t.pred():
+            return True
+        return t.wake_at is not None and t.wake_at <= self.now
+
+    def run(self, main_fn: Callable[[], None]) -> None:
+        """Drive the simulation until ``main_fn``'s task completes; then
+        kill and unwind every leftover task.  Raises the main task's
+        exception, or SimDeadlock / SimHorizon / SimStuck."""
+        self.spawn("main", main_fn, node="driver")
+        with self._lock:
+            main = self._tasks[-1]
+        try:
+            self._loop(lambda: main.state == _DONE)
+        finally:
+            self._finish_all()
+        if main.error is not None:
+            raise main.error
+
+    def _loop(self, done: Callable[[], bool]) -> None:
+        while not done():
+            with self._lock:
+                tasks = list(self._tasks)
+            ready = [t for t in tasks if self._runnable(t)]
+            if not ready:
+                wakes = [t.wake_at for t in tasks
+                         if t.state == _PARKED and t.wake_at is not None]
+                if not wakes:
+                    parked = [t.name for t in tasks if t.state == _PARKED]
+                    raise SimDeadlock(
+                        f"all tasks parked with no future wake at "
+                        f"t={self.now:.3f}: {parked}")
+                target = min(wakes)
+                if target > self.horizon:
+                    raise SimHorizon(
+                        f"virtual time would pass the {self.horizon:.0f}s "
+                        f"horizon (next wake {target:.1f}s)")
+                self.now = max(self.now, target)
+                continue
+            ready.sort(key=lambda t: t.seq)
+            pick = self.rng.choice(ready)
+            self._dispatch(pick)
+
+    def _dispatch(self, task: _Task) -> None:
+        # wait_ok tells a pred-parked task whether its predicate held
+        # (vs. a timeout / kill wake)
+        task.wait_ok = bool(task.killed
+                            or task.pred is None or task.pred())
+        task.pred = None
+        task.wake_at = None
+        task.state = _RUNNING
+        self._running = task
+        self.event("run", task.name)
+        self._wake.clear()
+        task.go.set()
+        while task.state == _RUNNING:
+            if not self._wake.wait(WATCHDOG_S):
+                raise SimStuck(
+                    f"task {task.name} did not yield within "
+                    f"{WATCHDOG_S:.0f}s real time — blocked outside the "
+                    f"clock seam")
+            self._wake.clear()
+
+    def _finish_all(self) -> None:
+        """Kill every unfinished task and run each to completion so no
+        sim thread outlives the run."""
+        self._finishing = True
+        with self._lock:
+            leftover = [t for t in self._tasks if t.state != _DONE]
+        for t in leftover:
+            t.killed = True
+        for t in leftover:
+            # NEW tasks unwind before their fn; PARKED ones raise
+            # TaskKilled at their yield point; a task mid-unwind may
+            # park again in a finally block — keep dispatching it
+            while t.state != _DONE:
+                t.state = _RUNNING
+                self._wake.clear()
+                t.go.set()
+                while t.state == _RUNNING:
+                    if not self._wake.wait(WATCHDOG_S):
+                        raise SimStuck(
+                            f"task {t.name} stuck during unwind")
+                    self._wake.clear()
+
+
+class SimClock(clock_mod.Clock):
+    """The virtual clock the sim installs at the ``utils/clock`` seam:
+    every blocking primitive becomes a scheduler yield."""
+
+    #: virtual runs report a fixed wall-clock epoch so timestamps in
+    #: artifacts are reproducible
+    EPOCH = 1_753_920_000.0
+
+    def __init__(self, sched: SimScheduler):
+        self.sched = sched
+
+    def time(self) -> float:
+        return self.EPOCH + self.sched.now
+
+    def monotonic(self) -> float:
+        return self.sched.now
+
+    def sleep(self, seconds: float) -> None:
+        self.sched.sleep(seconds)
+
+    def wait_event(self, event: threading.Event,
+                   timeout: Optional[float] = None) -> bool:
+        return self.sched.poll_until(event.is_set, timeout)
+
+    def cv_wait(self, cv: threading.Condition,
+                timeout: Optional[float] = None) -> bool:
+        # Condition carries no pollable predicate, so release the lock,
+        # park one quantum, reacquire, and let the caller's loop recheck
+        # — the documented spurious-wakeup contract of the seam
+        wait = CV_QUANTUM if timeout is None else min(CV_QUANTUM, timeout)
+        cv.release()
+        try:
+            self.sched.sleep(max(0.0, wait))
+        finally:
+            cv.acquire()
+        return True
+
+    def wait_future(self, future, timeout: Optional[float] = None):
+        if not self.sched.poll_until(future.done, timeout):
+            from concurrent.futures import TimeoutError as FutTimeout
+            raise FutTimeout()
+        return future.result(timeout=0)
+
+    def start_thread(self, thread: threading.Thread) -> None:
+        self.sched.adopt_thread(thread)
+
+    def join_thread(self, thread: threading.Thread,
+                    timeout: Optional[float] = None) -> None:
+        self.sched.poll_until(lambda: not thread.is_alive(), timeout)

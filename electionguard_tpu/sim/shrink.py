@@ -1,0 +1,123 @@
+"""Delta-debugging shrinker for failing fault schedules.
+
+Given a seed whose schedule violates an oracle, ``shrink`` searches for
+a minimal sub-schedule that still reproduces a violation of the same
+oracle class, using ddmin (Zeller's delta debugging) followed by a
+greedy one-by-one removal pass.  Every probe is a full deterministic
+re-run — same seed, candidate schedule — so the result is a replayable
+repro artifact: ``(seed, minimal schedule)`` fails identically on any
+checkout.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from electionguard_tpu.sim import cluster
+from electionguard_tpu.sim import schedule as schedule_mod
+
+
+def _oracle_class(violation: str) -> str:
+    return violation.split(":", 1)[0]
+
+
+@dataclass
+class ShrinkResult:
+    """Minimal failing schedule + the evidence trail."""
+    schedule: list[schedule_mod.FaultEvent]
+    violations: list[str]
+    runs: int
+    exhausted: bool = False            # budget ran out before a fixpoint
+    history: list[tuple[int, int]] = field(default_factory=list)
+
+    def repro_json(self) -> str:
+        return schedule_mod.to_json(self.schedule)
+
+
+def shrink(seed: int,
+           schedule: list[schedule_mod.FaultEvent],
+           plant: Sequence[str] = (),
+           config: Optional[cluster.SimConfig] = None,
+           oracle_classes: Optional[frozenset[str]] = None,
+           budget: Optional[int] = None) -> ShrinkResult:
+    """Minimize ``schedule`` while a violation of the same oracle class
+    persists under ``run_sim(seed, candidate)``.
+
+    ``oracle_classes`` defaults to the classes the full schedule
+    violates (so the shrinker cannot wander onto an unrelated failure);
+    ``budget`` caps the number of probe runs
+    (``EGTPU_SIM_SHRINK_BUDGET``).
+    """
+    from electionguard_tpu.sim.explore import run_sim   # avoid cycle
+    from electionguard_tpu.utils import knobs
+
+    if budget is None:
+        budget = knobs.get_int("EGTPU_SIM_SHRINK_BUDGET")
+    runs = 0
+
+    def failing(candidate: list[schedule_mod.FaultEvent]) -> list[str]:
+        nonlocal runs
+        runs += 1
+        report = run_sim(seed, schedule=candidate, plant=plant,
+                         config=config)
+        hits = [v for v in report.violations
+                if oracle_classes is None
+                or _oracle_class(v) in oracle_classes]
+        return hits
+
+    base = failing(list(schedule))
+    if not base:
+        return ShrinkResult(schedule=list(schedule), violations=[],
+                            runs=runs)
+    if oracle_classes is None:
+        oracle_classes = frozenset(_oracle_class(v) for v in base)
+        base = [v for v in base if _oracle_class(v) in oracle_classes]
+
+    current = list(schedule)
+    violations = base
+    history = [(runs, len(current))]
+    exhausted = False
+
+    # ddmin: try dropping chunks of shrinking granularity
+    n = 2
+    while len(current) >= 2:
+        if runs >= budget:
+            exhausted = True
+            break
+        chunk = max(1, len(current) // n)
+        reduced = False
+        for start in range(0, len(current), chunk):
+            candidate = current[:start] + current[start + chunk:]
+            if not candidate or runs >= budget:
+                continue
+            hits = failing(candidate)
+            if hits:
+                current, violations = candidate, hits
+                history.append((runs, len(current)))
+                n = max(2, n - 1)
+                reduced = True
+                break
+        if not reduced:
+            if chunk == 1:
+                break
+            n = min(len(current), n * 2)
+
+    # greedy tail: one-by-one removal until a fixpoint
+    changed = True
+    while changed and len(current) > 1 and runs < budget:
+        changed = False
+        for i in range(len(current)):
+            candidate = current[:i] + current[i + 1:]
+            if runs >= budget:
+                exhausted = True
+                break
+            hits = failing(candidate)
+            if hits:
+                current, violations = candidate, hits
+                history.append((runs, len(current)))
+                changed = True
+                break
+
+    return ShrinkResult(schedule=current, violations=violations,
+                        runs=runs, exhausted=exhausted, history=history)

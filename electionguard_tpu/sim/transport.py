@@ -1,0 +1,343 @@
+"""In-memory transport mounted at the ``rpc_util`` factory seams.
+
+``rpc_util.set_transport(SimTransport(...))`` makes every
+``make_channel`` / ``make_server`` / ``find_free_port`` call route here:
+servers are dictionaries of generic handlers keyed by virtual port,
+channels are direct dispatchers, and an rpc is one in-task function
+call bracketed by seeded virtual-time link delays.  The full middleware
+stack still applies — ``generic_service`` wraps impls with fault
+injection, rpc metrics, and tracing before they ever reach a server, so
+the sim exercises the same code the real gRPC planes run, minus the
+sockets.
+
+The :class:`NetModel` owns the adversarial link behavior, all drawn
+from its own seeded RNG so the scheduler's interleaving choices and the
+network's misbehavior are independent deterministic streams:
+
+* per-message latency (which also REORDERS concurrent rpcs: two
+  in-flight calls from different tasks resume in delay order);
+* duplicate delivery (the handler runs twice; the extra response is
+  discarded — at-least-once, the retry-idempotency killer);
+* partitions (directed windows of virtual time per node pair);
+* connection death (the Nth message in the run dies in flight after
+  the handler may already have committed).
+
+Requests serialize through the real protobuf wire format both ways, so
+a message that would not survive gRPC does not survive the sim either.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import namedtuple
+from dataclasses import dataclass, field
+from typing import Optional
+
+import grpc
+
+from electionguard_tpu.sim.scheduler import SimScheduler
+from electionguard_tpu.testing import faults
+
+_HCD = namedtuple("_HCD", ("method", "invocation_metadata"))
+
+
+class SimRpcError(grpc.RpcError):
+    """Transport-level failure surfaced to clients, quacking like the
+    real thing (``e.code()`` / ``e.details()``)."""
+
+    def __init__(self, code: grpc.StatusCode, details: str):
+        super().__init__()
+        self._code = code
+        self._details = details
+
+    def code(self) -> grpc.StatusCode:
+        return self._code
+
+    def details(self) -> str:
+        return self._details
+
+    def __str__(self) -> str:
+        return f"SimRpcError({self._code}, {self._details!r})"
+
+
+class _Abort(BaseException):
+    """Server-side ``context.abort`` control flow (BaseException so impl
+    ``except Exception`` blocks cannot eat it, matching real gRPC)."""
+
+    def __init__(self, code, details):
+        self.code = code
+        self.details = details
+
+
+class SimContext:
+    """Duck-typed ``grpc.ServicerContext`` for in-memory dispatch."""
+
+    def __init__(self, peer: str):
+        self._peer = peer
+        self.code = None
+        self.details = None
+
+    def invocation_metadata(self):
+        return ()
+
+    def peer(self) -> str:
+        return f"sim:{self._peer}"
+
+    def is_active(self) -> bool:
+        return True
+
+    def time_remaining(self) -> Optional[float]:
+        return None
+
+    def set_code(self, code) -> None:
+        self.code = code
+
+    def set_details(self, details) -> None:
+        self.details = details
+
+    def abort(self, code, details=""):
+        raise _Abort(code, details)
+
+
+@dataclass(frozen=True)
+class Partition:
+    """Both directions of the (a, b) link are severed for virtual time
+    ``[t0, t0 + duration)``."""
+    a: str
+    b: str
+    t0: float
+    duration: float
+
+    def severs(self, x: str, y: str, now: float) -> bool:
+        return ({x, y} == {self.a, self.b}
+                and self.t0 <= now < self.t0 + self.duration)
+
+
+@dataclass
+class NetModel:
+    """Seeded adversarial link behavior (see module docstring)."""
+    rng: object                       # random.Random
+    min_delay: float = 0.0002
+    max_delay: float = 0.003
+    dup_prob: float = 0.0
+    partitions: tuple[Partition, ...] = ()
+    kill_msgs: frozenset[int] = frozenset()
+    _msgs: int = field(default=0, init=False)
+
+    def delay(self) -> float:
+        return self.rng.uniform(self.min_delay, self.max_delay)
+
+    def duplicate(self) -> bool:
+        return self.dup_prob > 0 and self.rng.random() < self.dup_prob
+
+    def partitioned(self, a: str, b: str, now: float) -> bool:
+        return any(p.severs(a, b, now) for p in self.partitions)
+
+    def next_msg_dies(self) -> bool:
+        self._msgs += 1
+        return self._msgs in self.kill_msgs
+
+
+class SimServer:
+    """Stands in for ``grpc.Server``: handlers + an up/down bit."""
+
+    def __init__(self, transport: "SimTransport", port: int, node: str):
+        self.transport = transport
+        self.port = port
+        self.node = node
+        self.up = False
+        self._handlers: list = []
+
+    def add_generic_rpc_handlers(self, handlers) -> None:
+        self._handlers.extend(handlers)
+
+    def start(self) -> None:
+        self.up = True
+        self.transport.sched.event("server-up", f"{self.node}:{self.port}")
+
+    def stop(self, grace=None) -> threading.Event:
+        if self.up:
+            self.transport.sched.event("server-down",
+                                       f"{self.node}:{self.port}")
+        self.up = False
+        ev = threading.Event()
+        ev.set()
+        return ev
+
+    def dispatch(self, path: str, request_bytes: bytes, peer: str) -> bytes:
+        details = _HCD(path, ())
+        for gh in self._handlers:
+            mh = gh.service(details)
+            if mh is not None:
+                ctx = SimContext(peer)
+                resp = mh.unary_unary(
+                    mh.request_deserializer(request_bytes), ctx)
+                return mh.response_serializer(resp)
+        raise _Abort(grpc.StatusCode.UNIMPLEMENTED, f"no handler for {path}")
+
+
+class SimTransport:
+    """The process-wide virtual network: port registry + dispatch."""
+
+    def __init__(self, sched: SimScheduler, net: NetModel, on_crash=None):
+        self.sched = sched
+        self.net = net
+        #: cluster hook: called (server, method) after a crash_after
+        #: fault downs a server, to kill its node's tasks and schedule a
+        #: restart where the protocol supports one
+        self.on_crash = on_crash
+        self.servers: dict[int, SimServer] = {}
+        self._next_port = 18000
+        self._local = threading.local()
+
+    # ---- rpc_util factory seam ---------------------------------------
+    def free_port(self) -> int:
+        p = self._next_port
+        self._next_port += 1
+        return p
+
+    def server(self, port: int, max_message: int = 0):
+        if port == 0:
+            port = self.free_port()
+        existing = self.servers.get(port)
+        if existing is not None and existing.up:
+            raise OSError(f"sim port {port} already bound by "
+                          f"{existing.node}")
+        srv = SimServer(self, port, self.sched.current_node())
+        self.servers[port] = srv
+        return srv, port
+
+    def channel(self, url: str, max_message: int = 0, plain: bool = False):
+        return SimChannel(self, url, plain)
+
+    # ---- dispatch ----------------------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def current_node(self) -> str:
+        """The node 'speaking' right now: the innermost server when a
+        handler is running, else the current task's node — so a handler
+        making an onward rpc originates from ITS node, not the caller's."""
+        st = self._stack()
+        return st[-1].node if st else self.sched.current_node()
+
+    def crash_current_server(self, method: str) -> None:
+        """``FaultPlan.crash_cb`` target: down the server whose handler
+        is executing, then let the cluster kill/restart its node."""
+        st = self._stack()
+        if not st:
+            return
+        srv = st[-1]
+        srv.up = False
+        self.sched.event("crash", f"{srv.node}:{srv.port} after {method}")
+        if self.on_crash is not None:
+            self.on_crash(srv, method)
+
+    def reachable(self, src: str, port: int) -> bool:
+        srv = self.servers.get(port)
+        return (srv is not None and srv.up
+                and not self.net.partitioned(src, srv.node, self.sched.now))
+
+    def dispatch(self, port: int, path: str, request_bytes: bytes,
+                 method: str, src: str) -> bytes:
+        srv = self.servers.get(port)
+        if srv is None or not srv.up:
+            raise SimRpcError(grpc.StatusCode.UNAVAILABLE,
+                              f"sim port {port} not serving")
+        self.sched.event("rpc", f"{src}->{srv.node}:{port} {method}")
+        self._stack().append(srv)
+        try:
+            return srv.dispatch(path, request_bytes, src)
+        except _Abort as a:
+            raise SimRpcError(a.code, a.details) from None
+        finally:
+            self._stack().pop()
+
+
+class _SimMulticallable:
+    def __init__(self, channel: "SimChannel", path: str, serializer,
+                 deserializer):
+        self.channel = channel
+        self.path = path
+        self.ser = serializer
+        self.deser = deserializer
+
+    def __call__(self, request, timeout: Optional[float] = None,
+                 wait_for_ready: Optional[bool] = None, metadata=None):
+        tr = self.channel.transport
+        sched, net = tr.sched, tr.net
+        method = self.path.rsplit("/", 1)[-1]
+        src = tr.current_node()
+        port = int(self.channel.url.rsplit(":", 1)[-1])
+        if not self.channel.plain:
+            plan = faults.active_plan()
+            if plan is not None:
+                # the real channel applies client rules via interceptor;
+                # the sim channel has no grpc.Channel to intercept
+                faults.apply_client_rules(plan, method)
+        budget = timeout if timeout is not None else 600.0
+        deadline = sched.now + budget
+
+        def reach() -> bool:
+            return tr.reachable(src, port)
+
+        if not reach():
+            if wait_for_ready:
+                # real gRPC semantics: wait_for_ready blocks the attempt
+                # until the peer connects or the per-try deadline expires
+                if not sched.poll_until(reach, budget):
+                    raise SimRpcError(
+                        grpc.StatusCode.DEADLINE_EXCEEDED,
+                        f"connect timeout to {self.channel.url}")
+            else:
+                sched.sleep(net.delay())
+                raise SimRpcError(grpc.StatusCode.UNAVAILABLE,
+                                  f"{self.channel.url} unreachable")
+        sched.sleep(net.delay())                     # request in flight
+        if net.next_msg_dies() or not reach():
+            sched.event("conn-death", f"{src}->{port} {method}")
+            raise SimRpcError(grpc.StatusCode.UNAVAILABLE,
+                              f"connection to {self.channel.url} died "
+                              f"in flight")
+        request_bytes = self.ser(request)
+        response_bytes = tr.dispatch(port, self.path, request_bytes,
+                                     method, src)
+        if net.duplicate():
+            # at-least-once delivery: the peer processes the message
+            # again; the duplicate's response is discarded
+            sched.event("dup-delivery", f"{src}->{port} {method}")
+            try:
+                tr.dispatch(port, self.path, request_bytes, method, src)
+            except SimRpcError:
+                pass
+        sched.sleep(net.delay())                     # response in flight
+        if sched.now > deadline:
+            raise SimRpcError(grpc.StatusCode.DEADLINE_EXCEEDED,
+                              f"{method} deadline exceeded in transit")
+        if not reach():
+            raise SimRpcError(grpc.StatusCode.UNAVAILABLE,
+                              f"connection to {self.channel.url} lost "
+                              f"before response")
+        return self.deser(response_bytes)
+
+
+class SimChannel:
+    """Stands in for ``grpc.Channel`` (the unary-unary slice the repo
+    uses).  ``plain`` channels skip client-side fault rules, mirroring
+    ``make_plain_channel``."""
+
+    def __init__(self, transport: SimTransport, url: str, plain: bool):
+        self.transport = transport
+        self.url = url
+        self.plain = plain
+
+    def unary_unary(self, path: str, request_serializer=None,
+                    response_deserializer=None, **_kw):
+        return _SimMulticallable(self, path, request_serializer,
+                                 response_deserializer)
+
+    def close(self) -> None:
+        pass

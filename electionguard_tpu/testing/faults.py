@@ -53,11 +53,12 @@ import json
 import logging
 import os
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 import grpc
+
+from electionguard_tpu.utils import clock
 
 
 class InjectedRpcError(grpc.RpcError):
@@ -207,6 +208,24 @@ def active_plan() -> Optional[FaultPlan]:
 # client interceptor
 # ---------------------------------------------------------------------------
 
+def apply_client_rules(plan: FaultPlan, method: str) -> None:
+    """Run ``plan``'s client-side rules for ``method``: sleep injected
+    latency, raise injected errors.  Shared by the real channel
+    interceptor and the sim transport (which has no grpc channel to
+    intercept)."""
+    for rule, _n in plan.firing("client", method):
+        if rule.kind == "latency":
+            clock.sleep(rule.latency_s)
+        elif rule.kind == "unavailable":
+            raise InjectedRpcError(
+                grpc.StatusCode.UNAVAILABLE,
+                f"injected UNAVAILABLE on {method}")
+        elif rule.kind == "deadline":
+            raise InjectedRpcError(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                f"injected DEADLINE_EXCEEDED on {method}")
+
+
 class FaultClientInterceptor(grpc.UnaryUnaryClientInterceptor):
     """Applies a plan's client-side rules before the request leaves."""
 
@@ -216,17 +235,7 @@ class FaultClientInterceptor(grpc.UnaryUnaryClientInterceptor):
     def intercept_unary_unary(self, continuation, client_call_details,
                               request):
         method = client_call_details.method.rsplit("/", 1)[-1]
-        for rule, _n in self.plan.firing("client", method):
-            if rule.kind == "latency":
-                time.sleep(rule.latency_s)
-            elif rule.kind == "unavailable":
-                raise InjectedRpcError(
-                    grpc.StatusCode.UNAVAILABLE,
-                    f"injected UNAVAILABLE on {method}")
-            elif rule.kind == "deadline":
-                raise InjectedRpcError(
-                    grpc.StatusCode.DEADLINE_EXCEEDED,
-                    f"injected DEADLINE_EXCEEDED on {method}")
+        apply_client_rules(self.plan, method)
         return continuation(client_call_details, request)
 
 
@@ -255,7 +264,7 @@ def wrap_server_impl(method: str, fn: Callable) -> Callable:
         # trailing fn call; drop/crash rules run fn exactly once first
         for rule, _n in plan.firing("server", method):
             if rule.kind == "latency":
-                time.sleep(rule.latency_s)
+                clock.sleep(rule.latency_s)
             elif rule.kind in ("unavailable", "deadline"):
                 context.abort(
                     grpc.StatusCode.UNAVAILABLE

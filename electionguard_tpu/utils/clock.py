@@ -1,0 +1,133 @@
+"""The wall-clock seam every library component tells time through.
+
+Production code never calls ``time.time()`` / ``time.monotonic()`` /
+``time.sleep()`` directly (the eglint ``wall-clock-discipline`` pass
+enforces this outside ``cli/`` and benches); it calls the module
+functions here, which delegate to the installed :class:`Clock`.  In
+production that is :data:`SYSTEM` — a thin pass-through to ``time`` —
+so the seam costs one attribute hop.  The deterministic simulator
+(``electionguard_tpu/sim``) installs a virtual clock instead, so the
+entire multi-node workflow runs on simulated time: sleeps are free,
+schedules are reproducible from a seed, and "wait ten minutes" tests
+finish in microseconds.
+
+Blocking primitives are part of the seam too.  A cooperative simulator
+can only interleave tasks at points it controls, so code that would
+otherwise park a thread in the kernel — ``Event.wait``,
+``Condition.wait``, ``Future.result(timeout)``, ``Thread.start`` /
+``join`` — routes through :func:`wait_event` / :func:`cv_wait` /
+:func:`wait_future` / :func:`start_thread` / :func:`join_thread`.
+The system clock forwards each to the real primitive; the sim clock
+turns each into a virtual-time poll.  Every call site in the codebase
+sits inside a predicate-rechecking loop (or tolerates spurious
+wakeups), which is exactly the contract that makes the poll-based sim
+implementation sound.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Optional
+
+
+class Clock:
+    """The real clock: a pass-through to ``time`` and the genuine
+    blocking primitives.  Subclass and :func:`install` to virtualize
+    (see ``sim/scheduler.py``)."""
+
+    def time(self) -> float:
+        return _time.time()
+
+    def monotonic(self) -> float:
+        return _time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        _time.sleep(seconds)
+
+    # ---- blocking primitives ----------------------------------------
+    def wait_event(self, event: threading.Event,
+                   timeout: Optional[float] = None) -> bool:
+        return event.wait(timeout)
+
+    def cv_wait(self, cv: threading.Condition,
+                timeout: Optional[float] = None) -> bool:
+        """Wait on ``cv`` (held by the caller).  May return before the
+        timeout without a notify — callers must recheck their
+        predicate, the standard condition-variable contract."""
+        return cv.wait(timeout)
+
+    def wait_future(self, future, timeout: Optional[float] = None):
+        """``future.result(timeout)`` through the seam: returns the
+        result, re-raises the future's exception, or raises
+        ``concurrent.futures.TimeoutError``."""
+        return future.result(timeout)
+
+    def start_thread(self, thread: threading.Thread) -> None:
+        thread.start()
+
+    def join_thread(self, thread: threading.Thread,
+                    timeout: Optional[float] = None) -> None:
+        thread.join(timeout)
+
+
+SYSTEM = Clock()
+
+_lock = threading.Lock()
+_installed: Clock = SYSTEM
+
+
+def install(clock: Clock) -> None:
+    """Make ``clock`` the process-wide clock (the simulator's entry
+    point).  Callers pair this with :func:`uninstall` in a finally."""
+    global _installed
+    with _lock:
+        _installed = clock
+
+
+def uninstall() -> None:
+    global _installed
+    with _lock:
+        _installed = SYSTEM
+
+
+def installed() -> Clock:
+    return _installed
+
+
+# ---- module-level conveniences (the seam call sites use) ------------
+
+def now() -> float:
+    """Wall-clock seconds (``time.time`` semantics)."""
+    return _installed.time()
+
+
+def monotonic() -> float:
+    return _installed.monotonic()
+
+
+def sleep(seconds: float) -> None:
+    _installed.sleep(seconds)
+
+
+def wait_event(event: threading.Event,
+               timeout: Optional[float] = None) -> bool:
+    return _installed.wait_event(event, timeout)
+
+
+def cv_wait(cv: threading.Condition,
+            timeout: Optional[float] = None) -> bool:
+    return _installed.cv_wait(cv, timeout)
+
+
+def wait_future(future, timeout: Optional[float] = None):
+    return _installed.wait_future(future, timeout)
+
+
+def start_thread(thread: threading.Thread) -> None:
+    _installed.start_thread(thread)
+
+
+def join_thread(thread: threading.Thread,
+                timeout: Optional[float] = None) -> None:
+    _installed.join_thread(thread, timeout)

@@ -130,6 +130,18 @@ KNOBS: tuple[Knob, ...] = (
     Knob("EGTPU_SHA_DEVICE_MIN", "int", "65536",
          "Min rows before the ballot-code SHA batch runs on the device "
          "(ballot/code_batch)."),
+    Knob("EGTPU_SIM_HORIZON", "float", "600.0",
+         "Virtual-time horizon for one deterministic simulation run, "
+         "seconds; exceeding it is a liveness violation (sim/cluster)."),
+    Knob("EGTPU_SIM_SEED", "int", "0",
+         "First seed of the default simulation sweep range "
+         "(sim/explore; tools/sim_matrix)."),
+    Knob("EGTPU_SIM_SEEDS", "int", "20",
+         "Seed count of the default simulation sweep range "
+         "(sim/explore; tools/sim_matrix)."),
+    Knob("EGTPU_SIM_SHRINK_BUDGET", "int", "60",
+         "Max probe runs the failing-schedule shrinker may spend "
+         "(sim/shrink)."),
     Knob("EGTPU_TABLE_CACHE", "path", None,
          "On-disk cache dir for host-precomputed setup tables (NttCtx "
          "constants, PowRadix tables), keyed by group fingerprint; "

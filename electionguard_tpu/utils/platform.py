@@ -17,7 +17,8 @@ import contextlib
 import os
 import subprocess
 import sys
-import time
+
+from electionguard_tpu.utils import clock
 from typing import Mapping, MutableMapping, Optional
 
 #: Env-var name prefixes that attach the process to the axon TPU tunnel.
@@ -101,7 +102,7 @@ def ensure_tpu_or_cpu(probe_timeout: float = 90.0,
         return "cpu"
     for attempt in range(max(1, retries)):
         if attempt:
-            time.sleep(retry_wait)
+            clock.sleep(retry_wait)
         if probe_tpu(probe_timeout):
             return "tpu"
         log(f"# tpu probe {attempt + 1}/{retries} failed "

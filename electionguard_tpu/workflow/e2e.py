@@ -23,7 +23,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 from electionguard_tpu.ballot.manifest import (BallotStyle, Candidate,
                                                ContestDescription,
@@ -36,6 +35,7 @@ from electionguard_tpu.obs import trace as obs_trace
 from electionguard_tpu.publish.publisher import Publisher
 from electionguard_tpu.remote.rpc_util import (Stub, find_free_port,
                                                make_plain_channel)
+from electionguard_tpu.utils import clock
 from electionguard_tpu.workflow.run_command import RunCommand, wait_all
 
 
@@ -174,7 +174,7 @@ def main(argv=None) -> int:
                  obs_trace.trace_id())
     phases = _PhaseTracer()
 
-    t_all = time.time()
+    t_all = clock.now()
     procs: list[RunCommand] = []
 
     def phase_fail(name, cmds):
@@ -199,17 +199,17 @@ def main(argv=None) -> int:
              "-out", obs_dir], cmd_out)
         obs_stub = Stub(make_plain_channel(f"localhost:{obs_port}"),
                         "ObsCollectorService")
-        deadline = time.time() + 30
+        deadline = clock.now() + 30
         while True:
             try:
                 obs_stub.call("getFleetStatus",
                               pb.msg("FleetStatusRequest")(), timeout=2.0)
                 break
             except Exception:  # noqa: BLE001 — still binding
-                if time.time() > deadline or obs_cmd.poll() is not None:
+                if clock.now() > deadline or obs_cmd.poll() is not None:
                     obs_cmd.kill()
                     return phase_fail("obs-collector", [obs_cmd])
-                time.sleep(0.25)
+                clock.sleep(0.25)
         os.environ["EGTPU_OBS_COLLECTOR"] = f"localhost:{obs_port}"
         obs_collector.client_from_env()   # the driver streams too
         procs.append(obs_cmd)
@@ -227,7 +227,7 @@ def main(argv=None) -> int:
             f.write(manifest.to_json())
 
         # ---- phase 1: key ceremony (multi-process) ---------------------------
-        t0 = time.time()
+        t0 = clock.now()
         phases.begin("phase.key-ceremony")
         if args.chaos_guardian >= 0:
             # the COORDINATOR (launched next) needs a retry window wide
@@ -244,7 +244,7 @@ def main(argv=None) -> int:
              "-timeout", "90"] + group_flags,
             cmd_out)
         procs.append(coord)
-        time.sleep(1.5)  # let the coordinator bind
+        clock.sleep(1.5)  # let the coordinator bind
         chaos_dir = os.path.join(out, "chaos")
         guardians = []
         for i in range(args.nguardians):
@@ -282,10 +282,10 @@ def main(argv=None) -> int:
             chaos_thread.join(timeout=10)
             log.info("[1] key ceremony survived the guardian-%d chaos "
                      "restart", args.chaos_guardian)
-        log.info("[1] key ceremony took %.1fs", time.time() - t0)
+        log.info("[1] key ceremony took %.1fs", clock.now() - t0)
 
         # ---- phase 2: fake ballots + batch encryption ------------------------
-        t0 = time.time()
+        t0 = clock.now()
         phases.begin("phase.encrypt")
         pub = Publisher(out)
         for b in RandomBallotProvider(manifest, args.nballots, seed=11).ballots():
@@ -297,23 +297,23 @@ def main(argv=None) -> int:
             cmd_out)
         if not wait_all([enc], timeout=600):
             return phase_fail("encryption", [enc])
-        dt = time.time() - t0
+        dt = clock.now() - t0
         log.info("[2] encrypted %d ballots in %.1fs (%.3fs/ballot)",
                  args.nballots, dt, dt / max(args.nballots, 1))
 
         # ---- phase 3: accumulate --------------------------------------------
-        t0 = time.time()
+        t0 = clock.now()
         phases.begin("phase.tally")
         acc = RunCommand.python_module(
             "accumulate", "electionguard_tpu.cli.run_accumulate_tally",
             ["-in", record_dir, "-out", record_dir] + group_flags, cmd_out)
         if not wait_all([acc], timeout=300):
             return phase_fail("accumulate", [acc])
-        log.info("[3] tally accumulation took %.1fs", time.time() - t0)
+        log.info("[3] tally accumulation took %.1fs", clock.now() - t0)
 
         # ---- phase 3.5: mixnet (optional) -------------------------------------
         if args.mix > 0:
-            t0 = time.time()
+            t0 = clock.now()
             phases.begin("phase.mix")
             mix = RunCommand.python_module(
                 "mixnet", "electionguard_tpu.cli.run_mixnet",
@@ -322,11 +322,11 @@ def main(argv=None) -> int:
             if not wait_all([mix], timeout=600):
                 return phase_fail("mixnet", [mix])
             log.info("[3.5] %d mix stages took %.1fs", args.mix,
-                     time.time() - t0)
+                     clock.now() - t0)
 
         # ---- phase 3.5 (federated): one mix-server process per stage ---------
         if args.mix_servers > 0:
-            t0 = time.time()
+            t0 = clock.now()
             phases.begin("phase.mixfed")
             mix_port = find_free_port()
             n_servers = args.mix_servers + (1 if args.chaos_mix else 0)
@@ -338,7 +338,7 @@ def main(argv=None) -> int:
                  "-registrationTimeout", "90",
                  "-checkpointFile", os.path.join(out, "mix_checkpoint.json")]
                 + group_flags, cmd_out)
-            time.sleep(1.5)  # let the registration service bind
+            clock.sleep(1.5)  # let the registration service bind
 
             def launch_mix_server(i, env=None):
                 return RunCommand.python_module(
@@ -363,12 +363,12 @@ def main(argv=None) -> int:
                         {"method": "shuffleStage", "kind": "crash_after",
                          "on_calls": [1]}]})})
                 mix_servers.append(victim)
-                deadline = time.time() + 60
-                while time.time() < deadline:
+                deadline = clock.now() + 60
+                while clock.now() < deadline:
                     with open(mcoord.stdout_path, "rb") as f:
                         if b"registered mix server mix-0" in f.read():
                             break
-                    time.sleep(0.25)
+                    clock.sleep(0.25)
                 else:
                     return phase_fail("mixfed", [mcoord, victim])
             for i in range(len(mix_servers), n_servers):
@@ -382,10 +382,10 @@ def main(argv=None) -> int:
                 return phase_fail("mixfed", [mcoord] + mix_servers)
             log.info("[3.5] %d federated mix stages over %d server "
                      "processes took %.1fs", args.mix_servers, n_servers,
-                     time.time() - t0)
+                     clock.now() - t0)
 
         # ---- phase 4: remote decryption (multi-process) ----------------------
-        t0 = time.time()
+        t0 = clock.now()
         phases.begin("phase.decrypt")
         dec_port = find_free_port()
         decryptor = RunCommand.python_module(
@@ -395,7 +395,7 @@ def main(argv=None) -> int:
              "-timeout", "90"]
             + (["-decryptSpoiled"] if args.spoil_every else []) + group_flags,
             cmd_out)
-        time.sleep(1.5)
+        clock.sleep(1.5)
         dec_trustees = []
         trustee_files = sorted(os.listdir(trustee_dir))[:args.navailable]
         for name in trustee_files:
@@ -406,10 +406,10 @@ def main(argv=None) -> int:
                 cmd_out))
         if not wait_all([decryptor] + dec_trustees, timeout=300):
             return phase_fail("decryption", [decryptor] + dec_trustees)
-        log.info("[4] decryption took %.1fs", time.time() - t0)
+        log.info("[4] decryption took %.1fs", clock.now() - t0)
 
         # ---- phase 5: verify --------------------------------------------------
-        t0 = time.time()
+        t0 = clock.now()
         phases.begin("phase.verify")
         ver = RunCommand.python_module(
             "verifier", "electionguard_tpu.cli.run_verifier",
@@ -418,7 +418,7 @@ def main(argv=None) -> int:
         ver.show()
         if code != 0:
             return phase_fail("verify", [ver])
-        log.info("[5] verification took %.1fs", time.time() - t0)
+        log.info("[5] verification took %.1fs", clock.now() - t0)
 
         phases.end()
 
@@ -439,7 +439,7 @@ def main(argv=None) -> int:
                 return phase_fail("obs-fleet", [obs_cmd])
 
         log.info("WORKFLOW PASS: 5 phases, %d ballots, %.1fs total",
-                 args.nballots, time.time() - t_all)
+                 args.nballots, clock.now() - t_all)
         return 0
     finally:
         # best-effort teardown on EVERY exit path — including a phase

@@ -13,8 +13,9 @@ import os
 import subprocess
 import sys
 import threading
-import time
 from typing import Optional
+
+from electionguard_tpu.utils import clock
 
 
 class RunCommand:
@@ -97,7 +98,7 @@ class RunCommand:
             self.process.wait()
             for k in strip_env:
                 self._env.pop(k, None)
-            time.sleep(downtime_s)
+            clock.sleep(downtime_s)
             self.restart()
 
         t = threading.Thread(target=fire, daemon=True,
@@ -130,10 +131,10 @@ class RunCommand:
 
 def wait_all(commands: list[RunCommand], timeout: float) -> bool:
     """Wait for all commands; kill stragglers at the deadline."""
-    deadline = time.monotonic() + timeout
+    deadline = clock.monotonic() + timeout
     ok = True
     for c in commands:
-        remaining = max(0.1, deadline - time.monotonic())
+        remaining = max(0.1, deadline - clock.monotonic())
         code = c.wait_for(remaining)
         if code is None:
             c.kill()

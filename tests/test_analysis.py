@@ -23,7 +23,8 @@ from electionguard_tpu.analysis import core
 from electionguard_tpu.utils import knobs as knobs_mod
 
 ALL_PASSES = {"env-knob-registry", "jit-hygiene", "lock-discipline",
-              "no-bare-print", "rpc-contract", "secret-taint"}
+              "no-bare-print", "rpc-contract", "secret-taint",
+              "wall-clock-discipline"}
 
 
 # ---------------------------------------------------------------------------
@@ -355,6 +356,32 @@ def test_env_knob_registry_flags_missing_table(tmp_path):
     report = _run(project, ["env-knob-registry"])
     assert len(report.findings) == 1
     assert "ENV_KNOBS.md missing" in report.findings[0].message
+
+
+def test_wall_clock_discipline_fires_at_exact_lines(tmp_path):
+    project = _project(tmp_path, {
+        "serve/poller.py": """\
+            import time
+            from time import sleep as zzz
+
+            def wait():
+                t0 = time.monotonic()
+                zzz(0.5)
+                return time.time() - t0
+            """,
+        # exempt homes: the seam itself, cli/, bench harnesses
+        "utils/clock.py": "import time\nNOW = time.time()\n",
+        "cli/tool.py": "import time\ntime.sleep(1)\n",
+        "core/foo_bench.py": "import time\nt = time.perf_counter()\n",
+        # no time import at all -> never scanned for calls
+        "tally/add.py": "def add(a, b):\n    return a + b\n",
+    })
+    report = _run(project, ["wall-clock-discipline"])
+    assert [(f.path, f.line) for f in report.findings] \
+        == [("pkg/serve/poller.py", 5),
+            ("pkg/serve/poller.py", 6),
+            ("pkg/serve/poller.py", 7)]
+    assert all("utils/clock" in f.message for f in report.findings)
 
 
 def test_no_bare_print_fires_and_cli_is_exempt(tmp_path):

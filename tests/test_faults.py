@@ -48,6 +48,7 @@ from electionguard_tpu.remote.decrypting_remote import (
 from electionguard_tpu.remote.keyceremony_remote import (
     KeyCeremonyCoordinator, KeyCeremonyTrusteeServer, RemoteKeyCeremonyProxy)
 from electionguard_tpu.serve import journal as wal
+from electionguard_tpu.sim import simulation
 from electionguard_tpu.testing import faults
 from tests.test_keyceremony import tiny_manifest
 
@@ -111,56 +112,74 @@ def test_fault_rule_matching_and_sides():
 
 def test_client_injected_unavailable_is_retried_through(tgroup, fastrpc):
     """An injected UNAVAILABLE on the first attempt is absorbed by the
-    retry layer: the caller never sees the fault."""
-    coord = KeyCeremonyCoordinator(tgroup, 1, 1, port=0)
+    retry layer: the caller never sees the fault.  Runs on the virtual
+    clock — the retry backoff costs zero real time."""
     plan = faults.install(faults.FaultPlan(rules=[faults.FaultRule(
         method="registerTrustee", kind="unavailable", on_calls=(1,))]))
-    try:
-        proxy = RemoteKeyCeremonyProxy(f"localhost:{coord.port}")
-        resp = proxy.register_trustee("solo", "localhost:9", tgroup,
-                                      nonce=b"n1")
-        proxy.close()
-        assert resp.x_coordinate == 1 and not resp.error
-        # the audit log proves the fault actually fired (attempt 1),
-        # and the retry (call 2) went through clean
-        assert plan.injected == [("client", "registerTrustee", 1,
-                                  "unavailable")]
-    finally:
-        coord.shutdown(all_ok=True)
+
+    def body():
+        coord = KeyCeremonyCoordinator(tgroup, 1, 1, port=0)
+        try:
+            proxy = RemoteKeyCeremonyProxy(f"localhost:{coord.port}")
+            resp = proxy.register_trustee("solo", "localhost:9", tgroup,
+                                          nonce=b"n1")
+            proxy.close()
+            assert resp.x_coordinate == 1 and not resp.error
+            # the audit log proves the fault actually fired (attempt 1),
+            # and the retry (call 2) went through clean
+            assert plan.injected == [("client", "registerTrustee", 1,
+                                      "unavailable")]
+        finally:
+            coord.shutdown(all_ok=True)
+
+    with simulation() as sim:
+        sim.run(body)
 
 
 def test_client_injected_deadline_is_fatal_first_attempt(tgroup, fastrpc):
     """DEADLINE_EXCEEDED on a first (full-budget) attempt is a real
     timeout, not a connect hiccup — no retry."""
-    coord = KeyCeremonyCoordinator(tgroup, 1, 1, port=0)
     plan = faults.install(faults.FaultPlan(rules=[faults.FaultRule(
         method="registerTrustee", kind="deadline", on_calls=(1,))]))
-    try:
-        proxy = RemoteKeyCeremonyProxy(f"localhost:{coord.port}")
-        with pytest.raises(grpc.RpcError) as ei:
-            proxy.register_trustee("solo", "localhost:9", tgroup)
-        proxy.close()
-        assert ei.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
-        assert len(plan.injected) == 1       # exactly one attempt
-        assert coord.ready() == 0            # never reached the peer
-    finally:
-        coord.shutdown(all_ok=True)
+
+    def body():
+        coord = KeyCeremonyCoordinator(tgroup, 1, 1, port=0)
+        try:
+            proxy = RemoteKeyCeremonyProxy(f"localhost:{coord.port}")
+            with pytest.raises(grpc.RpcError) as ei:
+                proxy.register_trustee("solo", "localhost:9", tgroup)
+            proxy.close()
+            assert ei.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+            assert len(plan.injected) == 1   # exactly one attempt
+            assert coord.ready() == 0        # never reached the peer
+        finally:
+            coord.shutdown(all_ok=True)
+
+    with simulation() as sim:
+        sim.run(body)
 
 
 def test_injected_latency_delays_the_call(tgroup, fastrpc):
-    coord = KeyCeremonyCoordinator(tgroup, 1, 1, port=0)
+    """Server-side latency injection stretches VIRTUAL time, not wall
+    time: the call observes the delay, the test doesn't."""
     faults.install(faults.FaultPlan(rules=[faults.FaultRule(
         method="registerTrustee", kind="latency", latency_s=0.25)]))
-    try:
-        proxy = RemoteKeyCeremonyProxy(f"localhost:{coord.port}")
-        t0 = time.monotonic()
-        resp = proxy.register_trustee("solo", "localhost:9", tgroup,
-                                      nonce=b"n1")
-        proxy.close()
-        assert time.monotonic() - t0 >= 0.25
-        assert resp.x_coordinate == 1
-    finally:
-        coord.shutdown(all_ok=True)
+
+    with simulation() as sim:
+        def body():
+            coord = KeyCeremonyCoordinator(tgroup, 1, 1, port=0)
+            try:
+                proxy = RemoteKeyCeremonyProxy(f"localhost:{coord.port}")
+                t0 = sim.now
+                resp = proxy.register_trustee("solo", "localhost:9",
+                                              tgroup, nonce=b"n1")
+                proxy.close()
+                assert sim.now - t0 >= 0.25
+                assert resp.x_coordinate == 1
+            finally:
+                coord.shutdown(all_ok=True)
+
+        sim.run(body)
 
 
 def test_server_drop_response_replays_idempotently(tgroup, fastrpc):
@@ -169,18 +188,23 @@ def test_server_drop_response_replays_idempotently(tgroup, fastrpc):
     back the original answer, not a duplicate registration."""
     plan = faults.install(faults.FaultPlan(rules=[faults.FaultRule(
         method="registerTrustee", kind="drop_response", on_calls=(1,))]))
-    coord = KeyCeremonyCoordinator(tgroup, 1, 1, port=0)  # wrapped server
-    try:
-        proxy = RemoteKeyCeremonyProxy(f"localhost:{coord.port}")
-        resp = proxy.register_trustee("solo", "localhost:9", tgroup,
-                                      nonce=b"n1")
-        proxy.close()
-        assert resp.x_coordinate == 1 and not resp.error
-        assert coord.ready() == 1            # committed exactly once
-        assert ("server", "registerTrustee", 1,
-                "drop_response") in plan.injected
-    finally:
-        coord.shutdown(all_ok=True)
+
+    def body():
+        coord = KeyCeremonyCoordinator(tgroup, 1, 1, port=0)  # wrapped
+        try:
+            proxy = RemoteKeyCeremonyProxy(f"localhost:{coord.port}")
+            resp = proxy.register_trustee("solo", "localhost:9", tgroup,
+                                          nonce=b"n1")
+            proxy.close()
+            assert resp.x_coordinate == 1 and not resp.error
+            assert coord.ready() == 1        # committed exactly once
+            assert ("server", "registerTrustee", 1,
+                    "drop_response") in plan.injected
+        finally:
+            coord.shutdown(all_ok=True)
+
+    with simulation() as sim:
+        sim.run(body)
 
 
 # =====================================================================
@@ -336,35 +360,43 @@ def test_decryption_demotes_dead_trustee_when_quorum_holds(
     victim: dict = {}
     plan = faults.FaultPlan(rules=[faults.FaultRule(
         method="directDecrypt", kind="crash_after", on_calls=(1,))])
-    plan.crash_cb = lambda _m: threading.Timer(
-        0.05, lambda: victim["server"].server.stop(grace=0)).start()
+    # the "process" dies with the committed call: its server drops
+    # synchronously (the injected abort is the torn-connection error
+    # the client sees)
+    plan.crash_cb = lambda _m: victim["server"].server.stop(grace=0)
     faults.install(plan)
-    coord, servers = _spin_decryption(tgroup, dec_election)
-    victim["server"] = servers[0]
-    try:
-        d = Decryption(tgroup, dec_election["init"], coord.proxies, [],
-                       dec_election["dlog"])
-        out = d.decrypt(dec_election["tally"])
-        got = [s.tally for s in out.contests[0].selections]
-        assert got == dec_election["votes"]
-        # guardian-0 was demoted and reconstructed, mid-run
-        assert d.missing == ["guardian-0"]
-        assert [t.id for t in d.trustees] == ["guardian-1", "guardian-2"]
-        for s in out.contests[0].selections:
-            by_id = {sh.guardian_id: sh for sh in s.shares}
-            assert set(by_id) == {"guardian-0", "guardian-1",
-                                  "guardian-2"}
-            # the reconstructed share carries its compensating parts
-            assert by_id["guardian-0"].proof is None
-            assert set(by_id["guardian-0"].recovered_parts) == \
-                {"guardian-1", "guardian-2"}
-        assert ("server", "directDecrypt", 1,
-                "crash_after") in plan.injected
-    finally:
-        faults.clear()
-        coord.shutdown(all_ok=True)
-        for s in servers:
-            s.shutdown()
+
+    def body():
+        coord, servers = _spin_decryption(tgroup, dec_election)
+        victim["server"] = servers[0]
+        try:
+            d = Decryption(tgroup, dec_election["init"], coord.proxies,
+                           [], dec_election["dlog"])
+            out = d.decrypt(dec_election["tally"])
+            got = [s.tally for s in out.contests[0].selections]
+            assert got == dec_election["votes"]
+            # guardian-0 was demoted and reconstructed, mid-run
+            assert d.missing == ["guardian-0"]
+            assert [t.id for t in d.trustees] == ["guardian-1",
+                                                  "guardian-2"]
+            for s in out.contests[0].selections:
+                by_id = {sh.guardian_id: sh for sh in s.shares}
+                assert set(by_id) == {"guardian-0", "guardian-1",
+                                      "guardian-2"}
+                # the reconstructed share carries its compensating parts
+                assert by_id["guardian-0"].proof is None
+                assert set(by_id["guardian-0"].recovered_parts) == \
+                    {"guardian-1", "guardian-2"}
+            assert ("server", "directDecrypt", 1,
+                    "crash_after") in plan.injected
+        finally:
+            faults.clear()
+            coord.shutdown(all_ok=True)
+            for s in servers:
+                s.shutdown()
+
+    with simulation() as sim:
+        sim.run(body)
 
 
 def test_decryption_fails_cleanly_below_quorum(tgroup, dec_election,
@@ -372,22 +404,27 @@ def test_decryption_fails_cleanly_below_quorum(tgroup, dec_election,
     """Acceptance (b) failure half: with two of three guardians dead the
     survivors cannot meet quorum 2 — the run must fail with an explicit
     quorum error after bounded retries, not hang or emit a bad tally."""
-    coord, servers = _spin_decryption(tgroup, dec_election)
-    try:
-        servers[0].server.stop(grace=0)
-        servers[1].server.stop(grace=0)
-        d = Decryption(tgroup, dec_election["init"], coord.proxies, [],
-                       dec_election["dlog"])
-        t0 = time.monotonic()
-        with pytest.raises(DecryptionError,
-                           match="no longer meet quorum"):
-            d.decrypt(dec_election["tally"])
-        # bounded: two demote rounds of fast retries, not a hang
-        assert time.monotonic() - t0 < 30
-    finally:
-        coord.shutdown(all_ok=True)
-        for s in servers:
-            s.shutdown()
+    with simulation() as sim:
+        def body():
+            coord, servers = _spin_decryption(tgroup, dec_election)
+            try:
+                servers[0].server.stop(grace=0)
+                servers[1].server.stop(grace=0)
+                d = Decryption(tgroup, dec_election["init"],
+                               coord.proxies, [], dec_election["dlog"])
+                t0 = sim.now
+                with pytest.raises(DecryptionError,
+                                   match="no longer meet quorum"):
+                    d.decrypt(dec_election["tally"])
+                # bounded: two demote rounds of fast retries (virtual
+                # seconds), not a hang
+                assert sim.now - t0 < 30
+            finally:
+                coord.shutdown(all_ok=True)
+                for s in servers:
+                    s.shutdown()
+
+        sim.run(body)
 
 
 # =====================================================================

@@ -369,11 +369,10 @@ def test_collector_heartbeat_death_red_window_and_recovery(tmp_path,
     (edge-triggered) well inside any rpc deadline class, the process is
     flagged DEAD and the fleet goes red — then green again once the
     death ages past dead_red_for_s."""
-    import time
-
     from electionguard_tpu.obs import collector as coll
+    from electionguard_tpu.utils import clock
     c = coll.ObsCollector(str(tmp_path), slo_config=_quiet_slo())
-    t0 = time.monotonic()
+    t0 = clock.monotonic()
     c.push_telemetry(_batch("victim", 4242, status="SERVING",
                             phase="mix-stage-0"))
     assert c.evaluate_once(now=t0 + 1.0) == []
@@ -403,11 +402,10 @@ def test_collector_heartbeat_death_red_window_and_recovery(tmp_path,
 def test_collector_exiting_goodbye_is_not_a_death(tmp_path, clean_trace):
     """The atexit goodbye (status EXITING) followed by silence means a
     clean shutdown: state EXITED, no alert, fleet stays green."""
-    import time
-
     from electionguard_tpu.obs import collector as coll
+    from electionguard_tpu.utils import clock
     c = coll.ObsCollector(str(tmp_path), slo_config=_quiet_slo())
-    t0 = time.monotonic()
+    t0 = clock.monotonic()
     c.push_telemetry(_batch("worker", 77, status="EXITING"))
     assert c.evaluate_once(now=t0 + 5.0) == []
     st = c.get_fleet_status()

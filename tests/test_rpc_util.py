@@ -3,15 +3,20 @@ previously covered only implicitly through the e2e suites): full-jitter
 exponential backoff, deadline exhaustion mid-backoff, the bounded
 connect-window DEADLINE reclassification, the retry budget, and the
 EGTPU_RPC_RETRIES=1 reference posture.
-"""
 
-import time
+The dial-a-dead-port cases run inside the deterministic simulator:
+wait_for_ready connect windows and retry pacing elapse in VIRTUAL time,
+so the suite spends no real seconds blocking on sockets that will never
+answer — and the elapsed-time assertions are exact, not flake-prone
+wall-clock bounds.
+"""
 
 import grpc
 import pytest
 
 from electionguard_tpu.publish import pb
 from electionguard_tpu.remote import rpc_util
+from electionguard_tpu.sim import simulation
 
 
 def _dead_stub():
@@ -45,17 +50,33 @@ def sleeps(monkeypatch):
     return rec
 
 
+def _call_dead(pol=None, timeout=30.0):
+    """One Stub.call against a dead peer inside a fresh simulation;
+    returns the virtual seconds the call consumed."""
+    with simulation() as sim:
+        box = {}
+
+        def body():
+            stub, channel = _dead_stub()
+            t0 = sim.now
+            try:
+                with pytest.raises(grpc.RpcError):
+                    stub.call("registerTrustee", _req(), timeout=timeout,
+                              policy=pol)
+            finally:
+                channel.close()
+            box["virtual_s"] = sim.now - t0
+
+        sim.run(body)
+        return box["virtual_s"]
+
+
 def test_full_jitter_exponential_backoff(sleeps):
     """Waits double from base to cap, drawn from U(0, bound) — not the
     old synchronized-herd linear ladder."""
     pol = rpc_util.RetryPolicy(attempts=4, base_wait=0.1, max_wait=0.3,
                                connect_window=0.05, budget=100.0)
-    stub, channel = _dead_stub()
-    try:
-        with pytest.raises(grpc.RpcError):
-            stub.call("registerTrustee", _req(), timeout=30, policy=pol)
-    finally:
-        channel.close()
+    _call_dead(pol)
     # 4 attempts -> 3 backoffs; bounds 0.1, 0.2, then capped at 0.3
     assert sleeps["sleeps"] == [0.1, 0.2, 0.3]
     # every draw was full-jitter: U(0, bound)
@@ -68,15 +89,9 @@ def test_deadline_exhaustion_mid_backoff(sleeps):
     not slept: the call raises immediately with the real error."""
     pol = rpc_util.RetryPolicy(attempts=10, base_wait=5.0, max_wait=60.0,
                                connect_window=0.05, budget=1000.0)
-    stub, channel = _dead_stub()
-    t0 = time.monotonic()
-    try:
-        with pytest.raises(grpc.RpcError):
-            stub.call("registerTrustee", _req(), timeout=1.5, policy=pol)
-    finally:
-        channel.close()
+    virtual_s = _call_dead(pol, timeout=1.5)
     assert sleeps["sleeps"] == []          # never slept into the deadline
-    assert time.monotonic() - t0 < 1.4     # and never blocked out to it
+    assert virtual_s < 1.4                 # and never blocked out to it
 
 
 def test_retry_budget_bounds_total_backoff(sleeps):
@@ -84,12 +99,7 @@ def test_retry_budget_bounds_total_backoff(sleeps):
     transient failure is raised instead of retried."""
     pol = rpc_util.RetryPolicy(attempts=10, base_wait=0.1, max_wait=10.0,
                                connect_window=0.05, budget=0.15)
-    stub, channel = _dead_stub()
-    try:
-        with pytest.raises(grpc.RpcError):
-            stub.call("registerTrustee", _req(), timeout=30, policy=pol)
-    finally:
-        channel.close()
+    _call_dead(pol)
     # first backoff (0.1) fits the 0.15 budget; the second (0.2) does not
     assert sleeps["sleeps"] == [0.1]
 
@@ -114,17 +124,10 @@ def test_connect_window_bounds_each_retry(sleeps):
     long caller deadline."""
     pol = rpc_util.RetryPolicy(attempts=3, base_wait=0.01, max_wait=0.01,
                                connect_window=0.3, budget=100.0)
-    stub, channel = _dead_stub()
-    t0 = time.monotonic()
-    try:
-        with pytest.raises(grpc.RpcError):
-            stub.call("registerTrustee", _req(), timeout=60, policy=pol)
-    finally:
-        channel.close()
-    elapsed = time.monotonic() - t0
-    # 2 bounded wfr waits (~0.3 s each) + fail-fast first attempt: the
-    # 60 s deadline was never consumed
-    assert elapsed < 5.0
+    virtual_s = _call_dead(pol, timeout=60)
+    # 2 bounded wfr waits (~0.3 virtual s each) + fail-fast first
+    # attempt: the 60 s deadline was never consumed
+    assert virtual_s < 5.0
     assert len(sleeps["sleeps"]) == 2
 
 
@@ -133,15 +136,9 @@ def test_retries_1_restores_reference_posture(sleeps, monkeypatch):
     attempt, no backoff, immediate failure."""
     monkeypatch.setenv("EGTPU_RPC_RETRIES", "1")
     assert rpc_util.retry_policy().attempts == 1
-    stub, channel = _dead_stub()
-    t0 = time.monotonic()
-    try:
-        with pytest.raises(grpc.RpcError):
-            stub.call("registerTrustee", _req(), timeout=20)
-    finally:
-        channel.close()
+    virtual_s = _call_dead(timeout=20)
     assert sleeps["sleeps"] == []
-    assert time.monotonic() - t0 < 2.0
+    assert virtual_s < 2.0
 
 
 def test_deadline_classes_env_tunable(monkeypatch):

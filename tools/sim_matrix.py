@@ -1,0 +1,208 @@
+#!/usr/bin/env python3
+"""sim_matrix — the deterministic-simulation seed sweep runner.
+
+Usage::
+
+    python tools/sim_matrix.py --seeds 20            # quick sweep
+    python tools/sim_matrix.py --seeds 1000 --json   # + SIM_RESULTS.json
+    python tools/sim_matrix.py --seeds 1000 --procs 8
+    python tools/sim_matrix.py --replay '<schedule json>' --seed 17
+
+Each seed is one full virtual-cluster run (key ceremony → encryption
+serving → federated mix → compensated decryption → independent
+verification) under a seed-derived fault schedule, checked by every
+oracle.  Failing seeds are shrunk to minimal replayable schedules and
+recorded — ``--json`` writes the tracked SIM_RESULTS.json artifact with
+the seeds run, oracle failures, shrunk repros, and honest throughput.
+
+``--procs N`` shards the seed range over N worker subprocesses (the
+per-seed cost is JAX dispatch-bound, so sweep throughput scales with
+cores).  Workers share the persistent JAX compilation cache, so only
+the first sweep on a machine pays the compile warmup.
+
+Trace hashes are deterministic per process; to compare them across
+processes or machines, pin PYTHONHASHSEED.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import asdict
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+# the sweep re-jits the same programs every process: the persistent
+# compilation cache turns the per-process warmup from ~60s into ~15s
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(), "egtpu-jax-cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
+
+
+def _config(fast: bool):
+    from electionguard_tpu.sim.cluster import SimConfig
+    return SimConfig(n_mix_stages=1) if fast else SimConfig()
+
+
+def _sweep(start: int, count: int, fast: bool,
+           shrink_budget: int | None) -> dict:
+    """Run seeds [start, start+count) in THIS process; shrink failures."""
+    from electionguard_tpu.sim.explore import run_sim
+    from electionguard_tpu.sim.shrink import shrink
+
+    cfg = _config(fast)
+    ok = 0
+    failures = []
+    for seed in range(start, start + count):
+        r = run_sim(seed, config=cfg)
+        if r.ok:
+            ok += 1
+            continue
+        entry = {
+            "seed": seed,
+            "violations": r.violations,
+            "schedule": [asdict(e) for e in r.schedule],
+            "trace_hash": r.trace_hash,
+        }
+        if r.schedule:
+            res = shrink(seed, r.schedule, config=cfg,
+                         budget=shrink_budget)
+            entry["shrunk_schedule"] = [asdict(e) for e in res.schedule]
+            entry["shrunk_violations"] = res.violations
+            entry["shrink_runs"] = res.runs
+            entry["shrink_exhausted"] = res.exhausted
+        failures.append(entry)
+        print(f"FAIL {r.summary()}", file=sys.stderr)
+    return {"ok": ok, "failures": failures}
+
+
+def _sweep_procs(start: int, count: int, procs: int, fast: bool,
+                 shrink_budget: int | None) -> dict:
+    """Shard the range over worker subprocesses, merge their chunks."""
+    per = (count + procs - 1) // procs
+    jobs = []
+    tmpdir = tempfile.mkdtemp(prefix="egtpu-sim-matrix-")
+    for i in range(procs):
+        s = start + i * per
+        n = min(per, start + count - s)
+        if n <= 0:
+            break
+        out = os.path.join(tmpdir, f"chunk-{i}.json")
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--start", str(s), "--seeds", str(n),
+               "--chunk-worker", out]
+        if fast:
+            cmd.append("--fast")
+        if shrink_budget is not None:
+            cmd += ["--shrink-budget", str(shrink_budget)]
+        jobs.append((subprocess.Popen(cmd), out))
+    merged = {"ok": 0, "failures": []}
+    rc = 0
+    for proc, out in jobs:
+        rc |= proc.wait()
+        if os.path.exists(out):
+            chunk = json.load(open(out))
+            merged["ok"] += chunk["ok"]
+            merged["failures"].extend(chunk["failures"])
+    if rc:
+        raise SystemExit(f"a sweep worker failed (exit {rc})")
+    merged["failures"].sort(key=lambda f: f["seed"])
+    return merged
+
+
+def _replay(seed: int, schedule_json: str, fast: bool) -> int:
+    from electionguard_tpu.sim.explore import run_sim
+    from electionguard_tpu.sim.schedule import from_json
+    r = run_sim(seed, schedule=from_json(schedule_json),
+                config=_config(fast))
+    print(r.summary())
+    print(f"trace_hash={r.trace_hash}")
+    return 0 if r.ok else 1
+
+
+def main(argv=None) -> int:
+    from electionguard_tpu.utils import knobs
+
+    ap = argparse.ArgumentParser(
+        prog="sim_matrix", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--seeds", type=int,
+                    default=knobs.get_int("EGTPU_SIM_SEEDS"),
+                    help="how many seeds to sweep")
+    ap.add_argument("--start", type=int,
+                    default=knobs.get_int("EGTPU_SIM_SEED"),
+                    help="first seed")
+    ap.add_argument("--procs", type=int, default=1,
+                    help="worker subprocesses to shard the range over")
+    ap.add_argument("--fast", action="store_true",
+                    help="1 mix stage instead of 2 (faster, less "
+                         "cascade coverage)")
+    ap.add_argument("--shrink-budget", type=int, default=None,
+                    help="probe-run cap per failing-schedule shrink")
+    ap.add_argument("--json", nargs="?", const=os.path.join(
+                        REPO_ROOT, "SIM_RESULTS.json"), default=None,
+                    metavar="PATH",
+                    help="write the sweep artifact (default "
+                         "SIM_RESULTS.json at the repo root)")
+    ap.add_argument("--replay", metavar="SCHEDULE_JSON", default=None,
+                    help="replay one schedule under --start's seed "
+                         "instead of sweeping")
+    ap.add_argument("--chunk-worker", metavar="PATH", default=None,
+                    help=argparse.SUPPRESS)   # internal: emit one chunk
+    args = ap.parse_args(argv)
+
+    if args.replay is not None:
+        return _replay(args.start, args.replay, args.fast)
+
+    t0 = time.time()
+    if args.chunk_worker:
+        chunk = _sweep(args.start, args.seeds, args.fast,
+                       args.shrink_budget)
+        with open(args.chunk_worker, "w") as f:
+            json.dump(chunk, f)
+        return 0
+    if args.procs > 1:
+        merged = _sweep_procs(args.start, args.seeds, args.procs,
+                              args.fast, args.shrink_budget)
+    else:
+        merged = _sweep(args.start, args.seeds, args.fast,
+                        args.shrink_budget)
+    wall = time.time() - t0
+
+    result = {
+        "generated_by": "tools/sim_matrix.py",
+        "seed_start": args.start,
+        "n_seeds": args.seeds,
+        "profile": "fast" if args.fast else "default",
+        "procs": args.procs,
+        "ok": merged["ok"],
+        "failed": len(merged["failures"]),
+        "failures": merged["failures"],
+        "wall_s": round(wall, 1),
+        "schedules_per_s": round(args.seeds / wall, 2) if wall else None,
+    }
+    print(f"{merged['ok']}/{args.seeds} seeds green, "
+          f"{len(merged['failures'])} failures, {wall:.1f}s "
+          f"({result['schedules_per_s']} schedules/s)")
+    for f in merged["failures"]:
+        shrunk = f.get("shrunk_schedule")
+        print(f"  seed {f['seed']}: {f['violations'][0]}"
+              + (f"  [shrunk to {len(shrunk)} events]" if shrunk else ""))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(result, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {os.path.relpath(args.json)}")
+    return 1 if merged["failures"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
