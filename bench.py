@@ -522,6 +522,18 @@ def run_workload(nballots: int, n_chips: int) -> None:
         RESULT["obs_error"] = f"{type(e).__name__}: {e}"
     flush_partial()
 
+    # ---- fabric phase: router overhead + fleet ballots/s at 1/2/4 -------
+    # the serving fabric's two numbers: the latency the front door adds
+    # over a direct worker hit, and what an in-process fleet sustains as
+    # workers are added — the routing plane, not modexp throughput, so
+    # it pins the tiny group and stays best-effort like mixfed/obs
+    try:
+        _bench_fabric()
+    except Exception as e:  # noqa: BLE001 — diagnostics
+        note(f"fabric phase failed: {type(e).__name__}: {e}")
+        RESULT["fabric_error"] = f"{type(e).__name__}: {e}"
+    flush_partial()
+
     # ---- bignum phase: per-backend primitive rates (cios/ntt/pallas) ----
     # the roofline's raw numbers — mulmod/powmod/fixed rows through the
     # shared core.bignum_bench helper, labeled requested-vs-effective.
@@ -790,6 +802,152 @@ def _bench_obs(n_batches: int = 20, batch_spans: int = 1000,
             channel.close()
         collector.stop()
         server.stop(grace=0)
+        shutil.rmtree(out, ignore_errors=True)
+
+
+def _bench_fabric(fleets=(1, 2, 4), nsingles: int = 24,
+                  per_client: int = 16) -> None:
+    """Serving-fabric plane: (a) the p50 latency penalty the router's
+    forward hop adds over hitting a worker directly, and (b) fleet
+    ballots/s at 1/2/4 in-process workers behind one router (3 closed-
+    loop clients per worker, full-bucket batch rpcs).  Everything runs
+    in one process on the tiny group — this measures the routing plane
+    (forwarding, least-depth selection, health bookkeeping), so on a
+    host with few cores the curve is expected to flatten once the
+    workers saturate the CPU; tools/scale_run.py --fabric is the
+    subprocess drill with a pinned device leg."""
+    import shutil
+    import statistics
+    import tempfile
+    import threading
+    from dataclasses import replace as dc_replace
+
+    from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+    from electionguard_tpu.core.group import tiny_group
+    from electionguard_tpu.fabric import manifest as fab_manifest
+    from electionguard_tpu.fabric.router import EncryptionRouter
+    from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+    from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+    from electionguard_tpu.publish.election_record import ElectionConfig
+    from electionguard_tpu.remote import rpc_util
+    from electionguard_tpu.serve.service import (EncryptionClient,
+                                                 EncryptionService)
+    from electionguard_tpu.workflow.e2e import sample_manifest
+
+    g = tiny_group()
+    manifest = sample_manifest(1, 2)
+    trustees = [KeyCeremonyTrustee(g, "guardian-0", 1, 1)]
+    init = key_ceremony_exchange(trustees, g).make_election_initialized(
+        ElectionConfig(manifest, 1, 1), {"created_by": "bench"})
+    out = tempfile.mkdtemp(prefix="bench_fabric_")
+
+    def make_worker(router, sid_dir, wid):
+        kp = fab_manifest.ManifestKeypair.generate(g)
+        port = rpc_util.find_free_port()
+        ch = rpc_util.make_channel(router.url)
+        try:
+            from electionguard_tpu.publish import pb
+            resp = rpc_util.Stub(ch, "FabricRegistrationService").call(
+                "registerEncryptionWorker",
+                pb.RegisterEncryptionWorkerRequest(
+                    worker_id=wid, remote_url=f"localhost:{port}",
+                    group_fingerprint=g.fingerprint(),
+                    registration_nonce=os.urandom(16),
+                    manifest_public_key=kp.public.value.to_bytes(
+                        (kp.public.value.bit_length() + 7) // 8 or 1,
+                        "big")))
+        finally:
+            ch.close()
+        return EncryptionService(
+            init, g, port=port, out_dir=os.path.join(out, sid_dir),
+            max_batch=8, max_wait_ms=5, shard_id=resp.shard_id,
+            worker_id=wid, chain_seed=fab_manifest.shard_chain_seed(
+                init.manifest_hash, resp.shard_id),
+            manifest_keypair=kp)
+
+    def p50_singles(url, ballots):
+        client = EncryptionClient(url, g)
+        try:
+            client.encrypt(ballots[0])  # warm the channel
+            lat = []
+            for b in ballots[1:]:
+                t0 = time.perf_counter()
+                assert client.encrypt(b) is not None
+                lat.append((time.perf_counter() - t0) * 1e3)
+            return statistics.median(lat)
+        finally:
+            client.close()
+
+    try:
+        # -- (a) router overhead: same worker config, direct vs fronted --
+        ballots = list(RandomBallotProvider(manifest, nsingles + 1,
+                                            seed=31).ballots())
+        direct = EncryptionService(init, g, port=0,
+                                   out_dir=os.path.join(out, "direct"),
+                                   max_batch=8, max_wait_ms=5)
+        p50_direct = p50_singles(f"localhost:{direct.port}", ballots)
+        direct.shutdown()
+        router = EncryptionRouter(g, health_interval=0.5)
+        svc = make_worker(router, "fronted", "wf")
+        router.wait_for_workers(1, timeout=60, live=True)
+        fronted_ballots = [dc_replace(b, ballot_id="f-" + b.ballot_id)
+                           for b in ballots]
+        p50_router = p50_singles(router.url, fronted_ballots)
+        svc.shutdown()
+        router.shutdown()
+        RESULT.update(
+            fabric_direct_p50_ms=round(p50_direct, 2),
+            fabric_router_p50_ms=round(p50_router, 2),
+            fabric_router_overhead_ms=round(p50_router - p50_direct, 2),
+        )
+        note(f"fabric router hop: direct p50 {p50_direct:.2f}ms -> "
+             f"fronted {p50_router:.2f}ms "
+             f"({p50_router - p50_direct:+.2f}ms)")
+
+        # -- (b) fleet curve: 3 closed-loop clients per worker ------------
+        for w in fleets:
+            router = EncryptionRouter(g, health_interval=0.5)
+            svcs = [make_worker(router, f"x{w}-s{i}", f"x{w}w{i}")
+                    for i in range(w)]
+            router.wait_for_workers(w, timeout=60, live=True)
+            nclients = 3 * w
+            protos = list(RandomBallotProvider(
+                manifest, per_client, seed=77).ballots())
+            done = []
+
+            def one_client(ci):
+                client = EncryptionClient(router.url, g)
+                try:
+                    mine = [dc_replace(b, ballot_id=f"c{ci}-{b.ballot_id}")
+                            for b in protos]
+                    for k in range(0, len(mine), 8):
+                        res = client.encrypt_batch(mine[k:k + 8])
+                        assert all(e is not None for e, _ in res)
+                    done.append(len(mine))
+                finally:
+                    client.close()
+
+            threads = [threading.Thread(target=one_client, args=(ci,),
+                                        daemon=True)
+                       for ci in range(nclients)]
+            t0 = time.time()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            dt = time.time() - t0
+            for s in svcs:
+                s.shutdown()
+            router.shutdown()
+            total = sum(done)
+            assert total == nclients * per_client, \
+                f"fleet x{w}: {total}/{nclients * per_client}"
+            rate = total / max(dt, 1e-9)
+            RESULT[f"fabric_{w}w_ballots_per_s"] = round(rate, 1)
+            note(f"fabric fleet x{w}: {total} ballots in {dt:.2f}s "
+                 f"({rate:.1f}/s)")
+        RESULT["phases_done"] = RESULT.get("phases_done", "") + " fabric"
+    finally:
         shutil.rmtree(out, ignore_errors=True)
 
 

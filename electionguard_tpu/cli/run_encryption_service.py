@@ -61,6 +61,14 @@ def main(argv=None) -> int:
                     default=None,
                     help="serve Prometheus text metrics on this HTTP "
                          "port (0 = ephemeral; also via EGTPU_OBS_HTTP)")
+    ap.add_argument("-router", default=None,
+                    help="fabric mode: reverse-dial this router "
+                         "(host:port), own one shard of the fleet's "
+                         "code chain under a signed manifest")
+    ap.add_argument("-workerId", dest="worker_id", default=None,
+                    help="fabric: stable worker identity; a relaunch "
+                         "with the same id reclaims its shard "
+                         "(default: basename of -out)")
     add_group_flag(ap)
     args = ap.parse_args(argv)
 
@@ -69,6 +77,31 @@ def main(argv=None) -> int:
 
     from electionguard_tpu.serve.service import EncryptionService
     seed = group.int_to_q(42) if args.fixed_nonces else None
+    # fabric mode: register BEFORE the service exists — the shard id
+    # decides the chain seed and the requeued-ids list decides which
+    # journal entries recovery must tombstone instead of replay
+    shard_kw = {}
+    if args.router:
+        from electionguard_tpu.fabric import manifest as fab_manifest
+        from electionguard_tpu.fabric.router import register_worker
+        from electionguard_tpu.remote import rpc_util
+        worker_id = args.worker_id or \
+            os.path.basename(os.path.normpath(args.output))
+        keypair = fab_manifest.ManifestKeypair.generate(group)
+        port = args.port or rpc_util.find_free_port()
+        kval = keypair.public.value
+        shard_id, requeued = register_worker(
+            args.router, group, worker_id, port,
+            manifest_public_key=kval.to_bytes(
+                (kval.bit_length() + 7) // 8 or 1, "big"))
+        log.info("registered with router %s as shard %d (%d requeued "
+                 "ids to skip)", args.router, shard_id, len(requeued))
+        args.port = port
+        shard_kw = dict(
+            shard_id=shard_id, worker_id=worker_id,
+            chain_seed=fab_manifest.shard_chain_seed(init.manifest_hash,
+                                                     shard_id),
+            skip_ballot_ids=requeued, manifest_keypair=keypair)
     # chaos hook for the SIGKILL recovery test: wedge the device-owner
     # worker after N encrypted ballots so admitted-but-unpublished
     # ballots pile up deterministically in the (journaled) queue
@@ -77,6 +110,20 @@ def main(argv=None) -> int:
         hold_after = int(os.environ["EGTPU_CHAOS_HOLD_AFTER_BALLOTS"])
         log.warning("CHAOS: worker will wedge after %d ballots",
                     hold_after)
+    # install the drain handlers BEFORE the (slow: prewarm compiles)
+    # service construction: a SIGTERM that lands mid-startup must still
+    # end in a graceful drain — the signed shard manifest is only
+    # written on drain, and a fabric relaunch can be terminated moments
+    # after it starts (chaos drill: SIGKILL -> restart -> fleet drain)
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        log.info("signal %d: draining", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
     sw = Stopwatch()
     with maybe_profile("serve"):
         service = EncryptionService(
@@ -85,21 +132,12 @@ def main(argv=None) -> int:
             max_queue=args.max_queue, seed=seed,
             timestamp=args.timestamp,
             prewarm=not args.no_prewarm, hold_after=hold_after,
-            metrics_http_port=args.metrics_port)
+            metrics_http_port=args.metrics_port, **shard_kw)
         log.info("serving on port %d (startup took %.2fs)", service.port,
                  sw.elapsed())
         if service.metrics_http_port is not None:
             log.info("prometheus metrics on http://127.0.0.1:%d/metrics",
                      service.metrics_http_port)
-
-        stop = threading.Event()
-
-        def _on_signal(signum, frame):
-            log.info("signal %d: draining", signum)
-            stop.set()
-
-        signal.signal(signal.SIGTERM, _on_signal)
-        signal.signal(signal.SIGINT, _on_signal)
         stop.wait()
         service.drain()
     n = service.metrics.get("ballots_encrypted")

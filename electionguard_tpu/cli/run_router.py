@@ -1,0 +1,77 @@
+"""Fabric router binary: the fleet's one front door.
+
+Serves the ``BallotEncryptionService`` surface (clients point here
+unchanged) and ``FabricRegistrationService`` for the workers' reverse
+dial.  Requests fan out to the least-loaded live worker; membership is
+driven by the background health poll (eviction after
+``EGTPU_FABRIC_EVICT_AFTER`` consecutive misses, readmission on the next
+success).  No record is written here — each worker publishes its own
+shard record; ``tools/merge_record.py`` (or ``workflow/e2e.py
+-fabricWorkers``) folds them into the one verifiable merged record.
+
+Run:  python -m electionguard_tpu.cli.run_router -port 17710 \
+          -minWorkers 2 -group tiny
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from electionguard_tpu.cli.common import (Stopwatch, add_group_flag,
+                                          resolve_group, setup_logging)
+
+
+def main(argv=None) -> int:
+    log = setup_logging("RunRouter")
+    ap = argparse.ArgumentParser("RunRouter")
+    ap.add_argument("-port", type=int, default=17710,
+                    help="front-door + registration gRPC port "
+                         "(0 = pick a free one)")
+    ap.add_argument("-minWorkers", dest="min_workers", type=int, default=0,
+                    help="block startup until this many workers are LIVE "
+                         "(registered and health-checked); 0 = serve "
+                         "immediately")
+    ap.add_argument("-registrationTimeout", dest="reg_timeout",
+                    type=float, default=300.0,
+                    help="-minWorkers wait bound, seconds")
+    add_group_flag(ap)
+    args = ap.parse_args(argv)
+
+    group = resolve_group(args)
+    from electionguard_tpu.fabric.router import EncryptionRouter
+    sw = Stopwatch()
+    router = EncryptionRouter(group, port=args.port)
+    log.info("router front door on port %d (startup took %.2fs)",
+             router.port, sw.elapsed())
+    if args.min_workers:
+        if not router.wait_for_workers(args.min_workers,
+                                       timeout=args.reg_timeout, live=True):
+            log.error("only %d of %d workers live within %.0fs: %s",
+                      sum(1 for s in router.snapshot() if s["live"]),
+                      args.min_workers, args.reg_timeout, router.snapshot())
+            router.shutdown()
+            return 1
+        log.info("%d workers live; routing", args.min_workers)
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        log.info("signal %d: shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    stop.wait()
+    for s in router.snapshot():
+        log.info("shard %d (%s): forwarded=%d requeued=%d live=%s",
+                 s["shard_id"], s["worker_id"], s["forwarded"],
+                 s["requeued"], s["live"])
+    router.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,6 +45,9 @@ def _feeder_worker(wargs):
     group = resolve_group(ns)
     consumer = Consumer(record_dir, group)
     record = ElectionRecord(consumer.read_election_initialized())
+    # shard manifests flip the V6 bookkeeping into segment mode — every
+    # feeder must agree on which mode the record is in
+    record.shard_manifests = consumer.read_shard_manifests()
     v = Verifier(record, group, chunk_size=chunk_size)
     from electionguard_tpu.verify.verifier import (VerificationResult,
                                                    _BallotAggregates)
@@ -119,6 +122,10 @@ def main(argv=None) -> int:
             record.decryption_result = consumer.read_decryption_result()
         record.spoiled_ballot_tallies = list(
             consumer.iterate_spoiled_ballot_tallies())
+        record.shard_manifests = consumer.read_shard_manifests()
+        if record.shard_manifests:
+            log.info("record carries %d shard manifests (merged fleet "
+                     "record)", len(record.shard_manifests))
         if consumer.has_mix_stages():
             # mix stages are O(cast ballots) resident by design — the
             # cascade's working set IS the row matrix

@@ -1,0 +1,14 @@
+"""Horizontally sharded serving fabric.
+
+Turns the single encryption worker of ``serve/`` into a fleet: a router
+process speaks the ``BallotEncryptionService`` surface as the front door
+and fans requests out to N worker processes (``fabric/router.py``), each
+running its own contiguous ballot-code chain under a signed shard
+manifest (``fabric/manifest.py``); ``fabric/merge.py`` folds the N shard
+records back into ONE verifiable election record — sub-tallies add
+homomorphically, manifests are published alongside the ballots and
+checked by the verifier's ``V.shard_manifest`` family.
+"""
+
+from electionguard_tpu.fabric.manifest import (  # noqa: F401
+    ManifestKeypair, ShardManifest, shard_chain_seed)

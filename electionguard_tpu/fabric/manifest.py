@@ -1,0 +1,187 @@
+"""Signed shard manifests: the publishable claim one fleet worker makes
+about its slice of the election record.
+
+Each fabric worker runs its own contiguous ballot-code chain, anchored
+not at the single-worker anchor ``H("code-chain-start", manifest_hash)``
+but at a per-shard seed derivable by anyone holding the election
+manifest::
+
+    chain_seed(shard) = H("shard-chain-start", manifest_hash, shard_id)
+
+When the worker drains it signs a manifest — (shard id, worker id, chain
+seed, head hash, admitted count), hashed through ``core/hash.py`` and
+signed with a Schnorr signature over the election group (same equations
+as ``crypto/schnorr.py``, with the manifest digest bound into the
+Fiat–Shamir challenge).  The merge step publishes all N manifests next
+to the concatenated ballot stream; the verifier's ``V.shard_manifest``
+family recomputes the seeds, checks the signatures, and asserts the
+chains are individually contiguous, disjoint, and jointly complete — a
+gapped, overlapping, or forged-manifest record goes red.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, replace
+from typing import Optional, Sequence
+
+from electionguard_tpu.core.group import (ElementModP, ElementModQ,
+                                          GroupContext)
+from electionguard_tpu.core.hash import hash_digest, hash_elems
+
+#: per-worker manifest in its own shard record dir
+MANIFEST_NAME = "shard_manifest.json"
+#: all shards' manifests in the merged record dir
+MANIFESTS_NAME = "shard_manifests.json"
+
+
+def shard_chain_seed(manifest_hash: bytes, shard_id: int) -> bytes:
+    """The code-chain anchor of one shard — derivable from public data,
+    so a forged manifest can't smuggle in an arbitrary seed."""
+    return hash_digest("shard-chain-start", manifest_hash, shard_id)
+
+
+@dataclass(frozen=True)
+class ShardSignature:
+    """Schnorr signature (c, u) over a manifest digest: with keypair
+    ``K = g^s``, sign picks nonce r, ``h = g^r``, ``c = H(K, h, digest)``,
+    ``u = r + c·s mod q``; verify recomputes ``h' = g^u · K^(q-c)`` and
+    accepts iff ``c == H(K, h', digest)``."""
+
+    challenge: int
+    response: int
+
+
+@dataclass(frozen=True)
+class ManifestKeypair:
+    """A worker's manifest signing key (secret stays in the worker
+    process; only ``public`` travels — registration and manifest)."""
+
+    secret: ElementModQ
+    public: ElementModP
+
+    @staticmethod
+    def generate(group: GroupContext,
+                 secret: Optional[ElementModQ] = None) -> "ManifestKeypair":
+        s = secret if secret is not None else group.rand_q()
+        return ManifestKeypair(s, group.g_pow_p(s))
+
+
+@dataclass(frozen=True)
+class ShardManifest:
+    """One shard's signed claim: chain seed, head, and admitted count."""
+
+    shard_id: int
+    worker_id: str
+    chain_seed: bytes          # 32B anchor (shard_chain_seed)
+    head_hash: bytes           # 32B: last ballot's code; chain_seed if empty
+    admitted_count: int
+    public_key: int            # signing key K (ElementModP value)
+    signature: Optional[ShardSignature] = None
+
+    def digest(self) -> bytes:
+        return hash_digest("shard-manifest", self.shard_id, self.worker_id,
+                           self.chain_seed, self.head_hash,
+                           self.admitted_count, self.public_key)
+
+    # ---- json wire form ----------------------------------------------
+    def to_dict(self) -> dict:
+        d = {"shard_id": self.shard_id, "worker_id": self.worker_id,
+             "chain_seed": self.chain_seed.hex(),
+             "head_hash": self.head_hash.hex(),
+             "admitted_count": self.admitted_count,
+             "public_key": f"{self.public_key:x}"}
+        if self.signature is not None:
+            d["signature"] = {"challenge": f"{self.signature.challenge:x}",
+                              "response": f"{self.signature.response:x}"}
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "ShardManifest":
+        sig = None
+        if d.get("signature"):
+            sig = ShardSignature(int(d["signature"]["challenge"], 16),
+                                 int(d["signature"]["response"], 16))
+        return ShardManifest(
+            shard_id=int(d["shard_id"]), worker_id=str(d["worker_id"]),
+            chain_seed=bytes.fromhex(d["chain_seed"]),
+            head_hash=bytes.fromhex(d["head_hash"]),
+            admitted_count=int(d["admitted_count"]),
+            public_key=int(d["public_key"], 16), signature=sig)
+
+
+def sign_manifest(group: GroupContext, keypair: ManifestKeypair,
+                  manifest: ShardManifest) -> ShardManifest:
+    """Attach a Schnorr signature binding ``manifest.digest()`` to the
+    worker's keypair (which must match ``manifest.public_key``)."""
+    if keypair.public.value != manifest.public_key:
+        raise ValueError("manifest public_key does not match the keypair")
+    r = group.rand_q(minimum=0)
+    h = group.g_pow_p(r)
+    c = hash_elems(group, keypair.public, h, manifest.digest())
+    u = group.add_q(r, group.mult_q(c, keypair.secret))
+    return replace(manifest,
+                   signature=ShardSignature(c.value, u.value))
+
+
+def verify_manifest_signature(group: GroupContext,
+                              manifest: ShardManifest) -> bool:
+    """Recompute the Fiat–Shamir challenge from the claimed key and the
+    manifest digest; also rejects keys outside the order-q subgroup."""
+    sig = manifest.signature
+    if sig is None:
+        return False
+    try:
+        K = ElementModP(manifest.public_key, group)
+        c = ElementModQ(sig.challenge, group)
+        u = ElementModQ(sig.response, group)
+    except ValueError:
+        return False
+    if not K.is_valid_residue():
+        return False
+    # h' = g^u · K^(-c); K has order q, so K^(-c) = K^(q-c)
+    h = group.mult_p(group.g_pow_p(u),
+                     group.pow_p(K, group.sub_q(group.ZERO_MOD_Q, c)))
+    return hash_elems(group, K, h, manifest.digest()) == c
+
+
+# ---- on-disk forms ----------------------------------------------------
+
+def write_shard_manifest(out_dir: str, manifest: ShardManifest) -> str:
+    """One worker's own manifest, in its shard record dir (atomic)."""
+    path = os.path.join(out_dir, MANIFEST_NAME)
+    _write_json(path, manifest.to_dict())
+    return path
+
+
+def read_shard_manifest(in_dir: str) -> ShardManifest:
+    with open(os.path.join(in_dir, MANIFEST_NAME)) as f:
+        return ShardManifest.from_dict(json.load(f))
+
+
+def write_shard_manifests(out_dir: str,
+                          manifests: Sequence[ShardManifest]) -> str:
+    """All shards' manifests in the merged record dir, shard order."""
+    path = os.path.join(out_dir, MANIFESTS_NAME)
+    _write_json(path, [m.to_dict()
+                       for m in sorted(manifests,
+                                       key=lambda m: m.shard_id)])
+    return path
+
+
+def read_shard_manifests(in_dir: str) -> list[ShardManifest]:
+    path = os.path.join(in_dir, MANIFESTS_NAME)
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [ShardManifest.from_dict(d) for d in json.load(f)]
+
+
+def _write_json(path: str, obj) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

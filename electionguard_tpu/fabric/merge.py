@@ -1,0 +1,166 @@
+"""Merge N shard records into ONE verifiable election record.
+
+Each fabric worker publishes an ordinary record directory — init, framed
+encrypted-ballot stream, admission journal — plus its signed
+``shard_manifest.json``.  The merge is deliberately dumb where it can be
+and cryptographic where it must be:
+
+* **ballots** concatenate byte-for-byte in shard order (each stream is
+  tail-repaired first, so a SIGKILL'd worker's torn final frame never
+  reaches the merged record);
+* **manifests** are structurally checked (signature, derived chain seed,
+  admitted count vs frames, distinct shard ids) and republished together
+  as ``shard_manifests.json`` — the verifier's ``V.shard_manifest``
+  family re-checks them against the actual ballot stream;
+* **sub-tallies** add homomorphically: ElGamal is additively homomorphic
+  under ciphertext multiplication, so the fleet tally is the
+  component-wise ``mult_p`` of per-shard tallies — bit-identical to
+  accumulating the merged stream directly (asserted in tests).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from electionguard_tpu.ballot.tally import (EncryptedTally,
+                                            EncryptedTallyContest,
+                                            EncryptedTallySelection)
+from electionguard_tpu.core.group import GroupContext
+from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
+from electionguard_tpu.fabric import manifest as fab_manifest
+from electionguard_tpu.publish.election_record import TallyResult
+from electionguard_tpu.publish.publisher import (Consumer, Publisher,
+                                                 repair_frame_stream)
+
+log = logging.getLogger("fabric.merge")
+
+_BALLOTS = "encrypted_ballots.pb"
+
+
+class MergeError(ValueError):
+    """A shard record set that must not be merged (forged manifest,
+    duplicate shard id, count mismatch, divergent init...)."""
+
+
+@dataclass
+class MergeReport:
+    """What one merge did — per-shard counts and the merged totals."""
+
+    out_dir: str
+    n_shards: int = 0
+    n_ballots: int = 0
+    per_shard: list = field(default_factory=list)  # (shard_id, n_ballots)
+
+
+def merge_shard_records(group: GroupContext, shard_dirs: Sequence[str],
+                        out_dir: str, check: bool = True) -> MergeReport:
+    """Fold N shard record dirs into one election record at ``out_dir``.
+
+    ``check=True`` refuses structurally bad inputs up front (signature,
+    seed derivation, admitted-vs-published count, duplicate shard ids,
+    divergent init) — the merged record still goes through the full
+    verifier, this just keeps garbage from being published at all.
+    """
+    if not shard_dirs:
+        raise MergeError("no shard record dirs to merge")
+    shards = []  # (manifest, dir, n_frames, init_bytes)
+    for d in shard_dirs:
+        m = fab_manifest.read_shard_manifest(d)
+        n_frames, _ = repair_frame_stream(os.path.join(d, _BALLOTS))
+        with open(os.path.join(d, "election_initialized.pb"), "rb") as f:
+            init_bytes = f.read()
+        shards.append((m, d, n_frames, init_bytes))
+    shards.sort(key=lambda s: s[0].shard_id)
+
+    if check:
+        _check_shards(group, shards)
+
+    pub = Publisher(out_dir)
+    with open(os.path.join(out_dir, "election_initialized.pb"), "wb") as f:
+        f.write(shards[0][3])
+    report = MergeReport(out_dir=out_dir, n_shards=len(shards))
+    # framed streams concatenate as raw bytes once each tail is repaired
+    with open(os.path.join(out_dir, _BALLOTS), "wb") as dst:
+        for m, d, n_frames, _ in shards:
+            src_path = os.path.join(d, _BALLOTS)
+            if os.path.exists(src_path):
+                with open(src_path, "rb") as src:
+                    shutil.copyfileobj(src, dst)
+            report.n_ballots += n_frames
+            report.per_shard.append((m.shard_id, n_frames))
+        dst.flush()
+        os.fsync(dst.fileno())
+    fab_manifest.write_shard_manifests(pub.dir, [s[0] for s in shards])
+    log.info("merged %d shards -> %s (%d ballots: %s)", len(shards),
+             out_dir, report.n_ballots,
+             " ".join(f"s{sid}={n}" for sid, n in report.per_shard))
+    return report
+
+
+def _check_shards(group: GroupContext, shards) -> None:
+    manifest_hash = Consumer(
+        shards[0][1], group).read_election_initialized().manifest_hash
+    seen_ids: set[int] = set()
+    init0 = shards[0][3]
+    for m, d, n_frames, init_bytes in shards:
+        if init_bytes != init0:
+            raise MergeError(f"shard {m.shard_id} ({d}): "
+                             f"election_initialized differs from shard "
+                             f"{shards[0][0].shard_id}")
+        if m.shard_id in seen_ids:
+            raise MergeError(f"duplicate shard id {m.shard_id} ({d})")
+        seen_ids.add(m.shard_id)
+        if not fab_manifest.verify_manifest_signature(group, m):
+            raise MergeError(f"shard {m.shard_id} ({d}): manifest "
+                             f"signature invalid")
+        want = fab_manifest.shard_chain_seed(manifest_hash, m.shard_id)
+        if m.chain_seed != want:
+            raise MergeError(f"shard {m.shard_id} ({d}): chain seed is "
+                             f"not H('shard-chain-start', manifest_hash, "
+                             f"{m.shard_id})")
+        if m.admitted_count != n_frames:
+            raise MergeError(f"shard {m.shard_id} ({d}): manifest claims "
+                             f"{m.admitted_count} ballots, stream has "
+                             f"{n_frames}")
+
+
+def merge_sub_tallies(group: GroupContext,
+                      tallies: Sequence[TallyResult],
+                      tally_id: str = "tally",
+                      metadata: Optional[dict] = None) -> TallyResult:
+    """Homomorphically add per-shard sub-tallies: component-wise
+    ``mult_p`` of the ciphertexts, cast counts add.  Equals the tally of
+    the concatenated stream because ElGamal accumulation is an abelian
+    product — shard order doesn't matter."""
+    if not tallies:
+        raise MergeError("no sub-tallies to merge")
+    base = tallies[0].encrypted_tally
+    contests = []
+    for ci, c in enumerate(base.contests):
+        sels = []
+        for si, s in enumerate(c.selections):
+            pad, data = s.ciphertext.pad, s.ciphertext.data
+            for t in tallies[1:]:
+                other = t.encrypted_tally.contests[ci].selections[si]
+                if (other.selection_id != s.selection_id
+                        or t.encrypted_tally.contests[ci].contest_id
+                        != c.contest_id):
+                    raise MergeError(
+                        f"sub-tally shape mismatch at contest {ci} "
+                        f"selection {si}")
+                pad = group.mult_p(pad, other.ciphertext.pad)
+                data = group.mult_p(data, other.ciphertext.data)
+            sels.append(EncryptedTallySelection(
+                s.selection_id, s.sequence_order,
+                ElGamalCiphertext(pad, data)))
+        contests.append(EncryptedTallyContest(
+            c.contest_id, c.sequence_order, tuple(sels)))
+    n_cast = sum(t.encrypted_tally.cast_ballot_count for t in tallies)
+    tally = EncryptedTally(tally_id, tuple(contests),
+                           cast_ballot_count=n_cast)
+    return TallyResult(tallies[0].election_init, tally, (tally_id,),
+                       dict(metadata or {}))

@@ -1,0 +1,413 @@
+"""The fabric router: one front door, N encryption-worker shards.
+
+Speaks the existing ``BallotEncryptionService`` surface — clients built
+for the single worker (``EncryptionClient``, ``tools/loadgen_encrypt``)
+point at the router unchanged — and fans every request out to the
+least-loaded live worker.  Workers reverse-dial the router through
+``FabricRegistrationService`` exactly as mix servers reverse-dial their
+coordinator (nonce-idempotent: a lost-response retry replays, a
+relaunched worker with the same id reclaims its shard and receives the
+ballot ids that were requeued away while it was down).
+
+Routing and membership:
+
+* **least queue depth** — each shard's score is its last health-reported
+  queue depth plus the router's own in-flight delta, so bursts between
+  polls still spread;
+* **eviction / readmission** — a background poll drives the ``health``
+  rpc; ``EGTPU_FABRIC_EVICT_AFTER`` consecutive failures evict, one
+  success readmits.  A transport failure on a live forward evicts
+  immediately and requeues the ballot onto a surviving shard, recording
+  the id against the dead shard so its journal replay skips it;
+* **backpressure** — a worker's RESOURCE_EXHAUSTED moves the request to
+  the next shard; the router itself aborts RESOURCE_EXHAUSTED only when
+  EVERY live shard is saturated (and UNAVAILABLE when none is live).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+import grpc
+
+from electionguard_tpu import obs
+from electionguard_tpu.core.group import GroupContext
+from electionguard_tpu.obs import REGISTRY
+from electionguard_tpu.publish import pb
+from electionguard_tpu.remote import rpc_util
+from electionguard_tpu.utils import clock, knobs
+
+log = logging.getLogger("fabric.router")
+
+_FRONT = "BallotEncryptionService"
+_REG = "FabricRegistrationService"
+
+
+class _Shard:
+    """Router-side handle for one registered encryption worker."""
+
+    def __init__(self, shard_id: int, worker_id: str, url: str,
+                 nonce: bytes, public_key: bytes):
+        self.shard_id = shard_id
+        self.worker_id = worker_id
+        self.url = url
+        self.reg_nonce = nonce
+        self.public_key = public_key
+        self.live = False          # at least one health success, not evicted
+        self.evicted = False
+        self.fail_count = 0
+        self.queue_depth = 0       # last health-reported depth
+        self.in_flight = 0         # router-tracked delta since that poll
+        self.forwarded = 0
+        #: admitted-here ballot ids the router moved to surviving shards;
+        #: handed back (and kept, for idempotent replays) at re-register
+        self.requeued: list[str] = []
+        self._channel = None
+        self._stub: Optional[rpc_util.Stub] = None
+
+    def stub(self) -> rpc_util.Stub:
+        if self._stub is None:
+            self._channel = rpc_util.make_channel(self.url)
+            self._stub = rpc_util.Stub(self._channel, _FRONT)
+        return self._stub
+
+    def score(self) -> int:
+        return self.queue_depth + self.in_flight
+
+    def close(self):
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+            self._stub = None
+
+
+class EncryptionRouter:
+    """Front-door server + registration service + health-poll loop."""
+
+    def __init__(self, group: GroupContext, port: int = 0,
+                 health_interval: Optional[float] = None,
+                 health_timeout: Optional[float] = None,
+                 evict_after: Optional[int] = None,
+                 max_inflight: Optional[int] = None,
+                 max_workers: int = 32):
+        self.group = group
+        self._health_interval = (
+            health_interval if health_interval is not None
+            else knobs.get_float("EGTPU_FABRIC_HEALTH_INTERVAL"))
+        self._health_timeout = (
+            health_timeout if health_timeout is not None
+            else knobs.get_float("EGTPU_FABRIC_HEALTH_TIMEOUT"))
+        self._evict_after = (evict_after if evict_after is not None
+                             else knobs.get_int("EGTPU_FABRIC_EVICT_AFTER"))
+        self._max_inflight = (
+            max_inflight if max_inflight is not None
+            else knobs.get_int("EGTPU_FABRIC_MAX_INFLIGHT"))
+        self._lock = threading.Lock()
+        self.shards: list[_Shard] = []
+        self._rr = 0               # tiebreak rotation for equal scores
+        # forwards fail fast (one attempt): failover to another shard IS
+        # the router's retry, and the client's own Stub retries the
+        # router — stacking a third retry layer inside the forward would
+        # multiply worst-case latency for no added delivery guarantee
+        self._fwd_policy = rpc_util.RetryPolicy(
+            attempts=1, base_wait=0.1, max_wait=0.1,
+            connect_window=self._health_timeout, budget=0.0)
+        self._c_requeues = REGISTRY.counter("fabric_requeues_total")
+        self._c_evictions = REGISTRY.counter("fabric_evictions_total")
+        self._c_readmissions = REGISTRY.counter(
+            "fabric_readmissions_total")
+        self._c_saturated = REGISTRY.counter(
+            "fabric_rejects_saturated_total")
+        self._c_no_shards = REGISTRY.counter(
+            "fabric_rejects_no_live_shards_total")
+        self.server, self.port = rpc_util.make_server(
+            port, max_workers=max_workers)
+        self.url = f"localhost:{self.port}"
+        self.server.add_generic_rpc_handlers((
+            rpc_util.generic_service(_REG, {
+                "registerEncryptionWorker": self._register}),
+            rpc_util.generic_service(_FRONT, {
+                "encryptBallot": self._encrypt_ballot,
+                "encryptBallotBatch": self._encrypt_ballot_batch,
+                "health": self._health}),
+        ))
+        self.server.start()
+        self._stop = threading.Event()
+        self._poller = threading.Thread(target=self._poll_loop,
+                                        name="fabric-health", daemon=True)
+        clock.start_thread(self._poller)
+        obs.set_phase("routing shards=0/0")
+        log.info("fabric router listening on %d (health every %.1fs, "
+                 "evict after %d misses)", self.port,
+                 self._health_interval, self._evict_after)
+
+    # ---- registration ------------------------------------------------
+    def _register(self, request, context):
+        Resp = pb.RegisterEncryptionWorkerResponse
+        constants = rpc_util.group_constants_msg(self.group)
+        with self._lock:
+            err = rpc_util.check_group_fingerprint(
+                self.group, request.group_fingerprint)
+            if err:
+                return Resp(error=err, constants=constants)
+            wid = request.worker_id
+            nonce = bytes(request.registration_nonce)
+            for s in self.shards:
+                if s.worker_id != wid:
+                    continue
+                if s.reg_nonce == nonce:
+                    if s.url == request.remote_url:
+                        # lost-response retry: replay idempotently,
+                        # including the requeued-ids list
+                        return Resp(shard_id=s.shard_id,
+                                    requeued_ballot_ids=s.requeued,
+                                    constants=constants)
+                    return Resp(
+                        error=f"worker id {wid!r} already registered "
+                              f"from {s.url}", constants=constants)
+                # same id, fresh nonce: a RELAUNCHED worker reclaims its
+                # shard.  The requeued list stays on the handle (never
+                # cleared) so a lost response replays identically; ids
+                # no longer in the worker's journal are skipped for free.
+                log.warning("worker %s re-registered (shard %d, %d "
+                            "requeued ids handed back)", wid, s.shard_id,
+                            len(s.requeued))
+                s.url = request.remote_url
+                s.reg_nonce = nonce
+                s.public_key = bytes(request.manifest_public_key)
+                s.close()
+                s.live = False
+                s.evicted = False
+                s.fail_count = 0
+                s.in_flight = 0
+                return Resp(shard_id=s.shard_id,
+                            requeued_ballot_ids=s.requeued,
+                            constants=constants)
+            shard = _Shard(len(self.shards), wid, request.remote_url,
+                           nonce, bytes(request.manifest_public_key))
+            self.shards.append(shard)
+            log.info("registered encryption worker %s as shard %d at %s",
+                     wid, shard.shard_id, shard.url)
+            return Resp(shard_id=shard.shard_id, constants=constants)
+
+    def wait_for_workers(self, n: int, timeout: float = 300.0,
+                         poll: float = 0.25, live: bool = False) -> bool:
+        """Block until ``n`` workers are registered (``live=True``: until
+        n have answered a health poll and entered the routing set)."""
+        deadline = clock.monotonic() + timeout
+        while clock.monotonic() < deadline:
+            with self._lock:
+                ready = sum(1 for s in self.shards
+                            if (s.live if live else True))
+            if ready >= n:
+                return True
+            clock.sleep(poll)
+        return False
+
+    # ---- health / membership -----------------------------------------
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self._health_interval):
+            with self._lock:
+                shards = list(self.shards)
+            for s in shards:
+                if self._stop.is_set():
+                    return
+                self._poll_one(s)
+            with self._lock:
+                n_live = sum(1 for s in self.shards if s.live)
+                n = len(self.shards)
+            obs.set_phase(f"routing shards={n_live}/{n}")
+
+    def _poll_one(self, s: _Shard) -> None:
+        try:
+            h = s.stub().call("health", pb.msg("HealthRequest")(),
+                              timeout=self._health_timeout,
+                              policy=self._fwd_policy)
+        except grpc.RpcError as e:
+            with self._lock:
+                s.fail_count += 1
+                if s.live and s.fail_count >= self._evict_after:
+                    self._evict_locked(s, f"health: {e.code()}")
+            return
+        with self._lock:
+            s.fail_count = 0
+            s.queue_depth = h.queue_depth
+            if s.evicted:
+                s.evicted = False
+                self._c_readmissions.inc()
+                log.info("shard %d readmitted (status=%s depth=%d)",
+                         s.shard_id, h.status, h.queue_depth)
+            if not s.live:
+                s.live = True
+                log.info("shard %d live at %s (status=%s)", s.shard_id,
+                         s.url, h.status)
+
+    def _evict_locked(self, s: _Shard, reason: str) -> None:
+        if not s.live:
+            return
+        s.live = False
+        s.evicted = True
+        s.close()
+        self._c_evictions.inc()
+        log.warning("evicted shard %d (%s): %s", s.shard_id, s.worker_id,
+                    reason)
+
+    # ---- routing -----------------------------------------------------
+    def _pick(self, tried: set[int]) -> Optional[_Shard]:
+        """Least-loaded live shard not yet tried and under the in-flight
+        cap; claims one in-flight slot under the lock."""
+        with self._lock:
+            candidates = [s for s in self.shards
+                          if s.live and s.shard_id not in tried
+                          and s.in_flight < self._max_inflight]
+            if not candidates:
+                return None
+            # equal scores rotate round-robin so a sequential client
+            # doesn't pin the whole stream to shard 0
+            self._rr += 1
+            rr = self._rr
+            best = min(candidates,
+                       key=lambda s: (s.score(),
+                                      (s.shard_id - rr) % (len(self.shards)
+                                                           or 1)))
+            best.in_flight += 1
+            best.forwarded += 1
+            return best
+
+    def _release(self, s: _Shard) -> None:
+        with self._lock:
+            s.in_flight = max(0, s.in_flight - 1)
+
+    def _route(self, method: str, request, context, ballot_ids,
+               timeout: float):
+        """Forward ``request`` to shards in load order until one answers.
+
+        RESOURCE_EXHAUSTED tries the next shard; a transport failure
+        evicts the shard and requeues (recording ``ballot_ids`` against
+        it so the worker's recovery skips them).  Aborts
+        RESOURCE_EXHAUSTED only when every reachable shard is saturated,
+        UNAVAILABLE when none is reachable at all.
+        """
+        tried: set[int] = set()
+        n_exhausted = 0
+        while True:
+            shard = self._pick(tried)
+            if shard is None:
+                with self._lock:
+                    any_live = any(s.live for s in self.shards)
+                if n_exhausted or any_live:
+                    # a live shard we can't route to is a saturated one:
+                    # either its worker said RESOURCE_EXHAUSTED or the
+                    # router's own in-flight cap is the bound
+                    self._c_saturated.inc()
+                    context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        f"fleet saturated: {n_exhausted} shard(s) "
+                        f"exhausted, none under the in-flight cap")
+                self._c_no_shards.inc()
+                context.abort(grpc.StatusCode.UNAVAILABLE,
+                              "no live encryption workers")
+            tried.add(shard.shard_id)
+            try:
+                return shard.stub().call(method, request, timeout=timeout,
+                                         policy=self._fwd_policy)
+            except grpc.RpcError as e:
+                code = e.code()
+                if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    n_exhausted += 1
+                    continue
+                # transport-level failure mid-forward: the worker may
+                # have journaled the admission before dying, so the ids
+                # are recorded against this shard — its recovery must
+                # NOT replay what surviving shards are about to encrypt
+                with self._lock:
+                    self._evict_locked(shard, f"{method}: {code}")
+                    shard.requeued.extend(ballot_ids)
+                    self._c_requeues.inc(len(ballot_ids))
+                log.warning("requeued %d ballot(s) away from shard %d "
+                            "after %s", len(ballot_ids), shard.shard_id,
+                            code)
+                continue
+            finally:
+                self._release(shard)
+
+    def _encrypt_ballot(self, request, context):
+        return self._route("encryptBallot", request, context,
+                           [request.ballot.ballot_id],
+                           timeout=rpc_util.deadline_for("encryptBallot"))
+
+    def _encrypt_ballot_batch(self, request, context):
+        return self._route(
+            "encryptBallotBatch", request, context,
+            [b.ballot_id for b in request.ballots],
+            timeout=rpc_util.deadline_for("encryptBallotBatch"))
+
+    def _health(self, request, context):
+        with self._lock:
+            live = [s for s in self.shards if s.live]
+            depth = sum(s.score() for s in live)
+        return pb.msg("HealthResponse")(
+            status="SERVING" if live else "STARTING",
+            ready=bool(live), queue_depth=depth, shard_id=-1)
+
+    # ---- lifecycle ---------------------------------------------------
+    def snapshot(self) -> list[dict]:
+        """Membership view for CLIs/tests: one dict per shard."""
+        with self._lock:
+            return [{"shard_id": s.shard_id, "worker_id": s.worker_id,
+                     "url": s.url, "live": s.live, "evicted": s.evicted,
+                     "queue_depth": s.queue_depth,
+                     "in_flight": s.in_flight, "forwarded": s.forwarded,
+                     "requeued": len(s.requeued)}
+                    for s in self.shards]
+
+    def shutdown(self, grace: float = 2.0) -> None:
+        self._stop.set()
+        clock.wait_event(self.server.stop(grace=grace), grace)
+        with self._lock:
+            for s in self.shards:
+                s.close()
+
+
+def register_worker(router_url: str, group: GroupContext, worker_id: str,
+                    serve_port: int, manifest_public_key: bytes = b"",
+                    host: str = "localhost",
+                    timeout: float = 120.0) -> tuple[int, list[str]]:
+    """Worker-side reverse dial: register with the router (retrying while
+    it is unreachable), returning ``(shard_id, requeued_ballot_ids)`` —
+    the shard this worker owns and the admissions the router moved to
+    surviving shards while a previous incarnation was down.  One nonce
+    per process: a lost-response retry replays idempotently, a relaunch
+    (fresh nonce, same ``worker_id``) reclaims the shard."""
+    nonce = os.urandom(16)
+    deadline = clock.monotonic() + timeout
+    channel = rpc_util.make_channel(router_url)
+    stub = rpc_util.Stub(channel, _REG)
+    try:
+        while True:
+            try:
+                resp = stub.call(
+                    "registerEncryptionWorker",
+                    pb.RegisterEncryptionWorkerRequest(
+                        worker_id=worker_id,
+                        remote_url=f"{host}:{serve_port}",
+                        group_fingerprint=group.fingerprint(),
+                        registration_nonce=nonce,
+                        manifest_public_key=manifest_public_key))
+            except grpc.RpcError:
+                if clock.monotonic() >= deadline:
+                    raise
+                clock.sleep(0.5)
+                continue
+            if resp.error:
+                raise RuntimeError(
+                    f"router refused registration: {resp.error}")
+            err = rpc_util.check_group_constants(group, resp.constants)
+            if err:
+                raise RuntimeError(err)
+            return resp.shard_id, list(resp.requeued_ballot_ids)
+    finally:
+        channel.close()

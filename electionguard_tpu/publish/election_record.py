@@ -106,3 +106,6 @@ class ElectionRecord:
     decryption_result: Optional[DecryptionResult] = None
     spoiled_ballot_tallies: list = field(default_factory=list)
     mix_stages: list = field(default_factory=list)  # mixnet.stage.MixStage
+    # fabric: signed per-shard manifests of a merged record (empty =
+    # single-worker record; fabric.manifest.ShardManifest)
+    shard_manifests: list = field(default_factory=list)

@@ -110,6 +110,8 @@ MixRowChunk = msg("MixRowChunk")
 MixRowRequest = msg("MixRowRequest")
 MixShuffleRequest = msg("MixShuffleRequest")
 MixStageResult = msg("MixStageResult")
+RegisterEncryptionWorkerRequest = msg("RegisterEncryptionWorkerRequest")
+RegisterEncryptionWorkerResponse = msg("RegisterEncryptionWorkerResponse")
 ObsHeartbeat = msg("ObsHeartbeat")
 TelemetryBatch = msg("TelemetryBatch")
 TelemetryAck = msg("TelemetryAck")

@@ -168,6 +168,12 @@ class Publisher:
                 n += 1
         return n
 
+    def write_shard_manifests(self, manifests) -> str:
+        """Fabric: publish the signed per-shard manifests of a merged
+        record next to the concatenated ballot stream."""
+        from electionguard_tpu.fabric import manifest as fab_manifest
+        return fab_manifest.write_shard_manifests(self.dir, manifests)
+
     def write_plaintext_ballot(self, subdir: str, ballot: PlaintextBallot):
         d = self._path(subdir)
         os.makedirs(d, exist_ok=True)
@@ -363,6 +369,16 @@ class Consumer:
     def read_mix_stages(self) -> list:
         return [self.read_mix_stage(k) for k in range(self.mix_stage_count())]
 
+    def read_shard_manifests(self) -> list:
+        """Fabric: the signed per-shard manifests of a merged record
+        ([] = single-worker record)."""
+        from electionguard_tpu.fabric import manifest as fab_manifest
+        return fab_manifest.read_shard_manifests(self.dir)
+
+    def has_shard_manifests(self) -> bool:
+        from electionguard_tpu.fabric import manifest as fab_manifest
+        return os.path.exists(self._path(fab_manifest.MANIFESTS_NAME))
+
     def iterate_plaintext_ballots(self, subdir: str) -> Iterator[PlaintextBallot]:
         d = self._path(subdir)
         if not os.path.isdir(d):
@@ -384,4 +400,5 @@ def election_record_from_consumer(consumer: Consumer) -> ElectionRecord:
         record.decryption_result = consumer.read_decryption_result()
     record.spoiled_ballot_tallies = list(
         consumer.iterate_spoiled_ballot_tallies())
+    record.shard_manifests = consumer.read_shard_manifests()
     return record

@@ -137,6 +137,7 @@ _DEADLINE_CLASS_OF = {
     "encryptBallot": "data",
     "encryptBallotBatch": "data",
     "registerMixServer": "registration",
+    "registerEncryptionWorker": "registration",
     "registerStage": "control",
     "pushRows": "data",
     "shuffleStage": "data",

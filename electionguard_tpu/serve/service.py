@@ -69,10 +69,27 @@ class EncryptionService:
                  max_workers: int = 16,
                  hold: Optional[threading.Event] = None,
                  hold_after: Optional[int] = None,
-                 metrics_http_port: Optional[int] = None):
+                 metrics_http_port: Optional[int] = None,
+                 shard_id: Optional[int] = None,
+                 worker_id: Optional[str] = None,
+                 chain_seed: Optional[bytes] = None,
+                 skip_ballot_ids: Sequence[str] = (),
+                 manifest_keypair=None):
         self.init = init
         self.group = group if group is not None else \
             init.joint_public_key.group
+        # fabric shard mode: this worker owns ONE shard of the fleet's
+        # ballot-code chain, anchored at ``chain_seed`` instead of the
+        # single-worker anchor; ``skip_ballot_ids`` are admissions the
+        # router already requeued to surviving shards while this worker
+        # was down — replaying them would double-publish.
+        self.shard_id = shard_id
+        self.worker_id = worker_id or (f"worker-{shard_id}"
+                                       if shard_id is not None else None)
+        self._chain_seed = chain_seed
+        self._manifest_keypair = manifest_keypair
+        self._skip_ballot_ids = set(skip_ballot_ids)
+        self._published_base = 0
         self._status = "STARTING"
         self.publisher = Publisher(out_dir) if out_dir else None
         self._stream = None
@@ -92,6 +109,18 @@ class EncryptionService:
             jpath = os.path.join(out_dir, wal.JOURNAL_NAME)
             gap, code_seed = self._plan_recovery(jpath)
             self.journal = wal.AdmissionJournal(jpath)
+            skipped = [e for e in gap
+                       if e.ballot.ballot_id in self._skip_ballot_ids]
+            if skipped:
+                # the router moved these admissions to surviving shards
+                # while we were dead; tombstone them so neither this
+                # replay nor any future one resurrects a double-publish
+                gap = [e for e in gap
+                       if e.ballot.ballot_id not in self._skip_ballot_ids]
+                for e in skipped:
+                    self.journal.append_drop(e.ballot.ballot_id)
+                log.warning("dropping %d journaled admissions requeued "
+                            "to other shards", len(skipped))
             self._stream = self.publisher.open_encrypted_ballots(
                 append=True)
         self.batcher = DynamicBatcher(max_batch=max_batch,
@@ -101,7 +130,9 @@ class EncryptionService:
         self.worker = EncryptionWorker(
             self.batcher, BatchEncryptor(init, self.group, mesh=mesh),
             self.metrics, seed=seed, timestamp=timestamp,
-            stream=self._stream, hold=hold, code_seed=code_seed,
+            stream=self._stream, hold=hold,
+            code_seed=(code_seed if code_seed is not None
+                       else self._chain_seed),
             hold_after=hold_after)
         if prewarm:
             # compile every (program, bucket) pair before the first
@@ -131,7 +162,7 @@ class EncryptionService:
                 httpd.start(metrics_http_port)
         self._drained = threading.Event()
         self._status = "SERVING"
-        obs.set_phase("serving")
+        self._set_serving_phase()
         log.info("encryption service on port %d (max_batch=%d "
                  "max_wait=%.0fms max_queue=%d buckets=%s recovered=%d)",
                  self.port, max_batch, max_wait_ms, max_queue,
@@ -147,6 +178,7 @@ class EncryptionService:
         ballots_path = os.path.join(self.publisher.dir,
                                     "encrypted_ballots.pb")
         n_pub, last_frame = repair_frame_stream(ballots_path)
+        self._published_base = n_pub
         code_seed = None
         published: set[str] = set()
         if n_pub:
@@ -191,6 +223,29 @@ class EncryptionService:
                 # would have answered in-band; resolution is identical
                 log.warning("recovered ballot %s invalid: %s", bid, e)
 
+    # ---- shard bookkeeping -------------------------------------------
+    def published_count(self) -> int:
+        """Ballots durably in this worker's stream (pre-crash + since)."""
+        return self._published_base + \
+            (self._stream.n if self._stream is not None else 0)
+
+    def chain_head(self) -> Optional[bytes]:
+        """Current head of this worker's code chain (None = single-worker
+        mode with no publisher and nothing encrypted yet)."""
+        head = self.worker.code_seed
+        return head if head is not None else self._chain_seed
+
+    def _set_serving_phase(self) -> None:
+        """The obs heartbeat's free-form phase carries the shard facts
+        egtop renders per-shard rows from — no proto change needed."""
+        if self.shard_id is None:
+            obs.set_phase("serving")
+            return
+        head = self.chain_head()
+        obs.set_phase(f"serving shard={self.shard_id} "
+                      f"head={head.hex()[:16] if head else '-'} "
+                      f"admitted={self.published_count()}")
+
     # ---- rpc impls ---------------------------------------------------
     def _admit(self, ballot: PlaintextBallot, spoil: bool):
         """Journal-then-enqueue, atomically w.r.t. other admissions: the
@@ -228,18 +283,22 @@ class EncryptionService:
 
     def _resolve(self, future, error):
         Resp = pb.msg("EncryptBallotResponse")
+        sid = self.shard_id if self.shard_id is not None else -1
         if future is None:
-            return Resp(error=error)
+            return Resp(error=error, shard_id=sid)
         try:
             b = clock.wait_future(future, _RESULT_TIMEOUT)
         except InvalidBallotError as e:
-            return Resp(error=f"invalid ballot: {e}")
+            return Resp(error=f"invalid ballot: {e}", shard_id=sid)
         except Exception as e:  # noqa: BLE001 — in-band, like the planes
             self.metrics.inc("requests_failed")
-            return Resp(error=f"encryption failed: {type(e).__name__}: {e}")
+            return Resp(error=f"encryption failed: {type(e).__name__}: {e}",
+                        shard_id=sid)
+        if self.shard_id is not None:
+            self._set_serving_phase()
         return Resp(
             encrypted_ballot=serialize.publish_encrypted_ballot(b),
-            confirmation_code=b.code)
+            confirmation_code=b.code, shard_id=sid)
 
     def _encrypt_ballot(self, request, context):
         future, err = self._submit(request.ballot, request.spoil, context)
@@ -280,7 +339,8 @@ class EncryptionService:
             ready=(self._status == "SERVING"
                    and depth < self.batcher.max_queue),
             queue_depth=depth,
-            recovered_ballots=self.recovered_ballots)
+            recovered_ballots=self.recovered_ballots,
+            shard_id=self.shard_id if self.shard_id is not None else -1)
 
     # ---- lifecycle ---------------------------------------------------
     def drain(self, grace: float = 5.0) -> None:
@@ -295,8 +355,11 @@ class EncryptionService:
         self.batcher.close()
         clock.join_thread(self.worker, _RESULT_TIMEOUT)
         if self._stream is not None:
+            n_published = self.published_count()
             self._stream.close()
             self._stream = None
+            if self.shard_id is not None:
+                self._write_shard_manifest(n_published)
         with self._adm_lock:
             # the admission lock keeps a straggler _admit from appending
             # to a journal we are about to close
@@ -315,6 +378,30 @@ class EncryptionService:
             self._metrics_httpd = None
         log.info("drained: %s", self.metrics.summary())
 
+    def _write_shard_manifest(self, n_published: int) -> None:
+        """The shard's signed claim, written at drain next to its ballot
+        stream; ``fabric/merge.py`` publishes all of them and the
+        verifier's V.shard_manifest family holds them to account."""
+        from electionguard_tpu.fabric import manifest as fab_manifest
+
+        head = self.chain_head()
+        if head is None or self._chain_seed is None:
+            log.warning("shard %s drained without a chain seed; no "
+                        "manifest written", self.shard_id)
+            return
+        m = fab_manifest.ShardManifest(
+            shard_id=self.shard_id, worker_id=self.worker_id,
+            chain_seed=self._chain_seed, head_hash=head,
+            admitted_count=n_published,
+            public_key=(self._manifest_keypair.public.value
+                        if self._manifest_keypair is not None else 0))
+        if self._manifest_keypair is not None:
+            m = fab_manifest.sign_manifest(self.group,
+                                           self._manifest_keypair, m)
+        fab_manifest.write_shard_manifest(self.publisher.dir, m)
+        log.info("shard %d manifest: %d ballots, head %s",
+                 self.shard_id, n_published, head.hex()[:16])
+
     def shutdown(self) -> None:
         self.drain(grace=1.0)
 
@@ -329,6 +416,9 @@ class EncryptionClient:
         self.group = group
         self._channel = rpc_util.make_channel(url)
         self._stub = rpc_util.Stub(self._channel, _SERVICE)
+        #: shard that answered the last encrypt/encrypt_batch (-1 = the
+        #: single-worker plane); loadgen joins latencies to shards on it
+        self.last_shard_id = -1
 
     def encrypt(self, ballot: PlaintextBallot, spoil: bool = False,
                 timeout: float = 120.0):
@@ -338,6 +428,7 @@ class EncryptionClient:
                 ballot=serialize.publish_plaintext_ballot(ballot),
                 spoil=spoil),
             timeout=timeout)
+        self.last_shard_id = resp.shard_id
         if resp.error:
             raise ValueError(resp.error)
         return serialize.import_encrypted_ballot(self.group,
@@ -355,6 +446,7 @@ class EncryptionClient:
             timeout=timeout)
         out = []
         for r in resp.results:
+            self.last_shard_id = r.shard_id
             if r.error:
                 out.append((None, r.error))
             else:

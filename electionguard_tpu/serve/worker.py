@@ -77,6 +77,9 @@ class EncryptionWorker(threading.Thread):
         self.stream = stream
         self.hold = hold
         self.hold_after = hold_after
+        from electionguard_tpu.utils import knobs
+        self._emulate_device_s = knobs.get_float(
+            "EGTPU_FABRIC_EMULATE_DEVICE_MS") / 1e3
         self._code_seed: Optional[bytes] = code_seed
         self._pad_counter = 0
         self._filler_proto = self._make_filler_proto()
@@ -149,6 +152,14 @@ class EncryptionWorker(threading.Thread):
             if b.ballot_id in filler_ids:
                 break
             real_encrypted.append(b)
+        if self._emulate_device_s:
+            # scale-evidence hook (EGTPU_FABRIC_EMULATE_DEVICE_MS): pad
+            # the device leg to a fixed wall-clock duration — the
+            # per-chip-device-time regime of a real fleet, where the
+            # host core is NOT the bottleneck — so a single-host fabric
+            # curve measures routing-plane scaling, the analogue of
+            # scale_run's virtual 8-device mesh for the shuffle plane
+            clock.sleep(self._emulate_device_s)
         return real_encrypted, invalid, spoiled
 
     def _process(self, batch: list[PendingRequest], clock) -> None:

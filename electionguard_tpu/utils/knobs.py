@@ -54,6 +54,24 @@ KNOBS: tuple[Knob, ...] = (
     Knob("EGTPU_DRYRUN_TIMEOUT", "float", "900",
          "Harness-internal: dry-run subprocess timeout, seconds (repo "
          "entry shim)."),
+    Knob("EGTPU_FABRIC_EMULATE_DEVICE_MS", "float", "0",
+         "Pad each encryption batch's device leg to this wall-clock "
+         "duration — the per-chip-device-time regime of a real fleet — "
+         "so a single-host fabric scale curve measures routing-plane "
+         "scaling instead of host-core contention; 0 = off "
+         "(serve/worker, set by tools/scale_run --fabric)."),
+    Knob("EGTPU_FABRIC_EVICT_AFTER", "int", "2",
+         "Consecutive failed health polls before the router evicts a "
+         "worker from routing (fabric/router)."),
+    Knob("EGTPU_FABRIC_HEALTH_INTERVAL", "float", "1.0",
+         "Router health-poll period, seconds (fabric/router)."),
+    Knob("EGTPU_FABRIC_HEALTH_TIMEOUT", "float", "2.0",
+         "Per-worker health rpc deadline inside the router's poll loop, "
+         "seconds (fabric/router)."),
+    Knob("EGTPU_FABRIC_MAX_INFLIGHT", "int", "128",
+         "Router-side in-flight request cap per shard; a shard at the "
+         "cap is skipped, and a whole fleet at the cap is saturation "
+         "(fabric/router)."),
     Knob("EGTPU_FAULT_PLAN", "json", "",
          "Fault-injection plan: inline JSON or @file "
          "(testing/faults; workflow chaos modes set it per process)."),

@@ -77,6 +77,12 @@ class _BallotAggregates:
     total_count: int = 0
     spoiled_ids: set = field(default_factory=set)
     prev_code: Optional[bytes] = None           # last ballot's code
+    # fabric (record carries shard manifests): maximal contiguous chain
+    # runs as [first_code_seed, count, last_code] — finalize maps them
+    # onto the manifests — plus ballot-id overlap bookkeeping
+    segments: list = field(default_factory=list)
+    seen_ids: set = field(default_factory=set)
+    dup_ids: set = field(default_factory=set)
 
 
 class Verifier:
@@ -189,6 +195,16 @@ class Verifier:
             agg.cast_count += a.cast_count
             agg.total_count += a.total_count
             agg.spoiled_ids |= a.spoiled_ids
+            # fabric: a chain run continuing across the feeder boundary
+            # coalesces (its first seed IS the previous slice's tail code)
+            for seg in a.segments:
+                if agg.segments and seg[0] == agg.segments[-1][2]:
+                    agg.segments[-1][1] += seg[1]
+                    agg.segments[-1][2] = seg[2]
+                else:
+                    agg.segments.append(list(seg))
+            agg.dup_ids |= a.dup_ids | (agg.seen_ids & a.seen_ids)
+            agg.seen_ids |= a.seen_ids
             agg.prev_code = a.prev_code
         return res, agg
 
@@ -212,6 +228,8 @@ class Verifier:
         self._v14_coherence(res)
         if self.record.mix_stages:
             self._v15_mixnet(res)
+        if self.record.shard_manifests:
+            self._v_shard_manifests(res, agg)
         return res
 
     # ==================================================================
@@ -561,11 +579,30 @@ class Verifier:
         g = self.group
         from electionguard_tpu.ballot.code_batch import batch_codes
         codes = batch_codes(ballots)   # recomputed hash tree, batched
+        sharded = bool(self.record.shard_manifests)
         for i, b in enumerate(ballots):
             if b.code != codes[i].tobytes():
                 res.record("V6.ballot_chaining", False,
                            f"{b.ballot_id} confirmation code invalid")
-            if agg.prev_code is None:
+            if sharded:
+                # a merged fleet record is N chains, not one: collect the
+                # maximal contiguous runs here; finalize's
+                # V.shard_manifest family maps every run onto a signed
+                # manifest (so a chain break is a red check THERE, not an
+                # inline V6 error)
+                if (not agg.segments or agg.prev_code is None
+                        or b.code_seed != agg.prev_code):
+                    # also opens the run for a feeder seeded mid-chain:
+                    # its first seed is the previous slice's tail code, so
+                    # merge_partials coalesces the two runs back together
+                    agg.segments.append([b.code_seed, 0, b.code])
+                seg = agg.segments[-1]
+                seg[1] += 1
+                seg[2] = b.code
+                if b.ballot_id in agg.seen_ids:
+                    agg.dup_ids.add(b.ballot_id)
+                agg.seen_ids.add(b.ballot_id)
+            elif agg.prev_code is None:
                 # chain start must anchor to the manifest (the encryptor's
                 # start value, encrypt/encryptor.py): otherwise truncating
                 # leading ballots is invisible to the chain check
@@ -919,4 +956,97 @@ class Verifier:
                                    f"tally selection ({c.contest_id}, "
                                    f"{s.selection_id}) not in manifest")
         res.record("V14.coherence", True)
+
+    def _v_shard_manifests(self, res, agg: _BallotAggregates):
+        """V.shard_manifest.*: a merged fleet record's shard chains are
+        individually contiguous, mutually disjoint, and jointly complete.
+
+        * ``signature`` — every published manifest's Schnorr signature
+          verifies under its own key (tampering with a signed manifest
+          without the worker's secret goes red; binding the KEYS to the
+          legitimate fleet roster is the deployment's job — e.g. publish
+          the router's registration log);
+        * ``seed`` — every claimed chain seed is
+          ``H("shard-chain-start", manifest_hash, shard_id)``, so a
+          manifest can't smuggle in an arbitrary anchor;
+        * ``chain`` — every contiguous chain run in the ballot stream
+          starts at exactly one manifest's seed and carries exactly that
+          manifest's admitted count up to its head hash (a gap splits a
+          run in two: the orphan half matches no manifest);
+        * ``overlap`` — no ballot id is published by two chains;
+        * ``complete`` — shard ids are distinct and the manifests'
+          admitted counts sum to the record's ballot count.
+        """
+        from electionguard_tpu.fabric import manifest as fab_manifest
+        g = self.group
+        manifests = self.record.shard_manifests
+        seen_sids: set[int] = set()
+        seed_of: dict[bytes, object] = {}
+        for m in manifests:
+            if m.shard_id in seen_sids:
+                res.record("V.shard_manifest.complete", False,
+                           f"duplicate shard id {m.shard_id} in the "
+                           f"published manifests")
+            seen_sids.add(m.shard_id)
+            if not fab_manifest.verify_manifest_signature(g, m):
+                res.record("V.shard_manifest.signature", False,
+                           f"shard {m.shard_id}: manifest signature "
+                           f"invalid (forged or tampered)")
+            want = fab_manifest.shard_chain_seed(self.init.manifest_hash,
+                                                 m.shard_id)
+            if m.chain_seed != want:
+                res.record("V.shard_manifest.seed", False,
+                           f"shard {m.shard_id}: chain seed is not "
+                           f"H('shard-chain-start', manifest_hash, "
+                           f"{m.shard_id})")
+            seed_of[m.chain_seed] = m
+        # map every observed chain run onto exactly one manifest
+        claimed: dict[int, list] = {}
+        for first_seed, count, last_code in agg.segments:
+            m = seed_of.get(first_seed)
+            if m is None:
+                res.record("V.shard_manifest.chain", False,
+                           f"chain run of {count} ballot(s) starting at "
+                           f"{first_seed.hex()[:16]} matches no shard "
+                           f"manifest (gapped or truncated chain?)")
+                continue
+            if m.shard_id in claimed:
+                res.record("V.shard_manifest.chain", False,
+                           f"shard {m.shard_id}: chain restarts from its "
+                           f"seed ({claimed[m.shard_id][1]} then {count} "
+                           f"ballots)")
+                continue
+            claimed[m.shard_id] = [first_seed, count, last_code]
+        for m in manifests:
+            got = claimed.get(m.shard_id)
+            if got is None:
+                if m.admitted_count:
+                    res.record("V.shard_manifest.chain", False,
+                               f"shard {m.shard_id}: manifest claims "
+                               f"{m.admitted_count} ballot(s), the record "
+                               f"has none from its chain")
+                continue
+            _, count, last_code = got
+            if count != m.admitted_count:
+                res.record("V.shard_manifest.chain", False,
+                           f"shard {m.shard_id}: manifest claims "
+                           f"{m.admitted_count} ballot(s), its chain has "
+                           f"{count}")
+            if last_code != m.head_hash:
+                res.record("V.shard_manifest.chain", False,
+                           f"shard {m.shard_id}: chain head "
+                           f"{last_code.hex()[:16]} != manifest head "
+                           f"{m.head_hash.hex()[:16]}")
+        if agg.dup_ids:
+            some = ", ".join(sorted(agg.dup_ids)[:3])
+            res.record("V.shard_manifest.overlap", False,
+                       f"{len(agg.dup_ids)} ballot id(s) published by more "
+                       f"than one shard chain: {some}")
+        want_total = sum(m.admitted_count for m in manifests)
+        if want_total != agg.total_count:
+            res.record("V.shard_manifest.complete", False,
+                       f"manifests claim {want_total} ballot(s), the "
+                       f"record has {agg.total_count}")
+        for name in ("signature", "seed", "chain", "overlap", "complete"):
+            res.record(f"V.shard_manifest.{name}", True)
 

@@ -88,6 +88,176 @@ def sample_manifest(ncontests: int = 1, nselections: int = 2) -> Manifest:
     )
 
 
+def _watch_log(path: str, needle: bytes, count: int = 1,
+               timeout: float = 60.0) -> bool:
+    """Poll a subprocess's captured stdout until ``needle`` appears at
+    least ``count`` times (registration/liveness markers)."""
+    deadline = clock.now() + timeout
+    while clock.now() < deadline:
+        try:
+            with open(path, "rb") as f:
+                if f.read().count(needle) >= count:
+                    return True
+        except OSError:
+            pass
+        clock.sleep(0.25)
+    return False
+
+
+def _fabric_encrypt_phase(args, out, record_dir, cmd_out, group_flags,
+                          manifest, log, procs, phase_fail):
+    """Phase 2 through the sharded serving fabric: router + N worker
+    subprocesses, the driver as gRPC client, shard merge at the end.
+    Returns True, or the run's failing exit code."""
+    import threading
+
+    from electionguard_tpu.cli.common import resolve_group
+    from electionguard_tpu.fabric.merge import merge_shard_records
+    from electionguard_tpu.serve import journal as wal
+    from electionguard_tpu.serve.service import EncryptionClient
+
+    group = resolve_group(argparse.Namespace(group=args.group))
+    n = args.fabric_workers
+    shards_root = os.path.join(out, "shards")
+    router_port = find_free_port()
+    router_cmd = RunCommand.python_module(
+        "fabric-router", "electionguard_tpu.cli.run_router",
+        ["-port", str(router_port)] + group_flags, cmd_out)
+    procs.append(router_cmd)
+    clock.sleep(1.5)  # let the front door bind
+
+    def launch_worker(i, env=None):
+        return RunCommand.python_module(
+            f"encryption-worker-{i}",
+            "electionguard_tpu.cli.run_encryption_service",
+            ["-in", record_dir, "-out",
+             os.path.join(shards_root, f"shard-w{i}"),
+             "-port", "0", "-router", f"localhost:{router_port}",
+             "-workerId", f"w{i}", "-fixedNonces",
+             "-timestamp", "1754000000", "-maxBatch", "8",
+             "-maxWaitMs", "15"] + group_flags, cmd_out, env=env)
+
+    workers = []
+    for i in range(n):
+        env = None
+        if args.chaos_fabric and i == 0:
+            env = {"EGTPU_CHAOS_HOLD_AFTER_BALLOTS": "2"}
+        workers.append(launch_worker(i, env=env))
+    procs.extend(workers)
+    # every shard must be in the routing set before load starts
+    if not _watch_log(router_cmd.stdout_path, b" live at ", count=n,
+                      timeout=180):
+        return phase_fail("fabric-startup", [router_cmd] + workers)
+    log.info("[2] fabric up: router :%d routing %d shards", router_port, n)
+    if args.chaos_fabric:
+        log.info("CHAOS: worker 0 wedges after 2 ballots and is "
+                 "SIGKILL'd mid-load; its admissions must requeue onto "
+                 "surviving shards")
+
+    ballots = list(RandomBallotProvider(manifest, args.nballots,
+                                        seed=11).ballots())
+    results: dict[str, object] = {}
+    errors: list[BaseException] = []
+    lock = threading.Lock()
+
+    def client_run(idx):
+        client = EncryptionClient(f"localhost:{router_port}", group)
+        try:
+            for bi in range(idx, len(ballots), 4):
+                b = ballots[bi]
+                spoil = (args.spoil_every > 0
+                         and (bi + 1) % args.spoil_every == 0)
+                enc = client.encrypt(b, spoil=spoil, timeout=300)
+                with lock:
+                    results[b.ballot_id] = enc
+        except BaseException as e:  # noqa: BLE001 — collected, asserted below
+            with lock:
+                errors.append(e)
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=client_run, args=(i,), daemon=True)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+
+    if args.chaos_fabric:
+        # wait for the wedge to bite AND for an admission to land behind
+        # it: a post-wedge admission is journaled but can never publish,
+        # so pending>=1 here is stable, not a publish race — the SIGKILL
+        # is guaranteed to strand admitted-but-unpublished work
+        if not _watch_log(workers[0].stdout_path, b"worker wedged",
+                          timeout=120):
+            return phase_fail("fabric-chaos-arm", [router_cmd] + workers)
+        w0_journal = os.path.join(shards_root, "shard-w0",
+                                  wal.JOURNAL_NAME)
+        deadline = clock.now() + 120
+        while clock.now() < deadline:
+            try:
+                if len(wal.replay(w0_journal)) >= 1:
+                    break
+            except OSError:
+                pass
+            clock.sleep(0.2)
+        else:
+            return phase_fail("fabric-chaos-arm", [router_cmd] + workers)
+        workers[0].kill_hard()   # SIGKILL: no drain, torn stream allowed
+        log.info("CHAOS: worker 0 SIGKILL'd; load must complete on the "
+                 "surviving %d shard(s)", n - 1)
+        # the router requeues the dead shard's in-flight admissions; the
+        # stuck client calls complete on survivors
+        if not _watch_log(router_cmd.stdout_path, b"requeued ",
+                          timeout=120):
+            return phase_fail("fabric-chaos-requeue",
+                              [router_cmd] + workers)
+        workers[0]._env.pop("EGTPU_CHAOS_HOLD_AFTER_BALLOTS", None)
+        workers[0].restart()
+        # the relaunch reclaims shard 0 (same -workerId), tombstones the
+        # requeued ids instead of replaying them, and serves again (the
+        # second "serving on port" in its appended log): only a worker
+        # that finished recovery can drain and sign its shard manifest
+        if not _watch_log(router_cmd.stdout_path, b"re-registered",
+                          timeout=120):
+            return phase_fail("fabric-chaos-rejoin",
+                              [router_cmd] + workers)
+        if not _watch_log(workers[0].stdout_path, b"serving on port",
+                          count=2, timeout=120):
+            return phase_fail("fabric-chaos-rejoin",
+                              [router_cmd] + workers)
+
+    for t in threads:
+        t.join(timeout=600)
+    if errors or len(results) != args.nballots:
+        for e in errors[:5]:
+            log.error("fabric client error: %r", e)
+        log.error("fabric load: %d/%d ballots admitted", len(results),
+                  args.nballots)
+        return phase_fail("fabric-load", [router_cmd] + workers)
+    log.info("[2] fabric load done: %d/%d ballots admitted, zero lost",
+             len(results), args.nballots)
+
+    # graceful drain: every worker closes its stream and signs its shard
+    # manifest; then the router goes down and the driver merges
+    for w in workers:
+        w.process.terminate()
+    if not wait_all(workers, timeout=180):
+        return phase_fail("fabric-drain", [router_cmd] + workers)
+    router_cmd.process.terminate()
+    if router_cmd.wait_for(30) is None:
+        router_cmd.kill()
+    shard_dirs = sorted(
+        os.path.join(shards_root, d) for d in os.listdir(shards_root))
+    rep = merge_shard_records(group, shard_dirs, record_dir)
+    log.info("[2] merged %d shard records -> %s (%s)", rep.n_shards,
+             record_dir, " ".join(f"s{sid}={cnt}"
+                                  for sid, cnt in rep.per_shard))
+    if rep.n_ballots != args.nballots:
+        log.error("merged record has %d ballots, expected %d",
+                  rep.n_ballots, args.nballots)
+        return phase_fail("fabric-merge", [router_cmd] + workers)
+    return True
+
+
 def main(argv=None) -> int:
     log = setup_logging("RunRemoteWorkflow")
     ap = argparse.ArgumentParser("RunRemoteWorkflow")
@@ -119,6 +289,23 @@ def main(argv=None) -> int:
                          "right after its first shuffle commits; the "
                          "coordinator must requeue the stage on the "
                          "extra spare this flag also launches")
+    ap.add_argument("-fabricWorkers", dest="fabric_workers", type=int,
+                    default=0,
+                    help="run phase 2 through the sharded serving fabric: "
+                         "a router subprocess plus N encryption-worker "
+                         "subprocesses, each publishing its own shard "
+                         "record under a signed manifest; the driver "
+                         "merges the shards into the one verifiable "
+                         "record (fabric/merge.py) before phase 3")
+    ap.add_argument("-chaosKillEncryptionWorker", dest="chaos_fabric",
+                    action="store_true",
+                    help="chaos hook for -fabricWorkers: worker 0 wedges "
+                         "after 2 ballots (EGTPU_CHAOS_HOLD_AFTER_"
+                         "BALLOTS) and is SIGKILL'd mid-load; the router "
+                         "must requeue its in-flight admissions onto "
+                         "surviving shards, the relaunched worker must "
+                         "reclaim its shard without double-publishing, "
+                         "and the merged record must verify green")
     ap.add_argument("-spoilEvery", dest="spoil_every", type=int, default=5,
                     help="spoil every Nth ballot (0 = none); spoiled "
                          "ballots are decrypted in phase 4 and checked by "
@@ -148,6 +335,10 @@ def main(argv=None) -> int:
     if args.mix > 0 and args.mix_servers > 0:
         log.error("-mix and -mixServers are mutually exclusive (same "
                   "artifact, different topology)")
+        return 1
+    if args.chaos_fabric and args.fabric_workers < 2:
+        log.error("-chaosKillEncryptionWorker needs -fabricWorkers >= 2 "
+                  "(someone has to survive)")
         return 1
 
     out = args.output
@@ -290,13 +481,20 @@ def main(argv=None) -> int:
         pub = Publisher(out)
         for b in RandomBallotProvider(manifest, args.nballots, seed=11).ballots():
             pub.write_plaintext_ballot("plaintext_ballots", b)
-        enc = RunCommand.python_module(
-            "batch-encryption", "electionguard_tpu.cli.run_batch_encryption",
-            ["-in", record_dir, "-ballots", ballots_dir, "-out", record_dir,
-             "-fixedNonces", "-spoilEvery", str(args.spoil_every)] + group_flags,
-            cmd_out)
-        if not wait_all([enc], timeout=600):
-            return phase_fail("encryption", [enc])
+        if args.fabric_workers > 0:
+            ok = _fabric_encrypt_phase(args, out, record_dir, cmd_out,
+                                       group_flags, manifest, log, procs,
+                                       phase_fail)
+            if ok is not True:
+                return ok
+        else:
+            enc = RunCommand.python_module(
+                "batch-encryption", "electionguard_tpu.cli.run_batch_encryption",
+                ["-in", record_dir, "-ballots", ballots_dir, "-out", record_dir,
+                 "-fixedNonces", "-spoilEvery", str(args.spoil_every)] + group_flags,
+                cmd_out)
+            if not wait_all([enc], timeout=600):
+                return phase_fail("encryption", [enc])
         dt = clock.now() - t0
         log.info("[2] encrypted %d ballots in %.1fs (%.3fs/ballot)",
                  args.nballots, dt, dt / max(args.nballots, 1))

@@ -279,6 +279,74 @@ def test_five_phase_workflow_traced(tmp_path):
     assert tool.returncode == 0, tool.stdout + tool.stderr
 
 
+def test_five_phase_workflow_fabric(tmp_path):
+    """Phase 2 through the sharded serving fabric: a router process
+    fronting 2 encryption-worker processes, each publishing its own
+    shard record under a signed manifest; the driver merges the shards
+    into the one record phases 3-5 consume.  The phase-5 verifier must
+    be green INCLUDING the V.shard_manifest family, and the traced run
+    must show the router and both workers on the single run timeline."""
+    proc = _run_workflow(tmp_path, "tiny", nballots=8, timeout=600,
+                         extra_flags=["-fabricWorkers", "2", "-trace"])
+    out = proc.stdout + proc.stderr
+    assert "fabric up: router" in out
+    assert "fabric load done: 8/8 ballots admitted, zero lost" in out
+    assert "merged 2 shard records" in out
+    for check in ("signature", "seed", "chain", "overlap", "complete"):
+        assert f"PASS V.shard_manifest.{check}" in out, out
+    # both shards published + the merged record carries both manifests
+    import json
+    with open(os.path.join(str(tmp_path), "record",
+                           "shard_manifests.json")) as f:
+        manifests = json.load(f)
+    assert [m["shard_id"] for m in manifests] == [0, 1]
+    assert sum(m["admitted_count"] for m in manifests) == 8
+    for i in range(2):
+        assert os.path.exists(os.path.join(
+            str(tmp_path), "shards", f"shard-w{i}", "shard_manifest.json"))
+    # the whole fabric joins the run's single trace
+    from electionguard_tpu.obs import assemble
+    spans = assemble.load_spans(os.path.join(str(tmp_path), "trace"))
+    report = assemble.validate(spans)
+    assert len(report["trace_ids"]) == 1
+    procs = {p.split(":")[0] for p in report["processes"]}
+    assert {"fabric-router", "encryption-worker-0",
+            "encryption-worker-1"} <= procs
+    assert "worker.batch" in {s["name"] for s in spans}
+
+
+def test_five_phase_workflow_fabric_chaos_kill(tmp_path):
+    """The fleet SIGKILL drill: worker 0 wedges after 2 ballots (chaos
+    knob), is SIGKILL'd mid-load with admitted-but-unpublished ballots
+    in its journal, the router requeues them onto the survivor, and the
+    relaunched worker reclaims its shard — tombstoning the requeued ids
+    instead of double-publishing.  Zero lost admitted ballots, and the
+    merged record still verifies green through V.shard_manifest."""
+    proc = _run_workflow(
+        tmp_path, "tiny", nballots=8, timeout=900,
+        extra_flags=["-fabricWorkers", "2",
+                     "-chaosKillEncryptionWorker"])
+    out = proc.stdout + proc.stderr
+    assert "CHAOS: worker 0 SIGKILL'd" in out
+    assert "fabric load done: 8/8 ballots admitted, zero lost" in out
+    for check in ("signature", "seed", "chain", "overlap", "complete"):
+        assert f"PASS V.shard_manifest.{check}" in out, out
+    with open(os.path.join(str(tmp_path), "logs",
+                           "fabric-router.stdout")) as f:
+        router_log = f.read()
+    assert "requeued" in router_log
+    assert "re-registered" in router_log
+    with open(os.path.join(str(tmp_path), "logs",
+                           "encryption-worker-0.stdout")) as f:
+        w0_log = f.read()
+    assert "worker wedged" in w0_log
+    # the relaunch registered against the router and tombstoned the
+    # journaled admissions the router had requeued onto the survivor
+    # (replaying them would double-publish)
+    assert "requeued ids to skip" in w0_log
+    assert "journaled admissions requeued to other shards" in w0_log
+
+
 def test_five_phase_workflow_production(tmp_path):
     """The reference's full scenario on the REAL group over real gRPC:
     3 guardians, quorum 2, 2 available -> compensated decryption, spoiled

@@ -1,0 +1,365 @@
+"""Sharded serving fabric: router registration and routing, signed
+shard manifests, the verifiable merge, and the ``V.shard_manifest``
+verifier family.
+
+One module-scoped fleet (router + 2 in-process shard services on the
+tiny group) drives 8 ballots through the front door, drains, and merges
+— the assertion tests then pick the run apart.  The three adversarial
+manifest-tampering cases the acceptance criteria name (overlapping
+shard ranges, gapped chain, forged manifest signature) each pin their
+own named ``V.shard_manifest.*`` check going red.
+"""
+
+import dataclasses
+import json
+import os
+import shutil
+
+import pytest
+
+from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+from electionguard_tpu.fabric import manifest as fab_manifest
+from electionguard_tpu.fabric.merge import (MergeError, merge_shard_records,
+                                            merge_sub_tallies)
+from electionguard_tpu.fabric.router import EncryptionRouter
+from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+from electionguard_tpu.publish import pb
+from electionguard_tpu.publish.election_record import ElectionConfig
+from electionguard_tpu.publish.publisher import (Consumer,
+                                                 election_record_from_consumer)
+from electionguard_tpu.remote import rpc_util
+from electionguard_tpu.serve.service import (EncryptionClient,
+                                             EncryptionService)
+from electionguard_tpu.tally.accumulate import accumulate_ballots
+from electionguard_tpu.verify.verifier import Verifier
+from tests.test_keyceremony import tiny_manifest
+
+NBALLOTS = 8
+
+
+@pytest.fixture(scope="module")
+def fab_init(tgroup):
+    trustees = [KeyCeremonyTrustee(tgroup, f"guardian-{i}", i + 1, 2)
+                for i in range(3)]
+    return key_ceremony_exchange(trustees, tgroup).make_election_initialized(
+        ElectionConfig(tiny_manifest(), 3, 2), {"created_by": "test_fabric"})
+
+
+def _register(router_url, group, worker_id, url, public_key, nonce):
+    ch = rpc_util.make_channel(router_url)
+    try:
+        return rpc_util.Stub(ch, "FabricRegistrationService").call(
+            "registerEncryptionWorker",
+            pb.RegisterEncryptionWorkerRequest(
+                worker_id=worker_id, remote_url=url,
+                group_fingerprint=group.fingerprint(),
+                registration_nonce=nonce,
+                manifest_public_key=public_key))
+    finally:
+        ch.close()
+
+
+@pytest.fixture(scope="module")
+def fleet(tgroup, fab_init, tmp_path_factory):
+    """Router + 2 shard services, NBALLOTS routed through the front
+    door, graceful drain, verifiable merge — the artifacts every test
+    below asserts on."""
+    g = tgroup
+    tmp = tmp_path_factory.mktemp("fabric")
+    router = EncryptionRouter(g, health_interval=0.2, health_timeout=2.0)
+    services = []
+    try:
+        for i in range(2):
+            wid = f"w{i}"
+            kp = fab_manifest.ManifestKeypair.generate(g)
+            svc_port = rpc_util.find_free_port()
+            pk = kp.public.value.to_bytes(
+                (kp.public.value.bit_length() + 7) // 8 or 1, "big")
+            resp = _register(router.url, g, wid, f"localhost:{svc_port}",
+                             pk, os.urandom(16))
+            assert not resp.error, resp.error
+            sid = resp.shard_id
+            svc = EncryptionService(
+                fab_init, g, port=svc_port,
+                out_dir=str(tmp / f"shard{sid}"),
+                max_batch=8, max_wait_ms=10, seed=g.int_to_q(42),
+                timestamp=1754_000_000, shard_id=sid, worker_id=wid,
+                chain_seed=fab_manifest.shard_chain_seed(
+                    fab_init.manifest_hash, sid),
+                skip_ballot_ids=list(resp.requeued_ballot_ids),
+                manifest_keypair=kp)
+            services.append(svc)
+        assert router.wait_for_workers(2, timeout=60, live=True), \
+            router.snapshot()
+
+        client = EncryptionClient(router.url, g)
+        ballots = list(RandomBallotProvider(
+            tiny_manifest(), NBALLOTS, seed=7).ballots())
+        seen_shards = set()
+        encrypted = []
+        for b in ballots[:4]:
+            enc = client.encrypt(b)
+            assert enc is not None
+            encrypted.append(enc)
+            seen_shards.add(client.last_shard_id)
+        res = client.encrypt_batch(ballots[4:])
+        assert all(e is not None for e, _ in res), res
+        encrypted.extend(e for e, _ in res)
+        seen_shards.add(client.last_shard_id)
+        health = client.health()
+        client.close()
+
+        manifests = {}
+        for svc in services:
+            svc.drain()
+            m = fab_manifest.read_shard_manifest(svc.publisher.dir)
+            manifests[m.shard_id] = m
+        shard_dirs = [svc.publisher.dir for svc in services]
+        merged = str(tmp / "merged")
+        report = merge_shard_records(g, shard_dirs, merged)
+
+        yield {
+            "g": g, "init": fab_init, "router": router,
+            "seen_shards": seen_shards, "health": health,
+            "encrypted": encrypted, "manifests": manifests,
+            "shard_dirs": shard_dirs, "merged": merged, "report": report,
+            "tmp": tmp,
+        }
+    finally:
+        for svc in services:
+            svc.shutdown()
+        router.shutdown()
+
+
+# =====================================================================
+# routing plane
+# =====================================================================
+
+
+def test_routing_spreads_across_both_shards(fleet):
+    # least-queue-depth with round-robin tiebreak: 8 sequential/batch
+    # requests against two idle shards must not pin to one
+    assert fleet["seen_shards"] == {0, 1}
+    snap = {s["shard_id"]: s for s in fleet["router"].snapshot()}
+    assert set(snap) == {0, 1}
+    assert all(s["forwarded"] > 0 for s in snap.values())
+
+
+def test_router_health_is_fleet_aggregate(fleet):
+    # the front door answers health for the FLEET: shard_id=-1 marks
+    # the routing plane (a worker answers with its own shard id)
+    assert fleet["health"].status == "SERVING"
+    assert fleet["health"].shard_id == -1
+
+
+def test_registration_nonce_is_idempotent(tgroup):
+    router = EncryptionRouter(tgroup, health_interval=30.0)
+    try:
+        nonce = os.urandom(16)
+        r1 = _register(router.url, tgroup, "wx", "localhost:1", b"\x01",
+                       nonce)
+        # lost-response retry: same (worker, nonce, url) replays the
+        # SAME shard assignment instead of minting a second shard
+        r2 = _register(router.url, tgroup, "wx", "localhost:1", b"\x01",
+                       nonce)
+        assert not r1.error and not r2.error
+        assert r1.shard_id == r2.shard_id
+        # same id, same nonce, DIFFERENT url: refused (two live workers
+        # can't share an identity)
+        r3 = _register(router.url, tgroup, "wx", "localhost:2", b"\x01",
+                       nonce)
+        assert "already registered" in r3.error
+        # fresh nonce: a relaunched worker reclaims its shard
+        r4 = _register(router.url, tgroup, "wx", "localhost:2", b"\x01",
+                       os.urandom(16))
+        assert not r4.error and r4.shard_id == r1.shard_id
+        # a different worker gets the next shard
+        r5 = _register(router.url, tgroup, "wy", "localhost:3", b"\x02",
+                       os.urandom(16))
+        assert r5.shard_id == r1.shard_id + 1
+    finally:
+        router.shutdown()
+
+
+# =====================================================================
+# signed shard manifests + merge
+# =====================================================================
+
+
+def test_shard_manifests_signed_and_seeded(fleet):
+    g, init = fleet["g"], fleet["init"]
+    assert set(fleet["manifests"]) == {0, 1}
+    total = 0
+    for sid, m in fleet["manifests"].items():
+        assert fab_manifest.verify_manifest_signature(g, m)
+        assert m.chain_seed == fab_manifest.shard_chain_seed(
+            init.manifest_hash, sid)
+        assert m.admitted_count > 0
+        total += m.admitted_count
+    assert total == NBALLOTS
+
+
+def test_merge_produces_complete_record(fleet):
+    assert fleet["report"].n_shards == 2
+    assert fleet["report"].n_ballots == NBALLOTS
+    rec = election_record_from_consumer(Consumer(fleet["merged"],
+                                                 fleet["g"]))
+    assert len(rec.encrypted_ballots) == NBALLOTS
+    assert [m.shard_id for m in rec.shard_manifests] == [0, 1]
+    # every ballot routed through the front door is in the merged record
+    merged_ids = {b.ballot_id for b in rec.encrypted_ballots}
+    assert merged_ids == {b.ballot_id for b in fleet["encrypted"]}
+
+
+def test_sub_tally_merge_is_homomorphic(fleet):
+    # per-shard sub-tallies added component-wise == one accumulate over
+    # the merged stream (the whole point of merging ciphertexts)
+    g, init = fleet["g"], fleet["init"]
+    subs = [accumulate_ballots(init,
+                               Consumer(d, g).iterate_encrypted_ballots())
+            for d in fleet["shard_dirs"]]
+    merged_tally = merge_sub_tallies(g, subs)
+    direct = accumulate_ballots(
+        init, Consumer(fleet["merged"], g).iterate_encrypted_ballots())
+    assert merged_tally.encrypted_tally == direct.encrypted_tally
+
+
+def test_merge_refuses_tampered_admitted_count(fleet):
+    # tamper on a COPY so the shared fixture dirs stay pristine
+    g = fleet["g"]
+    tdir = str(fleet["tmp"] / "tampered-shard0")
+    shutil.copytree(fleet["shard_dirs"][0], tdir)
+    mpath = os.path.join(tdir, "shard_manifest.json")
+    with open(mpath) as f:
+        md = json.load(f)
+    md["admitted_count"] += 1
+    with open(mpath, "w") as f:
+        json.dump(md, f)
+    with pytest.raises(MergeError):
+        merge_shard_records(g, [tdir, fleet["shard_dirs"][1]],
+                            str(fleet["tmp"] / "merged-tampered"))
+
+
+# =====================================================================
+# V.shard_manifest verifier family
+# =====================================================================
+
+
+def _verify_with(fleet, manifests=None, ballots=None):
+    rec = election_record_from_consumer(Consumer(fleet["merged"],
+                                                 fleet["g"]))
+    if manifests is not None:
+        rec.shard_manifests = manifests
+    if ballots is not None:
+        rec.encrypted_ballots = ballots
+    return Verifier(rec, fleet["g"]).verify()
+
+
+def test_merged_record_verifies_green(fleet):
+    res = _verify_with(fleet)
+    assert res.ok, res.summary()
+    for check in ("signature", "seed", "chain", "overlap", "complete"):
+        assert res.checks.get(f"V.shard_manifest.{check}") is True, \
+            res.summary()
+
+
+def test_forged_manifest_signature_goes_red(fleet):
+    # adversarial case 1: forged manifest (claims one more admission
+    # than the trustee-signed statement covers)
+    ms = list(election_record_from_consumer(
+        Consumer(fleet["merged"], fleet["g"])).shard_manifests)
+    forged = [dataclasses.replace(
+        ms[0], admitted_count=ms[0].admitted_count + 1)] + ms[1:]
+    res = _verify_with(fleet, manifests=forged)
+    assert res.checks["V.shard_manifest.signature"] is False
+    assert not res.ok
+
+
+def test_gapped_chain_goes_red(fleet):
+    # adversarial case 2: a mid-chain ballot quietly dropped from the
+    # published stream — its shard's chain is no longer contiguous
+    balls = list(Consumer(fleet["merged"],
+                          fleet["g"]).iterate_encrypted_ballots())
+    gapped = balls[:4] + balls[5:]
+    res = _verify_with(fleet, ballots=gapped)
+    assert res.checks["V.shard_manifest.chain"] is False
+    assert not res.ok
+
+
+def test_overlapping_shard_ranges_go_red(fleet):
+    # adversarial case 3: the same ballot published under two chains
+    # (double-counted admission)
+    balls = list(Consumer(fleet["merged"],
+                          fleet["g"]).iterate_encrypted_ballots())
+    res = _verify_with(fleet, ballots=balls + [balls[0]])
+    assert res.checks["V.shard_manifest.overlap"] is False
+    assert not res.ok
+
+
+def test_wrong_chain_seed_goes_red(fleet):
+    ms = election_record_from_consumer(
+        Consumer(fleet["merged"], fleet["g"])).shard_manifests
+    bad = [dataclasses.replace(ms[0], chain_seed=b"\x00" * 32)] + ms[1:]
+    res = _verify_with(fleet, manifests=bad)
+    assert res.checks["V.shard_manifest.seed"] is False
+    assert not res.ok
+
+
+def test_feeder_partial_verify_stays_green(fleet):
+    # the streaming-verify path (cli/run_verifier feeder) must carry
+    # the shard machinery: partials merged + finalized == one-shot green
+    from electionguard_tpu.verify.verifier import (VerificationResult,
+                                                   _BallotAggregates)
+    g = fleet["g"]
+    rec = election_record_from_consumer(Consumer(fleet["merged"], g))
+    balls = rec.encrypted_ballots
+    v = Verifier(rec, g)
+    parts = []
+    for lo, hi, prev in ((0, 3, None), (3, NBALLOTS, balls[2].code)):
+        pr, pa = VerificationResult(), _BallotAggregates()
+        v.verify_ballots_partial(list(balls[lo:hi]), pr, pa,
+                                 prev_code=prev)
+        parts.append((pr, pa))
+    mres, magg = Verifier.merge_partials(parts)
+    mres = v.finalize(mres, magg)
+    assert mres.ok, mres.summary()
+
+
+# =====================================================================
+# manifest primitives + egtop shard rows
+# =====================================================================
+
+
+def test_manifest_sign_verify_tamper(tgroup):
+    kp = fab_manifest.ManifestKeypair.generate(tgroup)
+    m = fab_manifest.sign_manifest(tgroup, kp, fab_manifest.ShardManifest(
+        shard_id=3, worker_id="w3", chain_seed=b"\x11" * 32,
+        head_hash=b"\x22" * 32, admitted_count=5,
+        public_key=kp.public.value))
+    assert fab_manifest.verify_manifest_signature(tgroup, m)
+    # an unsigned manifest never verifies
+    assert not fab_manifest.verify_manifest_signature(
+        tgroup, dataclasses.replace(m, signature=None))
+    for field, value in (("admitted_count", 6), ("worker_id", "w4"),
+                         ("head_hash", b"\x23" * 32), ("shard_id", 4)):
+        assert not fab_manifest.verify_manifest_signature(
+            tgroup, dataclasses.replace(m, **{field: value}))
+    # dict round-trip preserves the signature
+    again = fab_manifest.ShardManifest.from_dict(m.to_dict())
+    assert fab_manifest.verify_manifest_signature(tgroup, again)
+
+
+def test_egtop_parses_shard_heartbeat_phase():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "egtop", os.path.join(os.path.dirname(__file__), os.pardir,
+                              "tools", "egtop.py"))
+    egtop = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(egtop)
+    s = egtop.parse_shard("serving shard=2 head=00ddc0ffee123456 "
+                          "admitted=41")
+    assert s == {"shard": 2, "head": "00ddc0ffee123456", "admitted": 41}
+    assert egtop.parse_shard("mixing round 3") is None
+    assert egtop.parse_shard("") is None
+    assert egtop.parse_shard("serving shard=x head=y admitted=z") is None

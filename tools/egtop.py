@@ -31,6 +31,25 @@ def _paint(text: str, color: str, enabled: bool) -> str:
     return f"{_COLORS[color]}{text}{_RESET}"
 
 
+def parse_shard(phase: str) -> dict | None:
+    """Parse the serving-plane heartbeat phase string
+    ``serving shard=<id> head=<hex16> admitted=<n>`` (set by
+    serve/service.py in fabric mode) into its fields; None when the
+    process is not a shard worker."""
+    if not phase or "shard=" not in phase:
+        return None
+    out = {}
+    for tok in phase.split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k] = v
+    try:
+        return {"shard": int(out["shard"]), "head": out.get("head", "-"),
+                "admitted": int(out.get("admitted", "0"))}
+    except (KeyError, ValueError):
+        return None
+
+
 def render(status, color: bool = True) -> str:
     """One frame of the board from a FleetStatusResponse."""
     lines = []
@@ -52,6 +71,23 @@ def render(status, color: bool = True) -> str:
             f"{p.heartbeat_age_s:>6.1f}s {p.queue_depth:>6} "
             f"{p.p99_ms:>7.1f} {p.spans:>7} {p.dropped:>5}  "
             f"{p.phase or '-'}", row_color, color))
+    # fabric: one row per encryption shard, parsed from the worker
+    # heartbeats' phase fields (serve/service.py emits
+    # "serving shard=<id> head=<hex16> admitted=<n>")
+    shards = []
+    for p in status.processes:
+        s = parse_shard(p.phase)
+        if s is not None:
+            shards.append((s, p))
+    if shards:
+        lines.append(f"{'':1} {'SHARD':<6}{'WORKER':<26}{'STATE':<7}"
+                     f"{'QUEUE':>6} {'ADMITTED':>9}  CHAIN_HEAD")
+        for s, p in sorted(shards, key=lambda sp: sp[0]["shard"]):
+            row_color = {"DEAD": "red", "ALIVE": "green"}.get(p.state, "")
+            lines.append(_paint(
+                f"  {s['shard']:<6}{p.proc:<26}{p.state:<7}"
+                f"{p.queue_depth:>6} {s['admitted']:>9}  {s['head']}",
+                row_color, color))
     if status.alerts:
         lines.append("recent alerts:")
         for a in list(status.alerts)[-8:]:

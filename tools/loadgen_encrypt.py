@@ -47,15 +47,24 @@ def run_loadgen(url: str, manifest, group, nclients: int = 4,
                 nballots: int = 32, seed: int = 0,
                 retry_backoff_s: float = 0.05,
                 max_retries: int = 200,
-                latency_out: str = None) -> dict:
+                latency_out: str = None,
+                batch: int = 1) -> dict:
     """Fire ``nclients`` threads × ``nballots`` single-ballot rpcs at
     ``url``; returns the report dict (also printed by main).
 
+    ``url`` may be a single worker OR a fabric router (the surface is
+    identical); behind a router every response carries the answering
+    shard id, and the report grows a ``per_shard`` latency breakdown.
+
     ``latency_out``: optional JSONL path — one line per request with the
-    client-observed latency AND the request's trace/span ids (when
-    tracing is on, every rpc carries them to the service), so
-    client-side and server-side latency can be joined post-hoc against
-    the span timeline.
+    client-observed latency, the answering ``shard``, AND the request's
+    trace/span ids (when tracing is on, every rpc carries them to the
+    service), so client↔shard joins work in the merged trace.
+
+    ``batch``: >1 groups each client's ballots into encryptBallotBatch
+    rpcs of this size (amortizes rpc overhead; the router forwards a
+    whole batch to one shard).  Per-ballot latency is then its batch
+    rpc's latency.
     """
     from electionguard_tpu.ballot.plaintext import RandomBallotProvider
     from electionguard_tpu.obs import trace
@@ -63,67 +72,112 @@ def run_loadgen(url: str, manifest, group, nclients: int = 4,
 
     lock = threading.Lock()
     latencies: list[float] = []
+    shard_lat: dict[int, list[float]] = {}
     errors: list[str] = []
     rejected = 0
     codes: dict[str, bytes] = {}
     lat_f = open(latency_out, "w") if latency_out else None
 
-    def one_client(idx: int):
+    def record(b, ok, err, lat, attempts, shard, enc, sp, ts_us):
+        with lock:
+            if ok:
+                latencies.append(lat)
+                shard_lat.setdefault(shard, []).append(lat)
+                codes[b.ballot_id] = enc.code
+            else:
+                errors.append(f"{b.ballot_id}: {err}")
+            if lat_f is not None:
+                lat_f.write(json.dumps(
+                    {"ballot_id": b.ballot_id,
+                     "trace_id": sp.trace_id,
+                     "span_id": sp.span_id,
+                     "ts": ts_us,
+                     "shard": shard,
+                     "latency_ms": (round(lat * 1e3, 3)
+                                    if lat is not None else None),
+                     "attempts": attempts, "ok": ok,
+                     "error": err},
+                    separators=(",", ":")) + "\n")
+
+    def send_one(client, b):
         nonlocal rejected
+        ts_us = time.time_ns() // 1000
+        ok, err, lat, attempts = False, None, None, 0
+        enc = None
+        sp = trace.span("loadgen.request",
+                        {"ballot_id": b.ballot_id}
+                        if trace.enabled() else None)
+        with sp:
+            for attempt in range(max_retries):
+                attempts = attempt + 1
+                t0 = time.monotonic()
+                try:
+                    enc = client.encrypt(b)
+                except grpc.RpcError as e:
+                    if (e.code()
+                            == grpc.StatusCode.RESOURCE_EXHAUSTED
+                            and attempt < max_retries - 1):
+                        with lock:
+                            rejected += 1
+                        time.sleep(retry_backoff_s
+                                   * (1 + attempt % 5))
+                        continue
+                    err = str(e.code())
+                    break
+                except ValueError as e:  # in-band invalid ballot
+                    err = str(e)
+                    break
+                lat = time.monotonic() - t0
+                ok = True
+                break
+        record(b, ok, err, lat, attempts, client.last_shard_id, enc, sp,
+               ts_us)
+
+    def send_batch(client, chunk):
+        nonlocal rejected
+        ts_us = time.time_ns() // 1000
+        sp = trace.span("loadgen.batch",
+                        {"n": str(len(chunk))}
+                        if trace.enabled() else None)
+        with sp:
+            for attempt in range(max_retries):
+                t0 = time.monotonic()
+                try:
+                    results = client.encrypt_batch(chunk)
+                except grpc.RpcError as e:
+                    if (e.code()
+                            == grpc.StatusCode.RESOURCE_EXHAUSTED
+                            and attempt < max_retries - 1):
+                        with lock:
+                            rejected += 1
+                        time.sleep(retry_backoff_s
+                                   * (1 + attempt % 5))
+                        continue
+                    for b in chunk:
+                        record(b, False, str(e.code()), None, attempt + 1,
+                               client.last_shard_id, None, sp, ts_us)
+                    return
+                lat = time.monotonic() - t0
+                for b, (enc, err) in zip(chunk, results):
+                    record(b, err is None, err, lat, attempt + 1,
+                           client.last_shard_id, enc, sp, ts_us)
+                return
+
+    def one_client(idx: int):
         client = EncryptionClient(url, group)
         ballots = list(RandomBallotProvider(
             manifest, nballots, seed=seed + idx).ballots())
+        # distinct ids across clients AND across loadgen waves
+        # (ballot ids are unique election-wide)
+        ballots = [dataclasses.replace(
+            b, ballot_id=f"c{idx}s{seed}-{b.ballot_id}") for b in ballots]
         try:
-            for b in ballots:
-                # distinct ids across clients AND across loadgen waves
-                # (ballot ids are unique election-wide)
-                b = dataclasses.replace(
-                    b, ballot_id=f"c{idx}s{seed}-{b.ballot_id}")
-                ts_us = time.time_ns() // 1000
-                ok, err, lat, attempts = False, None, None, 0
-                sp = trace.span("loadgen.request",
-                                {"ballot_id": b.ballot_id}
-                                if trace.enabled() else None)
-                with sp:
-                    for attempt in range(max_retries):
-                        attempts = attempt + 1
-                        t0 = time.monotonic()
-                        try:
-                            enc = client.encrypt(b)
-                        except grpc.RpcError as e:
-                            if (e.code()
-                                    == grpc.StatusCode.RESOURCE_EXHAUSTED
-                                    and attempt < max_retries - 1):
-                                with lock:
-                                    rejected += 1
-                                time.sleep(retry_backoff_s
-                                           * (1 + attempt % 5))
-                                continue
-                            err = str(e.code())
-                            break
-                        except ValueError as e:  # in-band invalid ballot
-                            err = str(e)
-                            break
-                        lat = time.monotonic() - t0
-                        ok = True
-                        break
-                with lock:
-                    if ok:
-                        latencies.append(lat)
-                        codes[b.ballot_id] = enc.code
-                    else:
-                        errors.append(f"{b.ballot_id}: {err}")
-                    if lat_f is not None:
-                        lat_f.write(json.dumps(
-                            {"ballot_id": b.ballot_id,
-                             "trace_id": sp.trace_id,
-                             "span_id": sp.span_id,
-                             "ts": ts_us,
-                             "latency_ms": (round(lat * 1e3, 3)
-                                            if lat is not None else None),
-                             "attempts": attempts, "ok": ok,
-                             "error": err},
-                            separators=(",", ":")) + "\n")
+            if batch > 1:
+                for i in range(0, len(ballots), batch):
+                    send_batch(client, ballots[i:i + batch])
+            else:
+                for b in ballots:
+                    send_one(client, b)
         finally:
             client.close()
 
@@ -166,6 +220,20 @@ def run_loadgen(url: str, manifest, group, nclients: int = 4,
         "service_counters": counters,
         "error_samples": errors[:5],
     }
+    # fabric: behind a router every response names its shard (>= 0); a
+    # single worker answers -1 and the breakdown stays out of the report
+    if any(s >= 0 for s in shard_lat):
+        per_shard = {}
+        for s, lats in sorted(shard_lat.items()):
+            ls = sorted(lats)
+            per_shard[str(s)] = {
+                "completed": len(ls),
+                "ballots_per_s": (round(len(ls) / wall, 2)
+                                  if wall else 0.0),
+                "latency_p50_ms": round(_percentile(ls, 0.50) * 1e3, 1),
+                "latency_p99_ms": round(_percentile(ls, 0.99) * 1e3, 1),
+            }
+        report["per_shard"] = per_shard
     report["_codes"] = codes  # for callers that diff against offline
     return report
 
@@ -177,13 +245,20 @@ def main(argv=None) -> int:
 
     log = setup_logging("LoadgenEncrypt")
     ap = argparse.ArgumentParser("loadgen_encrypt")
-    ap.add_argument("-url", required=True, help="service host:port")
+    ap.add_argument("-url", default=None, help="service host:port")
+    ap.add_argument("-target", dest="url",
+                    help="alias of -url; a fabric router is a valid "
+                         "target (same rpc surface) and unlocks the "
+                         "per_shard report section")
     ap.add_argument("-in", dest="input", required=True,
                     help="record dir with election_initialized.pb "
                          "(manifest source)")
     ap.add_argument("-clients", type=int, default=4)
     ap.add_argument("-nballots", type=int, default=32,
                     help="ballots per client")
+    ap.add_argument("-batch", type=int, default=1,
+                    help="group each client's ballots into "
+                         "encryptBallotBatch rpcs of this size")
     ap.add_argument("-seed", type=int, default=0)
     ap.add_argument("-json", dest="json_out", default=None,
                     help="also write the report to this path")
@@ -193,12 +268,15 @@ def main(argv=None) -> int:
                          "joins against the server span timeline")
     add_group_flag(ap)
     args = ap.parse_args(argv)
+    if not args.url:
+        ap.error("one of -url / -target is required")
 
     group = resolve_group(args)
     init = Consumer(args.input, group).read_election_initialized()
     report = run_loadgen(args.url, init.config.manifest, group,
                          nclients=args.clients, nballots=args.nballots,
-                         seed=args.seed, latency_out=args.latency_out)
+                         seed=args.seed, latency_out=args.latency_out,
+                         batch=args.batch)
     report.pop("_codes", None)
     print(json.dumps(report, indent=2, sort_keys=True))
     if args.json_out:

@@ -251,6 +251,122 @@ def mix_sharded_phase(n_rows: int, width: int = 2) -> dict:
     }
 
 
+def fabric_phase(nballots: int, workers=(1, 2, 4),
+                 workdir: str = "/tmp/egtpu_scale_fabric",
+                 emulate_device_ms: float = 500.0) -> dict:
+    """Workers × ballots/s curve for the sharded serving fabric: for
+    each fleet size, launch a router + N encryption worker subprocesses
+    (reverse-dial registration), drive the router with the loadgen
+    harness, and record achieved fleet throughput.  Each fleet's shard
+    records are merged and counted — the curve is only reported for
+    fleets whose merged record is complete.
+
+    ``emulate_device_ms`` pads every worker's device leg to a fixed
+    wall-clock duration (EGTPU_FABRIC_EMULATE_DEVICE_MS): on a
+    single-host run all workers share the host's cores, so a raw curve
+    measures core contention, not the fabric — with per-batch device
+    time pinned (the real fleet's one-chip-per-worker regime) the curve
+    isolates what this PR adds, the routing plane's ability to keep N
+    shards busy concurrently.  This is the serving-plane analogue of
+    mix_sharded_phase's virtual 8-device mesh.  0 disables the
+    emulation and measures raw contended throughput."""
+    import shutil
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from loadgen_encrypt import run_loadgen
+
+    from electionguard_tpu.core.group import tiny_group
+    from electionguard_tpu.fabric.merge import merge_shard_records
+    from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+    from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+    from electionguard_tpu.publish.election_record import ElectionConfig
+    from electionguard_tpu.publish.publisher import Publisher
+    from electionguard_tpu.remote.rpc_util import find_free_port
+    from electionguard_tpu.workflow.e2e import _watch_log, sample_manifest
+    from electionguard_tpu.workflow.run_command import RunCommand, wait_all
+
+    g = tiny_group()
+    manifest = sample_manifest(1, 2)
+    trustees = [KeyCeremonyTrustee(g, "g0", 1, 1)]
+    init = key_ceremony_exchange(trustees, g).make_election_initialized(
+        ElectionConfig(manifest, 1, 1), {"created_by": "scale_run"})
+    if os.path.exists(workdir):
+        shutil.rmtree(workdir)
+    record_dir = os.path.join(workdir, "record")
+    Publisher(record_dir).write_election_initialized(init)
+    logs = os.path.join(workdir, "logs")
+
+    curve = []
+    for w in workers:
+        port = find_free_port()
+        url = f"localhost:{port}"
+        router = RunCommand.python_module(
+            f"router-x{w}", "electionguard_tpu.cli.run_router",
+            ["-port", str(port), "-group", "tiny"], logs)
+        shards_root = os.path.join(workdir, f"shards-x{w}")
+        svcs = [RunCommand.python_module(
+            f"worker-x{w}-{i}",
+            "electionguard_tpu.cli.run_encryption_service",
+            ["-in", record_dir, "-out", os.path.join(shards_root, f"w{i}"),
+             "-port", "0", "-router", url, "-workerId", f"w{i}",
+             "-fixedNonces", "-maxBatch", "8",
+             "-maxWaitMs", "10", "-group", "tiny"], logs,
+            env={"EGTPU_FABRIC_EMULATE_DEVICE_MS":
+                 str(emulate_device_ms)})
+            for i in range(w)]
+        try:
+            # prewarm compiles every bucket at startup, so the measured
+            # wave sees steady-state latency, not one-time compiles
+            assert _watch_log(router.stdout_path, b" live at ", count=w,
+                              timeout=300), f"fleet of {w} never went live"
+            # short warmup wave settles channels/threads before timing
+            run_loadgen(url, manifest, g, nclients=w, nballots=8,
+                        seed=1000 + w, batch=8)
+            # saturation load: full-bucket batch rpcs, 3 clients per
+            # worker (queue depth ~3 keeps every shard busy across
+            # client turnarounds), total offered load ∝ fleet size so
+            # each row measures capacity, not a fixed trickle
+            nclients = 3 * w
+            per_client = max(8, nballots // 3)
+            t0 = time.time()
+            rep = run_loadgen(url, manifest, g, nclients=nclients,
+                              nballots=per_client, seed=w, batch=8)
+            wall = time.time() - t0
+            sent = nclients * per_client + w * 8
+        finally:
+            for s in svcs:
+                s.process.terminate()
+            drained = wait_all(svcs, timeout=180)
+            router.process.terminate()
+            if router.wait_for(15) is None:
+                router.kill()
+        mrep = merge_shard_records(
+            g, sorted(os.path.join(shards_root, d)
+                      for d in os.listdir(shards_root)),
+            os.path.join(workdir, f"merged-x{w}"))
+        assert drained and rep["errors"] == 0 \
+            and mrep.n_ballots == sent, \
+            f"fleet of {w}: drained={drained} errors={rep['errors']} " \
+            f"merged={mrep.n_ballots}/{sent}"
+        row = {"workers": w, "ballots": nclients * per_client,
+               "wall_s": round(wall, 1),
+               "ballots_per_s": rep["ballots_per_s"],
+               "latency_p50_ms": rep["latency_p50_ms"],
+               "latency_p99_ms": rep["latency_p99_ms"],
+               "merged_ballots": mrep.n_ballots}
+        print(f"  fabric x{w}: {rep['ballots_per_s']:.1f} ballots/s "
+              f"(p50 {rep['latency_p50_ms']:.0f}ms)", flush=True)
+        curve.append(row)
+
+    by_w = {r["workers"]: r["ballots_per_s"] for r in curve}
+    out = {"phase": "fabric", "group": "tiny", "nballots": nballots,
+           "device_emulation_ms": emulate_device_ms,
+           "curve": curve, "peak_rss_mb": round(rss_mb(), 1)}
+    if 1 in by_w and 2 in by_w and by_w[1]:
+        out["scale_2w_vs_1w"] = round(by_w[2] / by_w[1], 2)
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser("scale_run")
     ap.add_argument("--stream", type=int, default=0,
@@ -260,6 +376,17 @@ def main() -> int:
     ap.add_argument("--mix-sharded", type=int, default=0,
                     help="dp-scaling rows for the sharded shuffle on "
                          "the virtual 8-device mesh (N = rows)")
+    ap.add_argument("--fabric", type=int, default=0,
+                    help="fleet-throughput curve for the sharded "
+                         "serving fabric (N = total ballots per fleet "
+                         "size; router + 1/2/4 worker subprocesses)")
+    ap.add_argument("--fabric-workers", default="1,2,4",
+                    help="comma-separated fleet sizes for --fabric")
+    ap.add_argument("--fabric-emulate-device-ms", type=float,
+                    default=500.0,
+                    help="pin per-batch device time for the --fabric "
+                         "curve (one-chip-per-worker regime; 0 = raw "
+                         "host-contended throughput)")
     ap.add_argument("--chunk", type=int, default=4096)
     ap.add_argument("--workdir", default="/tmp/egtpu_scale")
     ap.add_argument("--out", default=os.path.join(
@@ -282,6 +409,13 @@ def main() -> int:
         results.append(r)
     if args.mix_sharded:
         r = mix_sharded_phase(args.mix_sharded)
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    if args.fabric:
+        fleet = tuple(int(x) for x in args.fabric_workers.split(","))
+        r = fabric_phase(args.fabric, workers=fleet,
+                         workdir=args.workdir + "_fabric",
+                         emulate_device_ms=args.fabric_emulate_device_ms)
         print(json.dumps(r), flush=True)
         results.append(r)
 
