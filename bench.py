@@ -65,6 +65,43 @@ def emit() -> None:
     print(json.dumps(RESULT), flush=True)
 
 
+def _append_progress_row() -> None:
+    """Append one compact trajectory row to PROGRESS.jsonl after a
+    successful run, so the bench history lives in one machine-readable
+    stream instead of loose BENCH_r*.json files (tools/bench_diff.py
+    accepts the stream as a baseline).  BENCH_PROGRESS= path override;
+    empty string disables."""
+    path = os.environ.get(
+        "BENCH_PROGRESS",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "PROGRESS.jsonl"))
+    if not path:
+        return
+    try:
+        git_rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or None
+    except (OSError, subprocess.SubprocessError):
+        git_rev = None
+    row = {
+        "kind": "bench",
+        "ts": round(time.time(), 1),
+        "ballots_per_s_per_chip": RESULT.get("value"),
+        "vs_baseline": RESULT.get("vs_baseline"),
+        "powmod_per_s": RESULT.get("powmod_per_s"),
+        "platform": RESULT.get("platform"),
+        "nballots": RESULT.get("nballots"),
+        "git": git_rev,
+    }
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(row, separators=(",", ":")) + "\n")
+    except OSError as e:
+        note(f"progress row write failed: {e}")
+
+
 def flush_partial() -> None:
     """Write the CURRENT artifact to disk (atomic replace).  Called after
     every phase, so a driver SIGKILL — which skips atexit AND signal
@@ -1045,6 +1082,8 @@ def main() -> int:
         RESULT["compile_cache_entries_end"] = len(os.listdir(cache_dir))
     except OSError:
         pass
+    if RESULT.get("error") is None:
+        _append_progress_row()
     emit()
     return 0
 

@@ -150,7 +150,8 @@ def load_default_passes() -> None:
     from electionguard_tpu.analysis import (env_knobs,  # noqa: F401
                                             jit_hygiene, lock_discipline,
                                             no_bare_print, rpc_contract,
-                                            secret_taint, wall_clock)
+                                            secret_taint, trace_coverage,
+                                            wall_clock)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,105 @@
+"""trace-coverage: every gRPC servicer method must be trace-wrapped.
+
+The obs plane's cross-process timeline only works because EVERY server
+method goes through ``obs.trace.wrap_server_method`` (it opens the
+``rpc.server.*`` span and adopts the caller's trace context from the
+request metadata).  The one blessed path is
+``remote/rpc_util.generic_service``, which wraps each method before
+building its ``unary_unary_rpc_method_handler``; a service registered
+any other way ships an untraced RPC surface that silently breaks rpc
+client/server pairing in every flight report.
+
+Three shapes are flagged:
+
+* a ``unary_unary_rpc_method_handler(...)`` whose behavior is not a
+  ``wrap_server_method(...)`` result (directly or via a local name);
+* an ``add_generic_rpc_handlers(...)`` registration whose handlers are
+  built by something other than ``generic_service(...)`` or a
+  collector-style ``.service()`` factory;
+* any ``method_handlers_generic_handler`` call outside
+  ``remote/rpc_util.py`` itself (hand-rolling the handler map bypasses
+  the wrap entirely).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from electionguard_tpu.analysis import astutil, core
+
+RULE = "trace-coverage"
+
+#: handler factories that wrap every method via wrap_server_method
+_BLESSED_FACTORIES = ("generic_service", "service")
+
+
+def _assigned_calls(tree: ast.Module) -> dict[str, str]:
+    """name -> terminal call name of the last ``name = call(...)`` at
+    any nesting level (enough to resolve the one-hop local aliases the
+    registration idiom uses)."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            name = astutil.call_name(node.value)
+            if name is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = name
+    return out
+
+
+def _resolved(node: ast.AST, assigns: dict[str, str]) -> Optional[str]:
+    """Terminal call name an expression provably evaluates to; None
+    when it can't be proven (the pass stays lenient on those)."""
+    if isinstance(node, ast.Call):
+        return astutil.call_name(node)
+    if isinstance(node, ast.Name):
+        return assigns.get(node.id)
+    return None
+
+
+@core.register(RULE, doc="gRPC servicer method registered without "
+                         "obs.trace.wrap_server_method (use "
+                         "rpc_util.generic_service)")
+def run(project: core.Project) -> Iterator[core.Finding]:
+    for f in project.files():
+        assigns = _assigned_calls(f.tree)
+        in_rpc_util = f.rel.endswith("remote/rpc_util.py")
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = astutil.call_name(node)
+            if name == "method_handlers_generic_handler" and not in_rpc_util:
+                yield core.Finding(
+                    RULE, f.rel, node.lineno,
+                    "hand-rolled method_handlers_generic_handler "
+                    "bypasses obs.trace.wrap_server_method: register "
+                    "via rpc_util.generic_service")
+            elif name == "unary_unary_rpc_method_handler":
+                behavior = node.args[0] if node.args else next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "behavior"), None)
+                if behavior is None:
+                    continue
+                got = _resolved(behavior, assigns)
+                if got != "wrap_server_method":
+                    yield core.Finding(
+                        RULE, f.rel, node.lineno,
+                        "rpc method handler behavior is not a "
+                        "wrap_server_method(...) result: this method "
+                        "would serve untraced")
+            elif name == "add_generic_rpc_handlers":
+                for arg in node.args:
+                    elts = arg.elts if isinstance(
+                        arg, (ast.Tuple, ast.List)) else [arg]
+                    for e in elts:
+                        got = _resolved(e, assigns)
+                        if got is not None and \
+                                got not in _BLESSED_FACTORIES:
+                            yield core.Finding(
+                                RULE, f.rel, e.lineno,
+                                f"handlers built by {got}() instead of "
+                                f"rpc_util.generic_service: methods "
+                                f"would serve untraced")

@@ -1,0 +1,485 @@
+"""Trace analytics: critical path, wall-clock attribution, anti-patterns.
+
+The obs plane *records* a run (obs/trace.py exports spans, obs/assemble
+merges them); this module *answers* the capacity-planning questions over
+that record:
+
+* **critical path** — the single chain of span self-segments that the
+  run's end-to-end wall-clock actually waited on.  At any instant the
+  critical path is inside the deepest span active at that instant that
+  finishes last; the decomposition below covers the root envelope
+  exactly, so the per-hop durations sum to the run's wall-clock by
+  construction (what the flight report's coverage line asserts);
+* **attribution buckets** — every span's *self time* (its duration
+  minus the union of its children) lands in a phase x process x
+  category bucket, where category is device compute, queue wait, RPC,
+  serialization, recompile (``device.compile`` events joined in from
+  the obs/jaxmon listener) or host;
+* **anti-patterns** — mid-run recompiles (a ``device.compile`` after a
+  process's first device batch completed: the prewarm contract was
+  violated), queue saturation against the SLO engine's
+  ``queue_depth_max`` threshold, and straggler shards (a fabric worker
+  whose mean device-batch duration is a multiple of the fleet median,
+  from the ``worker.batch`` spans and the collector's persisted
+  heartbeats).
+
+Everything degrades: orphaned spans (a SIGKILL'd worker never closes
+its root), clock-skewed processes and truncated JSONL lines produce a
+partial analysis with ``warnings``, never a crash — the assembler's
+tolerant loader (obs/assemble.load_spans) is the single parsing path.
+
+``obs/flight.py`` renders one of these into ``FLIGHT_REPORT.md``;
+``tools/egreport.py`` is the CLI; ``tools/egtop.py`` feeds its live
+critical-path pane from the same ``analyze()``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from electionguard_tpu.obs import assemble
+from electionguard_tpu.obs import slo as slo_mod
+from electionguard_tpu.utils import knobs
+
+#: span names that are one device dispatch (the "device" category);
+#: everything under ``device.`` counts too
+_DEVICE_BATCHES = frozenset(
+    {"worker.batch", "encrypt.batch", "decrypt.batch", "tally.batch",
+     "verify.batch"})
+_SERIALIZATION_TOKENS = ("publish", "serialize", "journal", "merge",
+                         "record")
+_QUEUE_TOKENS = ("wait", "queue", "batcher")
+
+CATEGORIES = ("device", "queue-wait", "rpc", "serialization",
+              "recompile", "host")
+
+
+def category_of(name: str) -> str:
+    """Wall-clock bucket for one span name (see CATEGORIES)."""
+    if name == "device.compile":
+        return "recompile"
+    if name in _DEVICE_BATCHES or name.startswith("device."):
+        return "device"
+    if name.startswith("rpc."):
+        return "rpc"
+    if any(t in name for t in _QUEUE_TOKENS):
+        return "queue-wait"
+    if any(t in name for t in _SERIALIZATION_TOKENS):
+        return "serialization"
+    return "host"
+
+
+def _end(s: dict) -> int:
+    return s["ts"] + s.get("dur", 0)
+
+
+@dataclass(frozen=True)
+class Hop:
+    """One self-segment of one span on the critical path: the interval
+    ``[t0, t1)`` during which ``span`` itself (no child of it) was the
+    thing the run waited on."""
+
+    span: dict
+    t0: int
+    t1: int
+
+    @property
+    def dur_us(self) -> int:
+        return self.t1 - self.t0
+
+
+@dataclass
+class ShardStat:
+    """Device-batch balance of one serving/fabric worker process."""
+
+    proc: str
+    n_batches: int
+    total_us: int
+    mean_us: float
+    max_us: int
+    shard: Optional[int] = None
+    queue_max: Optional[int] = None
+
+
+@dataclass
+class RunAnalysis:
+    """Everything analyze() learned about one trace dir."""
+
+    trace_dir: str
+    spans: list[dict] = field(default_factory=list)
+    warnings: list[str] = field(default_factory=list)
+    validation: dict = field(default_factory=dict)
+    root: Optional[dict] = None
+    wall_us: int = 0
+    hops: list[Hop] = field(default_factory=list)       # time order
+    path: list[dict] = field(default_factory=list)      # merged rows
+    #: (phase, proc, category) -> self-time us
+    buckets: dict = field(default_factory=dict)
+    top_self: list[tuple[dict, int]] = field(default_factory=list)
+    shards: list[ShardStat] = field(default_factory=list)
+    stragglers: list[dict] = field(default_factory=list)
+    recompiles_total: int = 0
+    recompile_us: int = 0
+    midrun_recompiles: list[dict] = field(default_factory=list)
+    heartbeats: list[dict] = field(default_factory=list)
+    queue_max: dict = field(default_factory=dict)       # proc -> depth
+    alerts: list[dict] = field(default_factory=list)    # slo.alert spans
+    antipatterns: list[dict] = field(default_factory=list)
+
+    @property
+    def path_total_us(self) -> int:
+        return sum(h.dur_us for h in self.hops)
+
+    @property
+    def coverage(self) -> float:
+        """Critical-path total over root wall-clock (1.0 = exact)."""
+        if not self.wall_us:
+            return 0.0
+        return self.path_total_us / self.wall_us
+
+    def to_json(self) -> dict:
+        return {
+            "trace_dir": self.trace_dir,
+            "n_spans": len(self.spans),
+            "wall_us": self.wall_us,
+            "path_total_us": self.path_total_us,
+            "coverage": round(self.coverage, 4),
+            "critical_path": self.path,
+            "buckets": [{"phase": p, "proc": pr, "category": c,
+                         "self_us": us}
+                        for (p, pr, c), us in sorted(self.buckets.items())],
+            "top_self": [{"name": s["name"], "proc": s["proc"],
+                          "self_us": us} for s, us in self.top_self],
+            "shards": [{"proc": s.proc, "shard": s.shard,
+                        "n_batches": s.n_batches, "total_us": s.total_us,
+                        "mean_us": round(s.mean_us, 1),
+                        "max_us": s.max_us, "queue_max": s.queue_max}
+                       for s in self.shards],
+            "stragglers": self.stragglers,
+            "recompiles_total": self.recompiles_total,
+            "recompile_us": self.recompile_us,
+            "midrun_recompiles": self.midrun_recompiles,
+            "queue_max": self.queue_max,
+            "alerts": [{"subject": a.get("attrs", {}).get("subject", ""),
+                        "kind": a.get("attrs", {}).get("kind", "")}
+                       for a in self.alerts],
+            "antipatterns": self.antipatterns,
+            "warnings": self.warnings,
+            "validation": {k: v for k, v in self.validation.items()
+                           if k in ("trace_ids", "processes", "rpc_pairs",
+                                    "rpc_server_unpaired")},
+        }
+
+
+# ---------------------------------------------------------------------------
+# critical path
+# ---------------------------------------------------------------------------
+
+def _children_index(spans: list[dict]) -> dict[str, list[dict]]:
+    kids: dict[str, list[dict]] = {}
+    for s in spans:
+        if s["parent_id"]:
+            kids.setdefault(s["parent_id"], []).append(s)
+    for v in kids.values():
+        v.sort(key=lambda s: s["ts"])
+    return kids
+
+
+def _critical_hops(span: dict, lo: int, hi: int,
+                   kids_of: dict[str, list[dict]],
+                   out: list[Hop]) -> None:
+    """Cover ``[lo, hi)`` with Hops: descend into whichever child is
+    active at the cursor and finishes LAST (the one the parent actually
+    waits on); the uncovered remainder is the span's own self time."""
+    cursor = lo
+    kids = kids_of.get(span["span_id"], ())
+    while cursor < hi:
+        active = [c for c in kids
+                  if c["ts"] <= cursor and _end(c) > cursor]
+        if active:
+            c = max(active, key=_end)
+            seg_end = min(_end(c), hi)
+            _critical_hops(c, cursor, seg_end, kids_of, out)
+            cursor = seg_end
+        else:
+            nxt = min([hi] + [c["ts"] for c in kids
+                              if cursor < c["ts"] < hi])
+            out.append(Hop(span=span, t0=cursor, t1=nxt))
+            cursor = nxt
+
+
+def critical_path(spans: list[dict],
+                  root: Optional[dict] = None) -> list[Hop]:
+    """The run's critical path as time-ordered self-segments; their
+    durations sum exactly to the root span's duration."""
+    closed = [s for s in spans if not assemble.is_open(s)]
+    if root is None:
+        root = find_root(closed)
+    if root is None:
+        return []
+    kids_of = _children_index(closed)
+    out: list[Hop] = []
+    _critical_hops(root, root["ts"], _end(root), kids_of, out)
+    return out
+
+
+def find_root(spans: list[dict]) -> Optional[dict]:
+    """The run's envelope span: prefer the workflow driver's ``process``
+    root, else the longest process root whose parent is unresolved."""
+    ids = {s["span_id"] for s in spans}
+    roots = [s for s in spans
+             if s["name"] == "process"
+             and (not s["parent_id"] or s["parent_id"] not in ids)]
+    if not roots:
+        return None
+    drivers = [s for s in roots if s["proc"] == "workflow-driver"]
+    pool = drivers or roots
+    return max(pool, key=lambda s: s.get("dur", 0))
+
+
+def merge_hops(hops: list[Hop]) -> list[dict]:
+    """Adjacent hops of the same span merged into display rows."""
+    rows: list[dict] = []
+    for h in hops:
+        if rows and rows[-1]["span_id"] == h.span["span_id"] \
+                and rows[-1]["_t1"] == h.t0:
+            rows[-1]["dur_us"] += h.dur_us
+            rows[-1]["_t1"] = h.t1
+            continue
+        rows.append({"span_id": h.span["span_id"],
+                     "name": h.span["name"], "proc": h.span["proc"],
+                     "t0": h.t0, "_t1": h.t1, "dur_us": h.dur_us})
+    for r in rows:
+        del r["_t1"]
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# attribution + anti-patterns
+# ---------------------------------------------------------------------------
+
+def _self_time_us(s: dict, kids_of: dict[str, list[dict]]) -> int:
+    """Span duration minus the union of its children's intervals
+    (clipped into the span; robust to small cross-process clock skew)."""
+    lo, hi = s["ts"], _end(s)
+    covered = 0
+    cursor = lo
+    for c in kids_of.get(s["span_id"], ()):
+        c0, c1 = max(c["ts"], cursor), min(_end(c), hi)
+        if c1 > c0:
+            covered += c1 - c0
+            cursor = c1
+    return max(s.get("dur", 0) - covered, 0)
+
+
+def _phase_of(s: dict, by_id: dict[str, dict],
+              cache: dict[str, str]) -> str:
+    """Nearest ancestor ``phase.*`` span name; "(run)" when none."""
+    chain: list[str] = []
+    cur: Optional[dict] = s
+    seen: set[str] = set()
+    phase = "(run)"
+    while cur is not None and cur["span_id"] not in seen:
+        sid = cur["span_id"]
+        if sid in cache:
+            phase = cache[sid]
+            break
+        seen.add(sid)
+        chain.append(sid)
+        if cur["name"].startswith("phase."):
+            phase = cur["name"]
+            break
+        cur = by_id.get(cur["parent_id"])
+    for sid in chain:
+        cache[sid] = phase
+    return phase
+
+
+def load_heartbeats(trace_dir: str,
+                    warnings: Optional[list[str]] = None) -> list[dict]:
+    """The collector's persisted heartbeat stream
+    (``heartbeats.jsonl`` in the receive dir), tolerant of torn lines;
+    empty when the run had no collector.  Looks in the trace dir itself,
+    its ``recv/`` subdir (when analyzing a collector's obs dir), and the
+    workflow layout's sibling ``obs/recv/`` (``<out>/trace`` next to
+    ``<out>/obs``)."""
+    base = trace_dir.rstrip("/")
+    candidates: list[str] = []
+    for d in (base, os.path.join(base, "recv"),
+              os.path.join(os.path.dirname(base) or ".", "obs", "recv")):
+        candidates += glob.glob(os.path.join(d, "heartbeats*.jsonl"))
+    out: list[dict] = []
+    for path in sorted(set(candidates)):
+        with open(path, errors="replace") as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    if warnings is not None:
+                        warnings.append(
+                            f"{os.path.basename(path)}:{lineno}: "
+                            f"malformed heartbeat line skipped")
+                    continue
+                if isinstance(rec, dict) and "proc" in rec:
+                    out.append(rec)
+    return out
+
+
+def _parse_shard_id(phase: str) -> Optional[int]:
+    """Shard id from a serving heartbeat phase string
+    (``serving shard=<id> ...``; see tools/egtop.parse_shard)."""
+    if not phase or "shard=" not in phase:
+        return None
+    for tok in phase.split():
+        if tok.startswith("shard="):
+            try:
+                return int(tok.split("=", 1)[1])
+            except ValueError:
+                return None
+    return None
+
+
+def analyze(trace_dir: str, top_n: Optional[int] = None,
+            straggler_ratio: Optional[float] = None,
+            slo_config: Optional[dict] = None) -> RunAnalysis:
+    """Full analysis of one trace dir (a run's ``EGTPU_OBS_TRACE`` dir
+    or a collector's ``obs/recv`` dir).  Never raises on a damaged
+    trace: everything partial lands in ``warnings``."""
+    if top_n is None:
+        top_n = knobs.get_int("EGTPU_FLIGHT_TOP_N")
+    if straggler_ratio is None:
+        straggler_ratio = knobs.get_float("EGTPU_FLIGHT_STRAGGLER_RATIO")
+    cfg = slo_config or slo_mod.load_config()
+
+    a = RunAnalysis(trace_dir=trace_dir)
+    raw = assemble.load_spans(trace_dir, a.warnings)
+    spans = assemble.dedupe(raw)
+    a.spans = spans
+    if not spans:
+        a.warnings.append(f"no spans found under {trace_dir}")
+        return a
+    a.validation = assemble.validate(spans)
+    if a.validation["orphans"]:
+        a.warnings.append(
+            f"{len(a.validation['orphans'])} orphaned span(s) (parents "
+            f"never exported — a killed process?): partial attribution")
+    if a.validation["open_spans"]:
+        a.warnings.append(
+            f"{len(a.validation['open_spans'])} span(s) still open: "
+            f"mid-run or died-run trace")
+    if len(a.validation["trace_ids"]) > 1:
+        a.warnings.append(
+            f"multiple trace ids {a.validation['trace_ids']}: dir mixes "
+            f"runs; analyzing all spans together")
+
+    closed = [s for s in spans if not assemble.is_open(s)]
+    by_id = {s["span_id"]: s for s in closed}
+    kids_of = _children_index(closed)
+
+    # ---- critical path ------------------------------------------------
+    root = find_root(closed)
+    a.root = root
+    if root is None:
+        a.warnings.append("no process root span: critical path "
+                          "unavailable (partial report)")
+    else:
+        a.wall_us = root.get("dur", 0)
+        a.hops = []
+        _critical_hops(root, root["ts"], _end(root), kids_of, a.hops)
+        a.path = merge_hops(a.hops)
+
+    # ---- attribution buckets + top self-time --------------------------
+    phase_cache: dict[str, str] = {}
+    self_us: list[tuple[dict, int]] = []
+    for s in closed:
+        us = _self_time_us(s, kids_of)
+        self_us.append((s, us))
+        key = (_phase_of(s, by_id, phase_cache), s["proc"],
+               category_of(s["name"]))
+        a.buckets[key] = a.buckets.get(key, 0) + us
+    self_us.sort(key=lambda t: -t[1])
+    a.top_self = self_us[:top_n]
+
+    # ---- recompile attribution (obs/jaxmon compile events) ------------
+    compiles = [s for s in closed if s["name"] == "device.compile"]
+    a.recompiles_total = len(compiles)
+    a.recompile_us = sum(s.get("dur", 0) for s in compiles)
+    first_batch_end: dict[str, int] = {}
+    for s in closed:
+        if category_of(s["name"]) == "device":
+            e = _end(s)
+            cur = first_batch_end.get(s["proc"])
+            if cur is None or e < cur:
+                first_batch_end[s["proc"]] = e
+    for s in compiles:
+        cutoff = first_batch_end.get(s["proc"])
+        if cutoff is not None and s["ts"] > cutoff:
+            a.midrun_recompiles.append(
+                {"proc": s["proc"], "ts": s["ts"],
+                 "dur_us": s.get("dur", 0)})
+    if a.midrun_recompiles:
+        a.antipatterns.append({
+            "kind": "midrun-recompile",
+            "subject": ",".join(sorted({m["proc"]
+                                        for m in a.midrun_recompiles})),
+            "detail": f"{len(a.midrun_recompiles)} compile(s) after the "
+                      f"first device batch — prewarm missed a shape"})
+
+    # ---- heartbeats: queue saturation + shard ids ---------------------
+    a.heartbeats = load_heartbeats(trace_dir, a.warnings)
+    shard_of: dict[str, int] = {}
+    for hb in a.heartbeats:
+        proc = hb["proc"]
+        depth = int(hb.get("queue_depth", 0))
+        if depth > a.queue_max.get(proc, -1):
+            a.queue_max[proc] = depth
+        sid = _parse_shard_id(hb.get("phase", ""))
+        if sid is not None:
+            shard_of[proc] = sid
+    depth_max = int(cfg.get("queue_depth_max", 256))
+    for proc, depth in sorted(a.queue_max.items()):
+        if depth >= depth_max:
+            a.antipatterns.append({
+                "kind": "queue-saturation", "subject": proc,
+                "detail": f"admission queue hit {depth} "
+                          f"(SLO queue_depth_max={depth_max})"})
+
+    # ---- per-shard balance + stragglers -------------------------------
+    per_proc: dict[str, list[int]] = {}
+    for s in closed:
+        if s["name"] == "worker.batch":
+            per_proc.setdefault(s["proc"], []).append(s.get("dur", 0))
+    for proc in sorted(per_proc):
+        durs = per_proc[proc]
+        a.shards.append(ShardStat(
+            proc=proc, n_batches=len(durs), total_us=sum(durs),
+            mean_us=sum(durs) / len(durs), max_us=max(durs),
+            shard=shard_of.get(proc), queue_max=a.queue_max.get(proc)))
+    if len(a.shards) >= 2:
+        means = sorted(s.mean_us for s in a.shards)
+        median = means[len(means) // 2] if len(means) % 2 \
+            else (means[len(means) // 2 - 1] + means[len(means) // 2]) / 2
+        for s in a.shards:
+            if median > 0 and s.mean_us > straggler_ratio * median:
+                entry = {"proc": s.proc, "shard": s.shard,
+                         "mean_us": round(s.mean_us, 1),
+                         "fleet_median_us": round(median, 1),
+                         "ratio": round(s.mean_us / median, 2)}
+                a.stragglers.append(entry)
+                a.antipatterns.append({
+                    "kind": "straggler-shard", "subject": s.proc,
+                    "detail": f"mean device batch "
+                              f"{s.mean_us / 1e3:.1f} ms vs fleet median "
+                              f"{median / 1e3:.1f} ms "
+                              f"({s.mean_us / median:.1f}x)"})
+
+    # ---- slo.alert spans recorded in the timeline ---------------------
+    a.alerts = [s for s in closed if s["name"] == "slo.alert"]
+    return a

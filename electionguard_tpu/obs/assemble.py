@@ -23,19 +23,47 @@ import os
 _SLACK_US = 2_000_000
 
 
-def load_spans(trace_dir: str) -> list[dict]:
+#: keys a record must carry to be a span at all; anything less is a
+#: torn write (a process killed mid-line) and is skipped with a warning
+_REQUIRED_KEYS = ("trace_id", "span_id", "name", "ts")
+
+
+def load_spans(trace_dir: str,
+               warnings: list[str] | None = None) -> list[dict]:
+    """Load every span record under ``trace_dir``.
+
+    Malformed lines — a truncated JSONL tail from a SIGKILL'd process, a
+    torn concurrent write, a record missing its identity keys — are
+    SKIPPED, not fatal: each one appends a message to ``warnings`` (when
+    given), so a died run still degrades to a partial timeline instead
+    of losing the whole report to its last broken byte."""
     spans: list[dict] = []
     for path in sorted(glob.glob(os.path.join(trace_dir, "spans-*.jsonl"))):
-        with open(path) as f:
+        base = os.path.basename(path)
+        with open(path, errors="replace") as f:
             for lineno, line in enumerate(f, 1):
                 line = line.strip()
                 if not line:
                     continue
                 try:
-                    spans.append(json.loads(line))
+                    rec = json.loads(line)
                 except ValueError as e:
-                    raise ValueError(
-                        f"{path}:{lineno}: malformed span line: {e}")
+                    if warnings is not None:
+                        warnings.append(
+                            f"{base}:{lineno}: malformed span line "
+                            f"skipped: {e}")
+                    continue
+                if not isinstance(rec, dict) or any(
+                        k not in rec for k in _REQUIRED_KEYS):
+                    if warnings is not None:
+                        warnings.append(
+                            f"{base}:{lineno}: span record missing "
+                            f"identity keys skipped")
+                    continue
+                rec.setdefault("parent_id", "")
+                rec.setdefault("pid", 0)
+                rec.setdefault("proc", "?")
+                spans.append(rec)
     return spans
 
 
@@ -109,10 +137,19 @@ def validate(spans: list[dict]) -> dict:
 
 def chrome_trace(spans: list[dict]) -> dict:
     """Chrome-trace JSON: per-process named tracks, one complete ("X")
-    event per span, parent/trace ids preserved under ``args``."""
+    event per span, parent/trace ids preserved under ``args``.
+
+    Cross-process parent links (an rpc.server span whose parent is the
+    caller's rpc.client span, a subprocess root parented to a driver
+    phase) additionally emit a flow pair — ``ph: "s"`` anchored inside
+    the parent slice, ``ph: "f"`` (``bp: "e"``) on the child — so
+    Perfetto renders RPC causality as arrows between tracks instead of
+    disconnected slices."""
+    spans = dedupe(spans)
+    by_id = {s["span_id"]: s for s in spans}
     events: list[dict] = []
     named: set[int] = set()
-    for s in sorted(dedupe(spans), key=lambda s: s["ts"]):
+    for s in sorted(spans, key=lambda s: s["ts"]):
         if s["pid"] not in named:
             named.add(s["pid"])
             events.append({"ph": "M", "name": "process_name",
@@ -127,17 +164,34 @@ def chrome_trace(spans: list[dict]) -> dict:
                        "ts": s["ts"], "dur": max(s.get("dur", 0), 1),
                        "pid": s["pid"], "tid": s.get("tid", 0),
                        "args": args})
+        parent = by_id.get(s["parent_id"])
+        if parent is None or parent["pid"] == s["pid"]:
+            continue
+        # flow start must land INSIDE the parent slice to bind to it;
+        # clamp the child's start into the parent's interval (the end
+        # is unbounded for an open parent)
+        p_end = parent["ts"] + max(parent.get("dur", 0), 1)
+        anchor = max(parent["ts"], min(s["ts"], p_end - 1))
+        flow = {"name": "egtpu-link", "cat": "egtpu",
+                "id": s["span_id"]}
+        events.append(dict(flow, ph="s", ts=anchor, pid=parent["pid"],
+                           tid=parent.get("tid", 0)))
+        events.append(dict(flow, ph="f", bp="e", ts=s["ts"],
+                           pid=s["pid"], tid=s.get("tid", 0)))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 def merge_dir(trace_dir: str, out_path: str,
               extra_spans: list[dict] | None = None) -> dict:
     """Load + validate + write the merged Chrome trace; returns the
-    validation report (with ``out`` added).  ``extra_spans`` lets a live
-    collector merge its in-memory open-span markers into the files."""
-    spans = load_spans(trace_dir) + list(extra_spans or [])
+    validation report (with ``out`` and load ``warnings`` added).
+    ``extra_spans`` lets a live collector merge its in-memory open-span
+    markers into the files."""
+    warnings: list[str] = []
+    spans = load_spans(trace_dir, warnings) + list(extra_spans or [])
     report = validate(spans)
     with open(out_path, "w") as f:
         json.dump(chrome_trace(spans), f)
     report["out"] = out_path
+    report["warnings"] = warnings
     return report

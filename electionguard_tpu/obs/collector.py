@@ -178,6 +178,10 @@ class ObsCollector:
             self._append(p, "spans", closed)
         if batch.log_lines:
             self._append(p, "log", list(batch.log_lines))
+        # persist the heartbeat stream too (single shared file: this is
+        # the only writer): post-run trace analytics reads queue depths
+        # and shard phases from it (obs/analyze.load_heartbeats)
+        self._append_heartbeat(batch, hb)
         return pb.msg("TelemetryAck")(ok=True)
 
     def _split_spans(self, lines) -> tuple[list[str], list[dict], int]:
@@ -204,6 +208,18 @@ class ObsCollector:
         try:
             with open(path, "a") as f:
                 f.write("\n".join(lines) + "\n")
+        except OSError as e:
+            log.warning("receive dir write failed: %s", e)
+
+    def _append_heartbeat(self, batch, hb) -> None:
+        rec = {"t_us": int(clock.now() * 1e6), "proc": batch.proc,
+               "pid": int(batch.pid), "status": hb.status,
+               "phase": hb.phase, "queue_depth": int(hb.queue_depth),
+               "uptime_s": round(float(hb.uptime_s), 3)}
+        path = os.path.join(self.recv_dir, "heartbeats.jsonl")
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
         except OSError as e:
             log.warning("receive dir write failed: %s", e)
 

@@ -1,0 +1,210 @@
+"""Render a RunAnalysis into FLIGHT_REPORT.md.
+
+A flight report is the post-run evidence bundle for one traced
+election: where the wall-clock went (critical path + attribution
+buckets), whether the fleet was balanced (per-shard table, straggler
+section), whether the run obeyed its SLOs, and what the device spent
+compiling vs computing.  ``workflow/e2e.py -flightReport`` drops one
+next to ``trace.json`` after every run; ``tools/egreport.py`` produces
+one from any trace dir after the fact.
+
+The renderer is pure (analysis in, markdown out) so tests can assert
+on sections without touching the filesystem.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from electionguard_tpu.obs import analyze as analyze_mod
+
+
+def _ms(us: float) -> str:
+    if us >= 10_000_000:
+        return f"{us / 1e6:.1f} s"
+    return f"{us / 1e3:.1f} ms"
+
+
+def _pct(part: float, whole: float) -> str:
+    return f"{100.0 * part / whole:.1f}%" if whole else "n/a"
+
+
+def render(a: analyze_mod.RunAnalysis) -> str:
+    """Markdown flight report for one analyzed run."""
+    lines: list[str] = []
+    w = lines.append
+    w("# Flight report")
+    w("")
+    w(f"Trace dir: `{a.trace_dir}`")
+    w("")
+
+    # ---- run summary --------------------------------------------------
+    w("## Run summary")
+    w("")
+    val = a.validation or {}
+    w(f"- spans: **{len(a.spans)}** across "
+      f"{len(val.get('processes', []))} process(es)")
+    if val.get("trace_ids"):
+        w(f"- trace id(s): {', '.join(val['trace_ids'])}")
+    if a.root is not None:
+        w(f"- root: `{a.root['name']}` in `{a.root['proc']}` — "
+          f"wall-clock **{_ms(a.wall_us)}**")
+    if val.get("rpc_pairs") or val.get("rpc_server_unpaired"):
+        w(f"- rpc: {val.get('rpc_pairs', 0)} paired, "
+          f"{val.get('rpc_server_unpaired', 0)} unpaired server span(s)")
+    if a.warnings:
+        w(f"- **partial report** — {len(a.warnings)} warning(s):")
+        for msg in a.warnings:
+            w(f"  - {msg}")
+    w("")
+
+    # ---- critical path ------------------------------------------------
+    w("## Critical path")
+    w("")
+    if not a.path:
+        w("_Critical path unavailable (no closed process-root span)._")
+        w("")
+    else:
+        w("| # | span | process | self on path |")
+        w("|--:|------|---------|-------------:|")
+        for i, row in enumerate(a.path, 1):
+            w(f"| {i} | `{row['name']}` | {row['proc']} | "
+              f"{_ms(row['dur_us'])} |")
+        w("")
+        w(f"Critical path total: **{_ms(a.path_total_us)}** "
+          f"({_pct(a.path_total_us, a.wall_us)} of run wall-clock "
+          f"{_ms(a.wall_us)}).")
+        w("")
+
+    # ---- attribution --------------------------------------------------
+    if a.buckets:
+        w("## Wall-clock attribution (self time)")
+        w("")
+        w("| phase | process | category | self time | % of wall |")
+        w("|-------|---------|----------|----------:|----------:|")
+        total_self = sum(a.buckets.values())
+        rows = sorted(a.buckets.items(), key=lambda kv: -kv[1])
+        for (phase, proc, cat), us in rows:
+            if us == 0:
+                continue
+            w(f"| {phase} | {proc} | {cat} | {_ms(us)} | "
+              f"{_pct(us, a.wall_us)} |")
+        w("")
+        by_cat: dict[str, int] = {}
+        for (_, _, cat), us in a.buckets.items():
+            by_cat[cat] = by_cat.get(cat, 0) + us
+        cats = ", ".join(f"{c} {_pct(us, total_self)}"
+                         for c, us in sorted(by_cat.items(),
+                                             key=lambda kv: -kv[1]) if us)
+        w(f"Category split of all self time: {cats}.")
+        w("")
+
+    # ---- top self-time spans ------------------------------------------
+    if a.top_self:
+        w(f"## Top {len(a.top_self)} self-time spans")
+        w("")
+        w("| span | process | self time |")
+        w("|------|---------|----------:|")
+        for s, us in a.top_self:
+            w(f"| `{s['name']}` | {s['proc']} | {_ms(us)} |")
+        w("")
+
+    # ---- shard balance ------------------------------------------------
+    w("## Shard balance")
+    w("")
+    if not a.shards:
+        w("_No device-batch spans (run had no serving/fabric workers)._")
+        w("")
+    else:
+        w("| process | shard | batches | total | mean | max | "
+          "queue max |")
+        w("|---------|------:|--------:|------:|-----:|----:|"
+          "----------:|")
+        for s in a.shards:
+            shard = "-" if s.shard is None else str(s.shard)
+            qmax = "-" if s.queue_max is None else str(s.queue_max)
+            w(f"| {s.proc} | {shard} | {s.n_batches} | "
+              f"{_ms(s.total_us)} | {_ms(s.mean_us)} | {_ms(s.max_us)} "
+              f"| {qmax} |")
+        w("")
+        if a.stragglers:
+            w("### Stragglers")
+            w("")
+            for st in a.stragglers:
+                w(f"- **{st['proc']}**"
+                  + (f" (shard {st['shard']})"
+                     if st.get("shard") is not None else "")
+                  + f": mean device batch {_ms(st['mean_us'])} vs fleet "
+                    f"median {_ms(st['fleet_median_us'])} "
+                    f"({st['ratio']}x)")
+            w("")
+        else:
+            w("No stragglers (all workers within "
+              "EGTPU_FLIGHT_STRAGGLER_RATIO of the fleet median).")
+            w("")
+
+    # ---- compile / device-time summary --------------------------------
+    w("## Compile & device time")
+    w("")
+    device_us = sum(us for (_, _, c), us in a.buckets.items()
+                    if c == "device")
+    w(f"- device compute self time: {_ms(device_us)} "
+      f"({_pct(device_us, a.wall_us)} of wall)")
+    w(f"- compiles: {a.recompiles_total} event(s), "
+      f"{_ms(a.recompile_us)} total")
+    if a.midrun_recompiles:
+        w(f"- **mid-run recompiles: {len(a.midrun_recompiles)}** "
+          f"(after the first device batch) in: "
+          + ", ".join(sorted({m['proc'] for m in a.midrun_recompiles})))
+    else:
+        w("- no mid-run recompiles (prewarm covered every shape)")
+    w("")
+
+    # ---- SLO verdicts -------------------------------------------------
+    w("## SLO verdicts")
+    w("")
+    if a.queue_max:
+        worst = max(a.queue_max.values())
+        verdict = "FAIL" if any(p["kind"] == "queue-saturation"
+                                for p in a.antipatterns) else "PASS"
+        w(f"- queue depth: **{verdict}** (max observed {worst})")
+    else:
+        w("- queue depth: no heartbeat data")
+    if a.alerts:
+        w(f"- alerts recorded during the run: **{len(a.alerts)}**")
+        for al in a.alerts:
+            attrs = al.get("attrs") or {}
+            w(f"  - {attrs.get('kind', '?')} on "
+              f"{attrs.get('subject', '?')}")
+    else:
+        w("- alerts recorded during the run: none")
+    mid = "FAIL" if a.midrun_recompiles else "PASS"
+    w(f"- recompile discipline: **{mid}**")
+    strag = "FAIL" if a.stragglers else \
+        ("PASS" if len(a.shards) >= 2 else "n/a (single worker)")
+    w(f"- shard balance: **{strag}**")
+    w("")
+
+    # ---- anti-patterns ------------------------------------------------
+    if a.antipatterns:
+        w("## Anti-patterns")
+        w("")
+        for p in a.antipatterns:
+            w(f"- `{p['kind']}` on **{p['subject']}**: {p['detail']}")
+        w("")
+
+    return "\n".join(lines) + "\n"
+
+
+def write_report(trace_dir: str, out_path: Optional[str] = None,
+                 top_n: Optional[int] = None) -> tuple[str, "analyze_mod.RunAnalysis"]:
+    """Analyze ``trace_dir`` and write FLIGHT_REPORT.md; returns
+    ``(out_path, analysis)``."""
+    a = analyze_mod.analyze(trace_dir, top_n=top_n)
+    if out_path is None:
+        out_path = os.path.join(os.path.dirname(trace_dir.rstrip("/"))
+                                or ".", "FLIGHT_REPORT.md")
+    with open(out_path, "w") as f:
+        f.write(render(a))
+    return out_path, a

@@ -38,6 +38,11 @@ class Knob:
 
 
 KNOBS: tuple[Knob, ...] = (
+    Knob("EGTPU_BENCH_BASELINE", "path", None,
+         "Default baseline artifact for the perf-regression gate: a "
+         "bench.py RESULT json, a BENCH_r*.json wrapper, BASELINE.json, "
+         "or a PROGRESS.jsonl trajectory (tools/bench_diff; falls back "
+         "to the repo BASELINE.json)."),
     Knob("EGTPU_BIGNUM", "str", "auto",
          "Bignum kernel backend: auto|pallas|ntt|cios; auto = pallas on "
          "TPU, cios elsewhere (core/group_jax)."),
@@ -77,6 +82,13 @@ KNOBS: tuple[Knob, ...] = (
          "(testing/faults; workflow chaos modes set it per process)."),
     Knob("EGTPU_FEEDER_PLATFORM", "str", "cpu",
          "Verifier feeder-pool child JAX platform (cli/run_verifier)."),
+    Knob("EGTPU_FLIGHT_STRAGGLER_RATIO", "float", "1.5",
+         "A fabric worker whose mean device-batch duration exceeds this "
+         "multiple of the fleet median is named a straggler in the "
+         "flight report (obs/analyze)."),
+    Knob("EGTPU_FLIGHT_TOP_N", "int", "10",
+         "Rows in the flight report's top-self-time table "
+         "(obs/analyze; tools/egreport -topN overrides)."),
     Knob("EGTPU_LOG", "str", "INFO",
          "Root log level for every CLI (cli/common)."),
     Knob("EGTPU_MIX_CHUNK_ROWS", "int", "64",

@@ -139,10 +139,15 @@ def _fabric_encrypt_phase(args, out, record_dir, cmd_out, group_flags,
 
     workers = []
     for i in range(n):
-        env = None
+        env = {}
         if args.chaos_fabric and i == 0:
-            env = {"EGTPU_CHAOS_HOLD_AFTER_BALLOTS": "2"}
-        workers.append(launch_worker(i, env=env))
+            env["EGTPU_CHAOS_HOLD_AFTER_BALLOTS"] = "2"
+        if args.fabric_skew_ms > 0 and i == 0:
+            # seeded straggler: worker 0's device leg is padded so the
+            # flight report's straggler section has something to name
+            env["EGTPU_FABRIC_EMULATE_DEVICE_MS"] = \
+                str(args.fabric_skew_ms)
+        workers.append(launch_worker(i, env=env or None))
     procs.extend(workers)
     # every shard must be in the routing set before load starts
     if not _watch_log(router_cmd.stdout_path, b" live at ", count=n,
@@ -317,6 +322,20 @@ def main(argv=None) -> int:
                          "spans under <out>/trace (EGTPU_OBS_TRACE), "
                          "and the driver merges them into <out>/"
                          "trace.json (Chrome-trace/Perfetto) at the end")
+    ap.add_argument("-flightReport", dest="flight_report",
+                    action="store_true",
+                    help="implies -trace: after the run (pass OR fail) "
+                         "analyze the trace and write <out>/FLIGHT_"
+                         "REPORT.md — critical path, wall-clock "
+                         "attribution, shard balance/stragglers, SLO "
+                         "verdicts (obs/analyze + obs/flight)")
+    ap.add_argument("-fabricSkewMs", dest="fabric_skew_ms", type=float,
+                    default=0.0,
+                    help="straggler drill for -fabricWorkers: worker 0 "
+                         "alone runs under EGTPU_FABRIC_EMULATE_DEVICE_"
+                         "MS of this much device-leg padding, so the "
+                         "flight report must name it in the straggler "
+                         "section")
     ap.add_argument("-obsCollector", dest="obs_collector",
                     action="store_true",
                     help="launch the run's obs collector FIRST and point "
@@ -340,6 +359,8 @@ def main(argv=None) -> int:
         log.error("-chaosKillEncryptionWorker needs -fabricWorkers >= 2 "
                   "(someone has to survive)")
         return 1
+    if args.flight_report:
+        args.trace = True   # a flight report is analytics over a trace
 
     out = args.output
     record_dir = os.path.join(out, "record")
@@ -676,6 +697,24 @@ def main(argv=None) -> int:
                          len(report["open_spans"]), report["out"])
             except (OSError, ValueError):
                 log.exception("trace merge failed")
+            if args.flight_report:
+                # even on a failed/chaos run: the report degrades to
+                # partial-with-warnings, never blocks teardown
+                try:
+                    from electionguard_tpu.obs import flight
+                    rpt_path, analysis = flight.write_report(
+                        trace_dir,
+                        os.path.join(out, "FLIGHT_REPORT.md"))
+                    log.info(
+                        "FLIGHT REPORT: %s (wall=%.1fs path=%.1fs "
+                        "coverage=%.1f%% stragglers=%d warnings=%d)",
+                        rpt_path, analysis.wall_us / 1e6,
+                        analysis.path_total_us / 1e6,
+                        analysis.coverage * 100,
+                        len(analysis.stragglers),
+                        len(analysis.warnings))
+                except Exception:  # noqa: BLE001 — report is best-effort
+                    log.exception("flight report generation failed")
 
 
 if __name__ == "__main__":

@@ -24,7 +24,7 @@ from electionguard_tpu.utils import knobs as knobs_mod
 
 ALL_PASSES = {"env-knob-registry", "jit-hygiene", "lock-discipline",
               "no-bare-print", "rpc-contract", "secret-taint",
-              "wall-clock-discipline"}
+              "trace-coverage", "wall-clock-discipline"}
 
 
 # ---------------------------------------------------------------------------
@@ -392,6 +392,59 @@ def test_no_bare_print_fires_and_cli_is_exempt(tmp_path):
     report = _run(project, ["no-bare-print"])
     assert [(f.path, f.line) for f in report.findings] \
         == [("pkg/mod.py", 1)]
+
+
+def test_trace_coverage_fires_on_unwrapped_handler(tmp_path):
+    project = _project(tmp_path, {"serve/rogue.py": """\
+        import grpc
+
+
+        def service(impls):
+            handlers = {}
+            for name, fn in impls.items():
+                handlers[name] = grpc.unary_unary_rpc_method_handler(fn)
+            return grpc.method_handlers_generic_handler("Svc", handlers)
+    """})
+    report = _run(project, ["trace-coverage"])
+    assert _lines(report, "trace-coverage") == [7, 8]
+
+
+def test_trace_coverage_accepts_wrapped_registration(tmp_path):
+    project = _project(tmp_path, {"serve/good.py": """\
+        import grpc
+
+        from electionguard_tpu.obs import trace as obs_trace
+
+
+        def service(impls):
+            handlers = {}
+            for name, fn in impls.items():
+                wrapped = obs_trace.wrap_server_method("Svc", name, fn)
+                handlers[name] = grpc.unary_unary_rpc_method_handler(
+                    wrapped)
+            return handlers
+
+
+        def register(server, reg, front, collector):
+            server.add_generic_rpc_handlers(
+                (generic_service(reg), collector.service()))
+
+
+        def generic_service(svc):
+            return svc
+    """})
+    report = _run(project, ["trace-coverage"])
+    assert report.findings == []
+
+
+def test_trace_coverage_fires_on_rogue_generic_registration(tmp_path):
+    project = _project(tmp_path, {"serve/sneaky.py": """\
+        def register(server, impls):
+            handler = make_untraced_handler(impls)
+            server.add_generic_rpc_handlers((handler,))
+    """})
+    report = _run(project, ["trace-coverage"])
+    assert _lines(report, "trace-coverage") == [3]
 
 
 # ---------------------------------------------------------------------------

@@ -362,6 +362,34 @@ def test_fleet_scrape_merges_labeled_histograms_three_processes(tmp_path):
             '{op="enc",proc="simshard",le="10.0"} 5') in text
 
 
+def test_collector_persists_heartbeat_stream(tmp_path):
+    """Every pushed heartbeat lands as one JSONL row in the receive
+    dir, where post-run trace analytics reads queue depths and shard
+    phases (obs/analyze.load_heartbeats)."""
+    from electionguard_tpu.obs import analyze
+    from electionguard_tpu.obs import collector as coll
+
+    c = coll.ObsCollector(str(tmp_path), slo_config=_quiet_slo())
+    c.push_telemetry(pb.msg("TelemetryBatch")(
+        proc="simworker", pid=9, seq=1,
+        heartbeat=pb.msg("ObsHeartbeat")(
+            status="SERVING", phase="serving shard=3 head=ab admitted=5",
+            queue_depth=7, uptime_s=1.5)))
+    c.push_telemetry(pb.msg("TelemetryBatch")(
+        proc="simworker", pid=9, seq=2,
+        heartbeat=pb.msg("ObsHeartbeat")(status="SERVING",
+                                         queue_depth=2)))
+    path = os.path.join(str(tmp_path), "recv", "heartbeats.jsonl")
+    with open(path) as f:
+        rows = [json.loads(line) for line in f]
+    assert [r["queue_depth"] for r in rows] == [7, 2]
+    assert all(r["proc"] == "simworker" and r["pid"] == 9 for r in rows)
+    # the analyzer reads them back (and parses the shard id)
+    hbs = analyze.load_heartbeats(os.path.join(str(tmp_path), "recv"))
+    assert len(hbs) == 2
+    assert hbs[0]["phase"].startswith("serving shard=3")
+
+
 def test_collector_heartbeat_death_red_window_and_recovery(tmp_path,
                                                            clean_trace):
     """Liveness end to end against the collector, clock injected: a

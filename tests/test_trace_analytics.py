@@ -1,0 +1,412 @@
+"""Trace analytics over synthetic span forests (tier-1, no subprocesses).
+
+Covers the analyzer's contracts on hand-built trace dirs: the critical
+path sums EXACTLY to the root wall-clock, attribution lands in the
+right phase x process x category buckets, anti-patterns (stragglers,
+mid-run recompiles, queue saturation) fire, and every damage mode a
+SIGKILL'd fleet can produce — truncated JSONL tails, orphaned spans,
+open roots, clock-skewed processes — degrades to a partial report with
+warnings, never a crash.  The flow-event emission of obs/assemble and
+both CLI gates (egreport, bench_diff) are smoked here too; the
+subprocess e2e tests exercise the same paths on real runs.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from electionguard_tpu.obs import analyze, assemble, flight
+
+
+def _tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(os.path.dirname(__file__), os.pardir,
+                           "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _span(sid, name, ts, dur, parent="", proc="workflow-driver", pid=1,
+          **attrs):
+    rec = {"trace_id": "t1", "span_id": sid, "parent_id": parent,
+           "name": name, "ts": ts, "dur": dur, "pid": pid, "tid": 0,
+           "proc": proc}
+    if attrs:
+        rec["attrs"] = attrs
+    return rec
+
+
+def _write(trace_dir, spans):
+    os.makedirs(trace_dir, exist_ok=True)
+    by_file = {}
+    for s in spans:
+        by_file.setdefault((s["proc"], s["pid"]), []).append(s)
+    for (proc, pid), recs in by_file.items():
+        with open(os.path.join(trace_dir,
+                               f"spans-{proc}-{pid}.jsonl"), "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+
+
+def _workflow_spans():
+    """A miniature 2-process run: driver root -> phases -> rpc pair ->
+    worker batch, with idle self-time gaps at every level."""
+    return [
+        _span("root", "process", 0, 100),
+        _span("ph-e", "phase.encrypt", 10, 60, parent="root"),
+        _span("rc", "rpc.client.encrypt", 20, 30, parent="ph-e"),
+        _span("rs", "rpc.server.encrypt", 22, 20, parent="rc",
+              proc="worker", pid=2),
+        _span("wb", "worker.batch", 24, 10, parent="rs",
+              proc="worker", pid=2),
+        _span("wroot", "process", 0, 100, proc="worker", pid=2),
+        _span("ph-t", "phase.tally", 75, 20, parent="root"),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# critical path + attribution
+# ---------------------------------------------------------------------------
+
+def test_critical_path_sums_exactly_to_wall(tmp_path):
+    d = str(tmp_path / "trace")
+    _write(d, _workflow_spans())
+    a = analyze.analyze(d)
+    assert a.root["span_id"] == "root"   # driver preferred over worker
+    assert a.wall_us == 100
+    assert a.path_total_us == 100        # exact, by construction
+    assert a.coverage == 1.0
+    # the path descends through the rpc pair into the worker batch
+    names = [r["name"] for r in a.path]
+    assert "worker.batch" in names and "rpc.server.encrypt" in names
+    # hop [24,34) is the worker batch, full 10us of it
+    wb = [r for r in a.path if r["name"] == "worker.batch"]
+    assert [(r["t0"], r["dur_us"]) for r in wb] == [(24, 10)]
+
+
+def test_category_and_bucket_attribution(tmp_path):
+    assert analyze.category_of("device.compile") == "recompile"
+    assert analyze.category_of("worker.batch") == "device"
+    assert analyze.category_of("rpc.client.encrypt") == "rpc"
+    assert analyze.category_of("record.publish") == "serialization"
+    assert analyze.category_of("router.queue") == "queue-wait"
+    assert analyze.category_of("keyceremony.exchange") == "host"
+
+    d = str(tmp_path / "trace")
+    _write(d, _workflow_spans())
+    a = analyze.analyze(d)
+    # worker batch self time lands in its cross-process phase ancestor
+    assert a.buckets[("phase.encrypt", "worker", "device")] == 10
+    # rpc server self time = 20 - 10 (child batch)
+    assert a.buckets[("phase.encrypt", "worker", "rpc")] == 10
+    # every span's self time is accounted once: the driver's tree sums
+    # to its root dur, and the worker's root — whose rpc.server span
+    # parents CROSS-process into the client span, not into it — idles
+    # its full 100us as host self time
+    total = sum(a.buckets.values())
+    assert total == 100 + 100
+
+
+def test_top_self_time_and_knob(tmp_path, monkeypatch):
+    d = str(tmp_path / "trace")
+    _write(d, _workflow_spans())
+    monkeypatch.setenv("EGTPU_FLIGHT_TOP_N", "3")
+    a = analyze.analyze(d)
+    assert len(a.top_self) == 3
+    # the worker root is pure idle (its rpc.server span nests under the
+    # driver-side client span): the biggest self time in the run
+    assert a.top_self[0][0]["name"] == "process"
+    assert a.top_self[0][0]["proc"] == "worker"
+    assert a.top_self[0][1] == 100
+
+
+# ---------------------------------------------------------------------------
+# degradation: damaged traces produce partial reports, never crashes
+# ---------------------------------------------------------------------------
+
+def test_truncated_jsonl_tail_degrades_with_warning(tmp_path):
+    d = str(tmp_path / "trace")
+    _write(d, _workflow_spans())
+    with open(os.path.join(d, "spans-worker-2.jsonl"), "a") as f:
+        f.write('{"trace_id": "t1", "span_id": "torn", "na')   # SIGKILL
+    a = analyze.analyze(d)
+    assert any("malformed" in w for w in a.warnings)
+    assert a.coverage == 1.0             # the rest still analyzes fully
+
+
+def test_orphaned_spans_partial_report(tmp_path):
+    d = str(tmp_path / "trace")
+    spans = _workflow_spans() + [
+        _span("lost", "encrypt.batch", 40, 5, parent="never-exported",
+              proc="worker", pid=2)]
+    _write(d, spans)
+    a = analyze.analyze(d)
+    assert any("orphaned" in w for w in a.warnings)
+    assert a.path                        # critical path still computed
+
+
+def test_open_root_no_critical_path_but_no_crash(tmp_path):
+    d = str(tmp_path / "trace")
+    root = _span("root", "process", 0, 0)
+    del root["dur"]
+    root["open"] = True                  # killed driver: root never closed
+    _write(d, [root, _span("ph", "phase.encrypt", 10, 20, parent="root")])
+    a = analyze.analyze(d)
+    assert a.path == []
+    assert any("open" in w for w in a.warnings)
+    assert any("critical path unavailable" in w for w in a.warnings)
+    report = flight.render(a)
+    assert "Critical path unavailable" in report
+
+
+def test_clock_skewed_child_is_clipped_not_fatal(tmp_path):
+    d = str(tmp_path / "trace")
+    spans = [
+        _span("root", "process", 0, 100),
+        # worker clock runs 30us ahead: child extends past parent end
+        _span("late", "rpc.server.encrypt", 90, 25, parent="root",
+              proc="worker", pid=2),
+    ]
+    _write(d, spans)
+    a = analyze.analyze(d)
+    assert a.path_total_us == a.wall_us == 100   # clipped at the root
+    assert sum(us for k, us in a.buckets.items()
+               if k[1] == "workflow-driver") == 90
+
+
+# ---------------------------------------------------------------------------
+# anti-patterns
+# ---------------------------------------------------------------------------
+
+def _fleet_spans(slow_mean_ms=60, fast_mean_ms=10):
+    spans = [_span("root", "process", 0, 1_000_000)]
+    for w, mean_ms in (("encryption-worker-0", slow_mean_ms),
+                       ("encryption-worker-1", fast_mean_ms),
+                       ("encryption-worker-2", fast_mean_ms)):
+        pid = 10 + int(w[-1])
+        for i in range(3):
+            spans.append(_span(
+                f"{w}-b{i}", "worker.batch", 1000 + i * 100_000,
+                mean_ms * 1000, parent="root", proc=w, pid=pid))
+    return spans
+
+
+def test_straggler_named_and_reported(tmp_path):
+    d = str(tmp_path / "trace")
+    _write(d, _fleet_spans())
+    a = analyze.analyze(d)
+    assert [s["proc"] for s in a.stragglers] == ["encryption-worker-0"]
+    assert any(p["kind"] == "straggler-shard"
+               and p["subject"] == "encryption-worker-0"
+               for p in a.antipatterns)
+    rpt = flight.render(a)
+    assert "### Stragglers" in rpt
+    assert "**encryption-worker-0**" in rpt
+
+
+def test_straggler_ratio_knob(tmp_path, monkeypatch):
+    d = str(tmp_path / "trace")
+    _write(d, _fleet_spans(slow_mean_ms=60, fast_mean_ms=45))
+    assert analyze.analyze(d).stragglers == []     # 1.33x < default 1.5
+    monkeypatch.setenv("EGTPU_FLIGHT_STRAGGLER_RATIO", "1.2")
+    assert [s["proc"] for s in analyze.analyze(d).stragglers] \
+        == ["encryption-worker-0"]
+
+
+def test_midrun_recompile_flagged_prewarm_is_not(tmp_path):
+    d = str(tmp_path / "trace")
+    spans = [
+        _span("root", "process", 0, 1000),
+        # prewarm: compile BEFORE the first device batch — fine
+        _span("c0", "device.compile", 10, 50, parent="root"),
+        _span("b0", "encrypt.batch", 100, 50, parent="root"),
+        # a new shape mid-run: compile AFTER the first batch — flagged
+        _span("c1", "device.compile", 300, 50, parent="root"),
+    ]
+    _write(d, spans)
+    a = analyze.analyze(d)
+    assert a.recompiles_total == 2
+    assert [m["ts"] for m in a.midrun_recompiles] == [300]
+    assert any(p["kind"] == "midrun-recompile" for p in a.antipatterns)
+    rpt = flight.render(a)
+    assert "mid-run recompiles: 1" in rpt
+    assert "recompile discipline: **FAIL**" in rpt
+
+
+def test_queue_saturation_from_heartbeats(tmp_path):
+    d = str(tmp_path / "trace")
+    _write(d, _workflow_spans())
+    with open(os.path.join(d, "heartbeats.jsonl"), "w") as f:
+        for depth, proc in ((3, "worker"), (300, "worker"),
+                            (1, "workflow-driver")):
+            f.write(json.dumps({
+                "t_us": 50, "proc": proc, "pid": 2, "status": "SERVING",
+                "phase": "serving shard=1 head=ab admitted=4",
+                "queue_depth": depth}) + "\n")
+        f.write("{torn")                           # tolerant here too
+    a = analyze.analyze(d)
+    assert a.queue_max["worker"] == 300
+    assert any(p["kind"] == "queue-saturation" and p["subject"] == "worker"
+               for p in a.antipatterns)
+    # the heartbeat's shard id annotates the balance table
+    assert [s.shard for s in a.shards] == [1]
+    rpt = flight.render(a)
+    assert "queue depth: **FAIL**" in rpt
+
+
+# ---------------------------------------------------------------------------
+# assembler flow events (Perfetto arrows)
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_emits_flow_pairs_for_cross_process_links():
+    spans = _workflow_spans()
+    events = assemble.chrome_trace(spans)["traceEvents"]
+    assert len([e for e in events if e["ph"] == "X"]) == len(spans)
+    starts = [e for e in events if e["ph"] == "s"]
+    finishes = [e for e in events if e["ph"] == "f"]
+    # exactly one cross-pid link in the fixture: rpc.client -> rpc.server
+    assert [e["id"] for e in starts] == ["rs"]
+    assert [e["id"] for e in finishes] == ["rs"]
+    s, f = starts[0], finishes[0]
+    assert f["bp"] == "e"
+    assert s["name"] == f["name"] == "egtpu-link"
+    assert s["cat"] == f["cat"] == "egtpu"
+    # the start binds inside the PARENT's slice on the parent's track
+    assert s["pid"] == 1 and f["pid"] == 2
+    assert 20 <= s["ts"] < 50
+
+
+def test_flow_start_clamped_into_short_parent():
+    spans = [
+        _span("p", "rpc.client.x", 10, 5),
+        _span("c", "rpc.server.x", 40, 5, parent="p", proc="w", pid=2),
+    ]
+    ev = assemble.chrome_trace(spans)["traceEvents"]
+    s = [e for e in ev if e["ph"] == "s"][0]
+    assert 10 <= s["ts"] <= 14           # inside [10, 15), not at 40
+
+
+# ---------------------------------------------------------------------------
+# the CLIs: egreport + bench_diff
+# ---------------------------------------------------------------------------
+
+def test_egreport_cli(tmp_path, capsys):
+    d = str(tmp_path / "trace")
+    _write(d, _workflow_spans())
+    egreport = _tool("egreport")
+    out = str(tmp_path / "FLIGHT_REPORT.md")
+    assert egreport.main([d, "-out", out]) == 0
+    with open(out) as f:
+        rpt = f.read()
+    assert "# Flight report" in rpt and "## Critical path" in rpt
+    assert "coverage=100.0%" in capsys.readouterr().out
+    # an empty dir is the one hard failure
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    assert egreport.main([empty]) == 1
+
+
+def _bench(tmp_path, name, **overrides):
+    base = {"metric": "ballots_verified_tallied_per_sec_per_chip",
+            "value": 2.5, "unit": "ballots/s/chip", "platform": "cpu",
+            "nballots": 32, "encrypt_per_s": 10.0, "tally_s": 2.0,
+            "verify_s": 12.0,
+            "powmod_per_s": {"cios": 1000.0, "ntt": 800.0}}
+    base.update(overrides)
+    p = str(tmp_path / name)
+    with open(p, "w") as f:
+        json.dump(base, f)
+    return p
+
+
+def test_bench_diff_same_run_passes(tmp_path):
+    bd = _tool("bench_diff")
+    base = _bench(tmp_path, "base.json")
+    assert bd.main(["--baseline", base, "--run", base]) == 0
+
+
+def test_bench_diff_regression_fails_improvement_passes(tmp_path):
+    bd = _tool("bench_diff")
+    base = _bench(tmp_path, "base.json")
+    # 20% ballots/s drop: outside the 10% band -> non-zero exit
+    slow = _bench(tmp_path, "slow.json", value=2.0)
+    assert bd.main(["--baseline", base, "--run", slow]) == 1
+    # 20% improvement never fails, in either metric direction
+    fast = _bench(tmp_path, "fast.json", value=3.0, verify_s=8.0)
+    assert bd.main(["--baseline", base, "--run", fast]) == 0
+    # lower-is-better direction: verify_s +30% is a regression
+    slow_v = _bench(tmp_path, "slow_v.json", verify_s=16.0)
+    assert bd.main(["--baseline", base, "--run", slow_v]) == 1
+    # per-backend powmod rates gate too
+    slow_p = _bench(tmp_path, "slow_p.json",
+                    powmod_per_s={"cios": 700.0, "ntt": 800.0})
+    assert bd.main(["--baseline", base, "--run", slow_p]) == 1
+
+
+def test_bench_diff_tolerance_override_and_verdict_json(tmp_path):
+    bd = _tool("bench_diff")
+    base = _bench(tmp_path, "base.json")
+    slow = _bench(tmp_path, "slow.json", value=2.0)
+    verdict_path = str(tmp_path / "verdict.json")
+    # widening the band waves the same run through
+    assert bd.main(["--baseline", base, "--run", slow,
+                    "--tolerance", "value=0.25",
+                    "--json", verdict_path]) == 0
+    with open(verdict_path) as f:
+        v = json.load(f)
+    assert v["pass"] is True and v["regressions"] == []
+    row = [m for m in v["metrics"] if m["metric"] == "value"][0]
+    assert row["tolerance"] == 0.25 and row["status"] == "ok"
+
+
+def test_bench_diff_seeds_from_baseline_json_shape(tmp_path):
+    """A BASELINE.json with nothing published yet falls back to the
+    highest BENCH_r*.json beside it (how the repo baseline bootstraps)."""
+    bd = _tool("bench_diff")
+    baseline = str(tmp_path / "BASELINE.json")
+    with open(baseline, "w") as f:
+        json.dump({"metric": "...", "north_star": 2083.0,
+                   "published": {}}, f)
+    with open(str(tmp_path / "BENCH_r03.json"), "w") as f:
+        json.dump({"n": 3, "parsed": {"value": 2.5, "platform": "cpu"}}, f)
+    with open(str(tmp_path / "BENCH_r05.json"), "w") as f:
+        json.dump({"n": 5, "parsed": {"value": 2.6, "platform": "cpu"}}, f)
+    metrics, src = bd.load_artifact(baseline)
+    assert metrics["value"] == 2.6 and "BENCH_r" in src
+    # and a PROGRESS.jsonl trajectory works as either side
+    prog = str(tmp_path / "PROGRESS.jsonl")
+    with open(prog, "w") as f:
+        f.write(json.dumps({"ts": 1, "round": 1}) + "\n")        # driver row
+        f.write(json.dumps({"kind": "bench", "platform": "cpu",
+                            "ballots_per_s_per_chip": 2.55}) + "\n")
+    run = _bench(tmp_path, "run.json", value=2.5)
+    assert bd.main(["--baseline", prog, "--run", run]) == 0
+    # unusable artifacts are a load error, not a crash
+    assert bd.main(["--baseline", str(tmp_path / "nope.json"),
+                    "--run", run]) == 2
+
+
+def test_bench_diff_knob_default(tmp_path, monkeypatch):
+    bd = _tool("bench_diff")
+    base = _bench(tmp_path, "base.json")
+    run = _bench(tmp_path, "run.json")
+    monkeypatch.setenv("EGTPU_BENCH_BASELINE", base)
+    assert bd.main(["--run", run]) == 0
+
+
+# ---------------------------------------------------------------------------
+# egtop pane
+# ---------------------------------------------------------------------------
+
+def test_egtop_critical_path_pane(tmp_path):
+    egtop = _tool("egtop")
+    d = str(tmp_path / "trace")
+    _write(d, _workflow_spans())
+    pane = egtop.render_critical_path(d)
+    assert "critical path" in pane and "worker.batch" in pane
+    # a trace with no closed root degrades to a notice, never a crash
+    assert "unavailable" in egtop.render_critical_path(
+        str(tmp_path / "missing"))

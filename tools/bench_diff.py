@@ -1,0 +1,271 @@
+"""Noise-aware perf-regression gate over bench.py artifacts.
+
+Compares a fresh bench result against a pinned baseline, metric by
+metric, each with a direction (higher- or lower-is-better) and a
+relative tolerance band sized to that metric's observed run-to-run
+noise.  A run is a REGRESSION only when a metric is *worse* than the
+baseline by more than its band — improvements never fail, and metrics
+missing from either side are reported but don't gate (bench phases are
+individually skippable).
+
+Accepted artifact shapes (both ``--baseline`` and ``--run``):
+
+* a raw ``bench.py`` RESULT json (the last stdout line of a run);
+* a ``BENCH_r*.json`` wrapper (``{"parsed": {...}}``);
+* the repo ``BASELINE.json`` (its latest ``published`` entry; when
+  none has been published yet, the gate seeds itself from the highest
+  ``BENCH_r*.json`` sitting next to it);
+* a ``PROGRESS.jsonl`` trajectory (the last ``"kind": "bench"`` row).
+
+Usage::
+
+    python bench.py > /tmp/bench.json   # RESULT json is the last line
+    python tools/bench_diff.py --baseline BASELINE.json --run /tmp/bench.json
+    python tools/bench_diff.py --run /tmp/bench.json --tolerance value=0.25
+    python tools/bench_diff.py --run /tmp/bench.json --json verdict.json
+
+Exit codes: 0 pass, 1 regression, 2 artifact load error.  The default
+``--baseline`` is the ``EGTPU_BENCH_BASELINE`` knob, falling back to
+the repo's ``BASELINE.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: metric -> (higher_is_better, relative tolerance).  Bands reflect the
+#: observed run-to-run noise of each bench phase on a warm compile
+#: cache; the headline ballots/s gets the tightest band.
+METRICS: dict[str, tuple[bool, float]] = {
+    "value": (True, 0.10),               # ballots/s/chip (headline)
+    "encrypt_per_s": (True, 0.15),
+    "tally_s": (False, 0.20),
+    "verify_s": (False, 0.20),
+    "mixnet_rows_per_s": (True, 0.20),
+    "mixfed_stages_per_s": (True, 0.20),
+    "obs_spans_per_s": (True, 0.25),
+    "setup_s": (False, 0.50),            # dominated by compile cache
+}
+#: per-backend powmod rates live in a dict metric
+_POWMOD_TOL = (True, 0.15)
+#: fabric_<N>w_ballots_per_s keys are dynamic in worker count
+_FABRIC_RE = re.compile(r"^fabric_\d+w_ballots_per_s$")
+_FABRIC_TOL = (True, 0.20)
+
+
+def _metric_spec(key: str) -> tuple[bool, float] | None:
+    if key in METRICS:
+        return METRICS[key]
+    if _FABRIC_RE.match(key):
+        return _FABRIC_TOL
+    return None
+
+
+def _seed_from_bench_files(near: str) -> dict | None:
+    """Highest-numbered BENCH_r*.json beside ``near``, parsed."""
+    rounds = []
+    for p in glob.glob(os.path.join(os.path.dirname(near) or ".",
+                                    "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        if m:
+            rounds.append((int(m.group(1)), p))
+    for _, p in sorted(rounds, reverse=True):
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and "value" in parsed:
+            return parsed
+    return None
+
+
+def load_artifact(path: str) -> tuple[dict, str]:
+    """Load one artifact into a flat metric dict; returns
+    ``(metrics, provenance)``.  Raises ValueError when nothing usable
+    is found."""
+    if path.endswith(".jsonl"):
+        last = None
+        with open(path, errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and rec.get("kind") == "bench":
+                    last = rec
+        if last is None:
+            raise ValueError(f"{path}: no bench rows")
+        flat = dict(last)
+        if "ballots_per_s_per_chip" in flat:
+            flat.setdefault("value", flat["ballots_per_s_per_chip"])
+        return flat, f"{path} (last bench row)"
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a json object")
+    if isinstance(doc.get("parsed"), dict):       # BENCH_r*.json wrapper
+        return doc["parsed"], f"{path} (parsed)"
+    if "published" in doc and "value" not in doc:  # BASELINE.json
+        pub = doc["published"]
+        entries = list(pub.values()) if isinstance(pub, dict) else \
+            list(pub or [])
+        entries = [e for e in entries
+                   if isinstance(e, dict) and "value" in e]
+        if entries:
+            return entries[-1], f"{path} (published)"
+        seeded = _seed_from_bench_files(path)
+        if seeded is not None:
+            return seeded, f"{path} (seeded from highest BENCH_r*.json)"
+        raise ValueError(f"{path}: nothing published and no "
+                         f"BENCH_r*.json to seed from")
+    if "value" in doc:                             # raw RESULT json
+        return doc, path
+    raise ValueError(f"{path}: unrecognized bench artifact shape")
+
+
+def compare(baseline: dict, run: dict,
+            overrides: dict[str, float] | None = None) -> dict:
+    """Per-metric comparison; returns the machine-readable verdict."""
+    overrides = overrides or {}
+    rows: list[dict] = []
+
+    def one(key: str, base_v, run_v, higher: bool, tol: float) -> None:
+        tol = overrides.get(key, tol)
+        if base_v is None or run_v is None:
+            rows.append({"metric": key, "status": "missing",
+                         "baseline": base_v, "run": run_v})
+            return
+        try:
+            base_v, run_v = float(base_v), float(run_v)
+        except (TypeError, ValueError):
+            rows.append({"metric": key, "status": "missing",
+                         "baseline": base_v, "run": run_v})
+            return
+        if base_v == 0:
+            rows.append({"metric": key, "status": "skipped",
+                         "baseline": base_v, "run": run_v})
+            return
+        delta = (run_v - base_v) / abs(base_v)
+        worse = -delta if higher else delta
+        status = "regression" if worse > tol else \
+            ("improved" if worse < -tol else "ok")
+        rows.append({"metric": key, "status": status,
+                     "baseline": base_v, "run": run_v,
+                     "delta_rel": round(delta, 4), "tolerance": tol,
+                     "higher_is_better": higher})
+
+    keys = set(baseline) | set(run)
+    for key in sorted(keys):
+        spec = _metric_spec(key)
+        if spec is not None:
+            one(key, baseline.get(key), run.get(key), *spec)
+    bp, rp = baseline.get("powmod_per_s"), run.get("powmod_per_s")
+    if isinstance(bp, dict) and isinstance(rp, dict):
+        for backend in sorted(set(bp) | set(rp)):
+            one(f"powmod_per_s.{backend}", bp.get(backend),
+                rp.get(backend), *_POWMOD_TOL)
+
+    regressions = [r for r in rows if r["status"] == "regression"]
+    verdict = {
+        "pass": not regressions,
+        "n_compared": sum(1 for r in rows
+                          if r["status"] in ("ok", "improved",
+                                             "regression")),
+        "regressions": [r["metric"] for r in regressions],
+        "platform_match": baseline.get("platform") == run.get("platform"),
+        "baseline_platform": baseline.get("platform"),
+        "run_platform": run.get("platform"),
+        "metrics": rows,
+    }
+    return verdict
+
+
+def _parse_tolerances(items: list[str]) -> dict[str, float]:
+    out: dict[str, float] = {}
+    for item in items:
+        if "=" not in item:
+            raise ValueError(f"--tolerance wants metric=rel, got {item!r}")
+        k, v = item.split("=", 1)
+        out[k] = float(v)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("bench_diff")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline artifact (default: EGTPU_BENCH_"
+                         "BASELINE knob, else the repo BASELINE.json)")
+    ap.add_argument("--run", required=True,
+                    help="fresh bench artifact to gate")
+    ap.add_argument("--tolerance", action="append", default=[],
+                    metavar="METRIC=REL",
+                    help="override one metric's relative band, "
+                         "e.g. value=0.25 (repeatable)")
+    ap.add_argument("--json", nargs="?", const="-", default=None,
+                    metavar="PATH",
+                    help="write the machine-readable verdict "
+                         "(- or no value = stdout)")
+    args = ap.parse_args(argv)
+
+    from electionguard_tpu.utils import knobs
+
+    baseline_path = args.baseline or \
+        knobs.get_str("EGTPU_BENCH_BASELINE") or \
+        os.path.join(_REPO, "BASELINE.json")
+    try:
+        overrides = _parse_tolerances(args.tolerance)
+        baseline, base_src = load_artifact(baseline_path)
+        run, run_src = load_artifact(args.run)
+    except (OSError, ValueError) as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+
+    verdict = compare(baseline, run, overrides)
+    verdict["baseline_source"] = base_src
+    verdict["run_source"] = run_src
+
+    if args.json is not None:
+        text = json.dumps(verdict, indent=2, sort_keys=True)
+        if args.json == "-":
+            print(text)
+        else:
+            with open(args.json, "w") as f:
+                f.write(text + "\n")
+    if not verdict["platform_match"]:
+        print(f"bench_diff: WARNING platform mismatch "
+              f"(baseline {verdict['baseline_platform']}, "
+              f"run {verdict['run_platform']}): bands assume same "
+              f"hardware", file=sys.stderr)
+    for r in verdict["metrics"]:
+        if r["status"] in ("ok", "improved", "regression"):
+            arrow = {"ok": "=", "improved": "+", "regression": "!"}
+            print(f"  [{arrow[r['status']]}] {r['metric']}: "
+                  f"{r['baseline']} -> {r['run']} "
+                  f"({r['delta_rel'] * 100:+.1f}%, "
+                  f"band {r['tolerance'] * 100:.0f}%)")
+    if verdict["pass"]:
+        print(f"bench_diff: PASS ({verdict['n_compared']} metric(s) "
+              f"compared, baseline: {base_src})")
+        return 0
+    print(f"bench_diff: REGRESSION in "
+          f"{', '.join(verdict['regressions'])} "
+          f"(baseline: {base_src})", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

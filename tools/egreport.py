@@ -1,0 +1,70 @@
+"""Flight report: turn any trace dir into FLIGHT_REPORT.md.
+
+Analyzes a traced run's span dir (the run's ``EGTPU_OBS_TRACE`` dir, or
+a collector's receive dir) and writes the post-run evidence bundle:
+critical path with per-hop durations, phase x process x category
+wall-clock attribution, top-N self-time spans, per-shard balance table
+with straggler naming, compile/device-time summary, and SLO verdicts.
+
+A damaged trace (killed worker, truncated span file, clock skew)
+degrades to a partial report with warnings — the tool only fails when
+the dir holds no spans at all.
+
+Usage::
+
+    python tools/egreport.py /tmp/eg/trace
+    python tools/egreport.py /tmp/eg/trace -out FLIGHT_REPORT.md -topN 20
+    python tools/egreport.py /tmp/eg/trace -json            # verdict json
+
+``workflow/e2e.py -flightReport`` runs the same generator in-process
+after every traced run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("egreport")
+    ap.add_argument("trace_dir",
+                    help="span dir (the run's EGTPU_OBS_TRACE or a "
+                         "collector recv dir)")
+    ap.add_argument("-out", dest="output", default=None,
+                    help="report path (default FLIGHT_REPORT.md next to "
+                         "the trace dir)")
+    ap.add_argument("-topN", dest="top_n", type=int, default=None,
+                    help="rows in the top-self-time table "
+                         "(default EGTPU_FLIGHT_TOP_N)")
+    ap.add_argument("-json", dest="as_json", action="store_true",
+                    help="also print the machine-readable analysis json")
+    args = ap.parse_args(argv)
+
+    from electionguard_tpu.obs import flight
+
+    out_path, analysis = flight.write_report(
+        args.trace_dir, out_path=args.output, top_n=args.top_n)
+    if args.as_json:
+        print(json.dumps(analysis.to_json(), indent=2, sort_keys=True))
+    else:
+        print(f"flight report: {out_path}")
+        print(f"  spans={len(analysis.spans)} wall={analysis.wall_us / 1e6:.1f}s "
+              f"path={analysis.path_total_us / 1e6:.1f}s "
+              f"coverage={analysis.coverage * 100:.1f}%")
+        for p in analysis.antipatterns:
+            print(f"  anti-pattern: {p['kind']} on {p['subject']}")
+        for msg in analysis.warnings:
+            print(f"  warning: {msg}")
+    if not analysis.spans:
+        print("no spans found: nothing to report", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,10 +5,17 @@ redraws a terminal status board: fleet health, one row per process
 (state, liveness, heartbeat age, queue depth, current phase, serving
 p99, spans streamed, client-side drops), and the recent SLO alerts.
 
+With ``-trace <dir>`` the board gains a critical-path pane: each frame
+re-analyzes the span dir (the collector's receive dir, or the run's
+``EGTPU_OBS_TRACE``) with obs/analyze and shows the top hops the run's
+wall-clock is actually waiting on — the live version of the flight
+report's first table.
+
 Usage::
 
     python tools/egtop.py -collector localhost:17171
     python tools/egtop.py -collector localhost:17171 -once   # one frame
+    python tools/egtop.py -collector localhost:17171 -trace /tmp/eg/obs/recv
 """
 
 from __future__ import annotations
@@ -95,6 +102,30 @@ def render(status, color: bool = True) -> str:
     return "\n".join(lines)
 
 
+def render_critical_path(trace_dir: str, rows: int = 5) -> str:
+    """Critical-path pane: the top ``rows`` hops by self-on-path time
+    over the spans exported so far.  A mid-run or damaged trace degrades
+    to a one-line notice, never breaks the board."""
+    try:
+        from electionguard_tpu.obs import analyze
+        a = analyze.analyze(trace_dir)
+    except Exception as e:  # noqa: BLE001 — the pane must never kill the board
+        return f"critical path unavailable: {e}"
+    if not a.path:
+        return "critical path unavailable (no closed process-root span yet)"
+    lines = [f"critical path  wall {a.wall_us / 1e6:.1f}s  "
+             f"{len(a.path)} hop(s)"
+             + (f"  [{len(a.warnings)} warning(s)]" if a.warnings else "")]
+    top = sorted(a.path, key=lambda r: -r["dur_us"])[:rows]
+    for r in top:
+        pct = 100.0 * r["dur_us"] / a.wall_us if a.wall_us else 0.0
+        lines.append(f"  {r['dur_us'] / 1e6:>7.2f}s {pct:>5.1f}%  "
+                     f"{r['name']}  [{r['proc']}]")
+    for p in a.antipatterns:
+        lines.append(f"  ! {p['kind']}: {p['subject']}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser("egtop")
     ap.add_argument("-collector", required=True,
@@ -104,6 +135,10 @@ def main(argv=None) -> int:
     ap.add_argument("-once", action="store_true",
                     help="print one frame and exit (no screen control)")
     ap.add_argument("-noColor", dest="no_color", action="store_true")
+    ap.add_argument("-trace", dest="trace_dir", default=None,
+                    help="span dir to analyze per frame (collector recv "
+                         "dir or EGTPU_OBS_TRACE): adds a critical-path "
+                         "pane under the fleet board")
     args = ap.parse_args(argv)
 
     from electionguard_tpu.publish import pb
@@ -120,6 +155,8 @@ def main(argv=None) -> int:
             status = None
         else:
             frame = render(status, color=color)
+        if args.trace_dir:
+            frame += "\n" + render_critical_path(args.trace_dir)
         if args.once:
             print(frame)
             return 0 if status is not None else 1
