@@ -28,7 +28,7 @@ from electionguard_tpu.keyceremony.trustee import commitment_product
 from electionguard_tpu.publish.election_record import (ElectionConfig,
                                                        ElectionInitialized,
                                                        GuardianRecord)
-from electionguard_tpu.utils import clock
+from electionguard_tpu.utils import clock, errors
 
 # A transport-dead step is re-attempted at the PROTOCOL level before the
 # ceremony is abandoned: one rpc's bounded retries span well under a
@@ -126,17 +126,22 @@ def _key_ceremony_exchange(
     for t in trustees:
         keys = _step(t.send_public_keys)
         if isinstance(keys, Result):
-            return Result.Err(f"{t.id} sendPublicKeys: {keys.error}")
+            return Result.Err(errors.named(
+                "kc.exchange_failed",
+                f"{t.id} sendPublicKeys: {keys.error}"))
         # identity binding: a (possibly remote) trustee must answer with the
         # identity it registered under, or it could impersonate another
         # guardian and corrupt everyone's commitment bookkeeping
         if keys.guardian_id != t.id or keys.x_coordinate != t.x_coordinate:
-            return Result.Err(
-                f"trustee {t.id} (x={t.x_coordinate}) answered with "
-                f"identity {keys.guardian_id} (x={keys.x_coordinate})")
+            msg = (f"trustee {t.id} (x={t.x_coordinate}) answered with "
+                   f"identity {keys.guardian_id} (x={keys.x_coordinate})")
+            errors.reject("kc.equivocation", msg)
+            return Result.Err(errors.named("kc.equivocation", msg))
         val = keys.validate()
         if not val.ok:
-            return Result.Err(f"{t.id} public keys invalid: {val.error}")
+            msg = f"{t.id} public keys invalid: {val.error}"
+            errors.reject("kc.bad_proof", msg)
+            return Result.Err(errors.named("kc.bad_proof", msg))
         all_keys[t.id] = keys
 
     # round 2: distribute all key sets to all other trustees
@@ -147,8 +152,9 @@ def _key_ceremony_exchange(
                 continue
             res = _step(lambda: t.receive_public_keys(keys))
             if not res.ok:
-                return Result.Err(
-                    f"{t.id} rejected keys of {other_id}: {res.error}")
+                msg = f"{t.id} rejected keys of {other_id}: {res.error}"
+                errors.reject("kc.peer_reject", msg)
+                return Result.Err(errors.named("kc.peer_reject", msg))
 
     # round 3: pairwise encrypted share exchange, with challenge fallback
     set_phase("keyceremony-round3")
@@ -158,9 +164,10 @@ def _key_ceremony_exchange(
                 continue
             share = _step(lambda: sender.send_secret_key_share(receiver.id))
             if isinstance(share, Result):
-                return Result.Err(
+                return Result.Err(errors.named(
+                    "kc.exchange_failed",
                     f"{sender.id} sendSecretKeyShare({receiver.id}): "
-                    f"{share.error}")
+                    f"{share.error}"))
             res = _step(lambda: receiver.receive_secret_key_share(share))
             if not res.ok and res.transport:
                 # transport death, not a rejection: the receiver never
@@ -169,32 +176,47 @@ def _key_ceremony_exchange(
                 # network died would leak secret-sharing state on every
                 # crash; only an explicit in-band rejection may trigger
                 # the reveal below.
-                return Result.Err(
+                return Result.Err(errors.named(
+                    "rpc.unreachable",
                     f"{receiver.id} unreachable receiving "
-                    f"{sender.id}'s share: {res.error}")
+                    f"{sender.id}'s share: {res.error}"))
             if not res.ok:
+                # in-band rejection of the encrypted share (bad MAC /
+                # polynomial check): a contained detection — the
+                # challenge path below decides whether the ceremony
+                # survives it
+                errors.reject("kc.bad_share",
+                              f"{receiver.id} rejected {sender.id}'s "
+                              f"share: {res.error}")
                 # challenge path: sender must reveal the coordinate; everyone
                 # can check it against the public commitments.
                 challenge = _step(
                     lambda: sender.challenge_share(receiver.id))
                 if isinstance(challenge, Result):
-                    return Result.Err(
-                        f"{sender.id} failed challenge for {receiver.id}: "
-                        f"{challenge.error} (original: {res.error})")
+                    msg = (f"{sender.id} failed challenge for "
+                           f"{receiver.id}: {challenge.error} "
+                           f"(original: {res.error})")
+                    errors.reject("kc.challenge_refused", msg)
+                    return Result.Err(errors.named(
+                        "kc.challenge_refused", msg))
                 expected = commitment_product(
                     group, all_keys[sender.id].coefficient_commitments,
                     receiver.x_coordinate)
                 if group.g_pow_p(challenge.coordinate) != expected:
-                    return Result.Err(
-                        f"challenge verification failed: {sender.id}'s "
-                        f"share for {receiver.id} does not match its "
-                        f"commitments (original: {res.error})")
+                    msg = (f"challenge verification failed: {sender.id}'s "
+                           f"share for {receiver.id} does not match its "
+                           f"commitments (original: {res.error})")
+                    errors.reject("kc.challenge_failed", msg)
+                    return Result.Err(errors.named(
+                        "kc.challenge_failed", msg))
                 # coordinate is publicly verified; receiver ingests it
                 accept = _step(
                     lambda: receiver.receive_challenged_share(challenge))
                 if not accept.ok:
-                    return Result.Err(
-                        f"{receiver.id} rejects {sender.id}'s challenged "
-                        f"share: {accept.error}")
+                    msg = (f"{receiver.id} rejects {sender.id}'s "
+                           f"challenged share: {accept.error}")
+                    errors.reject("kc.challenge_failed", msg)
+                    return Result.Err(errors.named(
+                        "kc.challenge_failed", msg))
 
     return KeyCeremonyResults(all_keys)

@@ -39,7 +39,7 @@ from electionguard_tpu.obs import REGISTRY, set_phase, span
 from electionguard_tpu.publish import pb, serialize
 from electionguard_tpu.publish.publisher import Consumer, Publisher
 from electionguard_tpu.remote import rpc_util
-from electionguard_tpu.utils import clock, knobs
+from electionguard_tpu.utils import clock, errors, knobs
 
 log = logging.getLogger("mixfed.coordinator")
 
@@ -80,6 +80,7 @@ class _MixServer:
         self.reg_nonce = nonce
         self.stage: Optional[int] = None   # assigned stage, if any
         self.failed = False
+        self.fail_cause = ""               # named cause of the eviction
         self._channel = None
         self._stub: Optional[rpc_util.Stub] = None
 
@@ -147,8 +148,10 @@ class MixCoordinator:
                             server_id=sid,
                             constants=rpc_util.group_constants_msg(
                                 self.group))
+                    msg = f"duplicate mix server id {sid}"
+                    errors.reject("rpc.stale_registration", msg)
                     return pb.RegisterMixServerResponse(
-                        error=f"duplicate mix server id {sid}")
+                        error=errors.named("rpc.stale_registration", msg))
             self.servers.append(_MixServer(
                 sid, request.remote_url,
                 bytes(request.registration_nonce)))
@@ -228,7 +231,8 @@ class MixCoordinator:
             n_rows=n, width=w,
             group_fingerprint=self.group.fingerprint()))
         if ready.error:
-            raise _StageFailed(f"registerStage: {ready.error}")
+            raise _StageFailed(f"registerStage: {ready.error}",
+                               check="refused")
         chunk = _chunk_rows()
         for start in range(0, n, chunk):
             rows = [serialize.publish_mix_row(self.group, pads[i], datas[i])
@@ -236,22 +240,27 @@ class MixCoordinator:
             resp = stub.call("pushRows", pb.MixRowChunk(
                 stage_index=k, chunk_start=start, rows=rows))
             if not resp.ok:
-                raise _StageFailed(f"pushRows@{start}: {resp.error}")
+                raise _StageFailed(f"pushRows@{start}: {resp.error}",
+                                   check="refused")
         result = stub.call("shuffleStage", pb.MixShuffleRequest(
             stage_index=k, input_hash=input_hash))
         if result.error:
-            raise _StageFailed(f"shuffleStage: {result.error}")
+            # the server refused to shuffle: disputed input rows or a
+            # transcript replayed against a different input
+            raise _StageFailed(f"shuffleStage: {result.error}",
+                               check="input_mismatch")
         out_pads: list = []
         out_datas: list = []
         while len(out_pads) < n:
             got = stub.call("pullRows", pb.MixRowRequest(
                 stage_index=k, chunk_start=len(out_pads), max_rows=chunk))
             if got.error:
-                raise _StageFailed(f"pullRows: {got.error}")
+                raise _StageFailed(f"pullRows: {got.error}",
+                                   check="transfer")
             if not got.rows:
                 raise _StageFailed(
                     f"pullRows: server returned {len(out_pads)} of {n} "
-                    f"rows then went empty")
+                    f"rows then went empty", check="transfer")
             for rm in got.rows:
                 row_a, row_b = serialize.import_mix_row(self.group, rm)
                 out_pads.append(row_a)
@@ -260,14 +269,16 @@ class MixCoordinator:
                 != bytes(result.output_hash):
             raise _StageFailed(
                 f"stage {k}: pulled rows do not digest to the server's "
-                f"output hash (corrupted transfer?)")
+                f"output hash (corrupted transfer?)", check="transfer")
         hdr = result.header
         if (int(hdr.stage_index) != k or int(hdr.n_rows) != n
                 or int(hdr.width) != w
                 or serialize.import_u256(hdr.input_hash) != input_hash):
+            # a replayed transcript: the result describes some OTHER
+            # stage (wrong index / rows / input hash)
             raise _StageFailed(
                 f"stage {k}: result header does not describe the "
-                f"requested stage")
+                f"requested stage", check="replay")
         proof = serialize.import_mix_proof(self.group, hdr.proof)
         return MixStage(k, n, w, input_hash, out_pads, out_datas, proof)
 
@@ -286,9 +297,19 @@ class MixCoordinator:
         while k < n_stages:
             srv = self._next_server()
             if srv is None:
+                # exhaustion discovered a stage AFTER the evictions that
+                # caused it; re-surface their named causes so the abort
+                # text says WHY every server is gone (and so a sound
+                # abort under attack stays attributable to the attack)
+                with self._lock:
+                    causes = [f"{s.id}: {s.fail_cause}"
+                              for s in self.servers
+                              if s.failed and s.fail_cause]
                 raise MixFedError(
                     f"stage {k}: no registered mix server left to run it "
-                    f"(all assigned or failed)")
+                    f"(all assigned or failed"
+                    + (f"; evictions: {'; '.join(causes)}" if causes
+                       else "") + ")")
             srv.stage = k
             set_phase(f"mix-stage-{k}")
             with span("mixfed.forward", {"stage": k, "server": srv.id}):
@@ -299,15 +320,26 @@ class MixCoordinator:
                 except (grpc.RpcError, _StageFailed) as e:
                     detail = (f"{e.code().name}: {e.details()}"
                               if isinstance(e, grpc.RpcError) else str(e))
+                    cls = getattr(e, "check", "")
+                    if cls:
+                        # in-band refusal with a named cause: a
+                        # contained detection even when a spare absorbs
+                        # the requeue
+                        errors.reject(f"mix.{cls}",
+                                      f"stage {k} on {srv.id}: {detail}")
                     log.warning("stage %d failed on server %s (%s); "
                                 "requeueing on a spare", k, srv.id, detail)
                     srv.failed = True
+                    srv.fail_cause = (errors.named(f"mix.{cls}", detail)
+                                      if cls else detail)
                     srv.close()
                     REGISTRY.counter("mixfed_stage_requeues_total").inc()
                     if self._next_server() is None:
-                        raise MixFedError(
-                            f"stage {k} failed on server {srv.id} "
-                            f"({detail}) and no spare server remains")
+                        msg = (f"stage {k} failed on server {srv.id} "
+                               f"({detail}) and no spare server remains")
+                        if cls:
+                            msg = errors.named(f"mix.{cls}", msg)
+                        raise MixFedError(msg, check=cls)
                     continue
                 # ---- verify BEFORE forwarding ------------------------
                 rec = _Recorder()
@@ -317,18 +349,23 @@ class MixCoordinator:
                     check, msg = (rec.failures[0] if rec.failures
                                   else ("mix_verify", "unknown"))
                     check = check.split(".")[-1]
+                    short = check[4:] if check.startswith("mix_") else check
+                    errors.reject(f"mix.{short}",
+                                  f"stage {k} on {srv.id}: {msg}")
                     log.error("stage %d from server %s FAILED pre-forward "
                               "verification [%s]: %s — requeueing", k,
                               srv.id, check, msg)
                     srv.failed = True
+                    srv.fail_cause = errors.named(f"mix.{short}", msg)
                     srv.close()
                     REGISTRY.counter("mixfed_bad_proofs_total").inc()
                     REGISTRY.counter("mixfed_stage_requeues_total").inc()
                     if self._next_server() is None:
-                        raise MixFedError(
+                        raise MixFedError(errors.named(
+                            f"mix.{short}",
                             f"stage {k} from server {srv.id} failed "
                             f"verification ({check}: {msg}) and no spare "
-                            f"server remains", check=check)
+                            f"server remains"), check=check)
                     continue
             path = self.publisher.write_mix_stage(self.group, stage)
             output_hash = rows_digest(self.group, stage.pads, stage.datas)

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import logging
 import os
+import sys
 import threading
 from typing import Optional
 
@@ -43,6 +44,18 @@ from electionguard_tpu.remote import rpc_util
 from electionguard_tpu.utils import clock, knobs
 
 log = logging.getLogger("mixfed.server")
+
+
+def _adversary_mod():
+    """The sim's adversary registry WITHOUT importing the sim package
+    into honest processes: present when already imported (a sim run),
+    otherwise imported only when the EGTPU_MIX_TAMPER drill knob asks
+    for it (the knob is a thin alias for the ``mix_tamper_output``
+    adversary)."""
+    mod = sys.modules.get("electionguard_tpu.sim.adversary")
+    if mod is None and os.environ.get("EGTPU_MIX_TAMPER"):
+        from electionguard_tpu.sim import adversary as mod
+    return mod
 
 
 def _env_shards() -> int:
@@ -61,12 +74,14 @@ class MixServerServer:
                  tamper: bool = False, seed: Optional[bytes] = None):
         self.group = group
         self.server_id = server_id
-        # tamper knob (tests + drills): corrupt one output ciphertext
+        # tamper hook (tests + drills): corrupt one output ciphertext
         # AFTER proving, so the published transcript no longer binds —
         # the coordinator's pre-forward verification must catch it as a
-        # V15.mix_binding failure, never publish it
-        self._tamper = tamper or os.environ.get("EGTPU_MIX_TAMPER") in (
-            "1", server_id)
+        # V15.mix_binding failure, never publish it.  The ctor flag is
+        # the direct form; the EGTPU_MIX_TAMPER knob and the sim's
+        # seeded schedules both mount the same `mix_tamper_output`
+        # adversary (sim/adversary.py), consulted per shuffled stage.
+        self._tamper = tamper
         self._pinned_seed = seed
         shards = _env_shards() if shards is None else shards
         self._ops = None
@@ -222,7 +237,9 @@ class MixServerServer:
                 stage = run_stage(self.group, self._public_key, self._qbar,
                                   k, pads, datas, seed=self._pinned_seed,
                                   shuffler=sh)
-            if self._tamper:
+            adv = _adversary_mod()
+            if self._tamper or (adv is not None
+                                and adv.mix_tamper_fires(self.server_id)):
                 # corrupt one output AFTER proving: digest matches the
                 # rows we hand back, but the Fiat–Shamir challenge no
                 # longer re-derives — a mix_binding failure downstream

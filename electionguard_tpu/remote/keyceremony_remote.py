@@ -37,7 +37,7 @@ from electionguard_tpu.keyceremony.interface import (KeyCeremonyTrusteeIF,
 from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
 from electionguard_tpu.publish import pb, serialize
 from electionguard_tpu.remote import rpc_util
-from electionguard_tpu.utils import clock
+from electionguard_tpu.utils import clock, errors
 
 log = logging.getLogger("egtpu.remote.keyceremony")
 
@@ -220,7 +220,10 @@ class KeyCeremonyCoordinator:
                                     quorum=self.quorum,
                                     constants=rpc_util.group_constants_msg(
                                         self.group))
-                    return Resp(error=f"duplicate guardian id {gid}")
+                    msg = f"duplicate guardian id {gid}"
+                    errors.reject("rpc.stale_registration", msg)
+                    return Resp(error=errors.named(
+                        "rpc.stale_registration", msg))
             if self._started_ceremony:
                 return Resp(error="ceremony already started")
             if len(self.proxies) >= self.n:

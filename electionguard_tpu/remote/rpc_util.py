@@ -53,6 +53,12 @@ def transport():
     return _transport
 
 
+# adversary seam: sim/adversary.py sets this to its wrap_server_impl
+# when it is imported (the sim, or a mixfed server running the
+# EGTPU_MIX_TAMPER drill).  None = honest process, no hook consulted.
+_adversary_wrap: Optional[Callable[[str, Callable], Callable]] = None
+
+
 def _env_float(name: str, default: float) -> float:
     try:
         return float(os.environ.get(name, default))
@@ -216,10 +222,14 @@ def generic_service(service_name: str,
                 raise ValueError(
                     f"missing impl for {service_name}.{m.name}")
         req_cls, _ = _method_classes(m)
-        wrapped = obs_trace.wrap_server_method(
-            service_name, m.name,
-            _observe_server(service_name, m.name,
-                            faults.wrap_server_impl(m.name, fn)))
+        inner = _observe_server(service_name, m.name,
+                                faults.wrap_server_impl(m.name, fn))
+        if _adversary_wrap is not None:
+            # outermost of observe/faults: a fault-injected abort must
+            # propagate PAST the adversary hook, so an attack whose
+            # response never left the server is not recorded as fired
+            inner = _adversary_wrap(m.name, inner)
+        wrapped = obs_trace.wrap_server_method(service_name, m.name, inner)
         handlers[m.name] = grpc.unary_unary_rpc_method_handler(
             wrapped,
             request_deserializer=req_cls.FromString,

@@ -39,7 +39,7 @@ from electionguard_tpu.serve.batcher import (DrainingError, DynamicBatcher,
                                              QueueFullError)
 from electionguard_tpu.serve.metrics import ServiceMetrics
 from electionguard_tpu.serve.worker import EncryptionWorker, InvalidBallotError
-from electionguard_tpu.utils import clock
+from electionguard_tpu.utils import clock, errors
 
 log = logging.getLogger("serve.service")
 
@@ -268,7 +268,9 @@ class EncryptionService:
         ballot = serialize.import_plaintext_ballot(ballot_msg)
         if ballot.ballot_id.startswith("__pad-"):
             # the filler namespace is the worker's, not the client's
-            return None, "ballot id prefix '__pad-' is reserved"
+            msg = "ballot id prefix '__pad-' is reserved"
+            errors.reject("serve.reserved_id", msg)
+            return None, errors.named("serve.reserved_id", msg)
         try:
             self.metrics.inc("requests_admitted")
             return self._admit(ballot, spoil), None
@@ -289,7 +291,14 @@ class EncryptionService:
         try:
             b = clock.wait_future(future, _RESULT_TIMEOUT)
         except InvalidBallotError as e:
-            return Resp(error=f"invalid ballot: {e}", shard_id=sid)
+            # stable named class for the soundness oracle: duplicates
+            # (in-batch or cross-batch replays) are their own class,
+            # everything else is a malformed submission
+            cls = ("serve.duplicate_ballot" if "duplicate" in str(e)
+                   else "serve.invalid_ballot")
+            errors.reject(cls, str(e))
+            return Resp(error=errors.named(cls, f"invalid ballot: {e}"),
+                        shard_id=sid)
         except Exception as e:  # noqa: BLE001 — in-band, like the planes
             self.metrics.inc("requests_failed")
             return Resp(error=f"encryption failed: {type(e).__name__}: {e}",

@@ -1,0 +1,576 @@
+"""Seeded in-protocol adversaries for the deterministic sim.
+
+Where ``testing/faults.py`` models an honest-but-unlucky world (crashes,
+drops, partitions), this registry models *malice*: named attacks in
+which a protocol participant itself misbehaves — a trustee serving a bad
+Schnorr proof or a share that fails the polynomial check, a guardian
+equivocating about its identity, a mix server tampering with its output
+after proving or replaying a previous stage's transcript, a client
+submitting malformed/duplicate ballots or replaying a stale
+registration nonce.
+
+Attacks mount at the SAME hook points the fault plans use, so the
+honest path has zero call-site changes:
+
+* server side — :func:`wrap_server_impl` is consulted by
+  ``rpc_util.generic_service`` through the late-binding
+  ``rpc_util._adversary_wrap`` seam (set when this module imports, so
+  real honest processes never pay for it);
+* client side — the sim transport asks the active plan to mutate or
+  forge-duplicate outbound requests (``AdversaryPlan.apply_client``);
+* behavior — a misbehaving server consults the plan directly
+  (:func:`mix_tamper_fires`), which is also where the old
+  ``EGTPU_MIX_TAMPER`` drill now lands: the knob is a thin env alias
+  that mounts the ``mix_tamper_output`` adversary.
+
+Every attack is deterministic: rules fire on exact per-(side, method,
+node) call indices derived from the schedule's seed, mutators are pure
+functions of the message, and ``fired`` is an audit log the soundness
+oracle checks against the run's detections — an attack that fired and
+was never rejected in-band nor caught by the verifier is an oracle
+violation.
+
+This module stays a leaf of the sim package (stdlib + ``rpc_util``,
+which honest processes import anyway) so the mixfed server's gated
+import and the rpc_util seam cannot drag the heavy sim package into
+honest processes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from electionguard_tpu.remote import rpc_util
+
+# pseudo-method key for behavior rules: the mixfed server consults the
+# plan at its tamper decision point; no rpc by this name exists
+MIX_TAMPER_METHOD = "__mix_tamper__"
+
+_CLIENT_KINDS = ("mutate_request", "forge_dup")
+_SERVER_KINDS = ("mutate_response", "replay_response", "behavior")
+
+
+def _copy(msg):
+    c = type(msg)()
+    c.CopyFrom(msg)
+    return c
+
+
+def _flip(b: bytes) -> bytes:
+    """Same-width corruption (serialize importers width-check, so the
+    mutated field must still parse — wrong value, right shape)."""
+    v = bytearray(b or b"\x00")
+    v[-1] ^= 0x01
+    return bytes(v)
+
+
+# ---------------------------------------------------------------- rules
+
+
+@dataclass(frozen=True)
+class AdvRule:
+    """One mounted misbehavior.  ``node`` narrows the rule to a single
+    sim node ('' = any); ``on_calls`` are 1-based per-(side, method,
+    node) call indices ('' rules count globally); ``mutate`` edits a
+    message in place and returns True iff it really changed it (so
+    ``fired`` never records a no-op)."""
+
+    attack: str
+    method: str
+    kind: str
+    on_calls: tuple[int, ...] = ()
+    node: str = ""
+    mutate: Optional[Callable] = None
+
+    @property
+    def side(self) -> str:
+        return "client" if self.kind in _CLIENT_KINDS else "server"
+
+
+class AdversaryPlan:
+    """Active set of adversary rules plus the audit state the soundness
+    oracle reads: ``fired`` (attack, method, call, node) records every
+    misbehavior that actually reached the wire."""
+
+    def __init__(self, rules=()):
+        self.rules: tuple[AdvRule, ...] = tuple(rules)
+        # sim wires this to transport.current_node; None = real process
+        self.node_fn: Optional[Callable[[], str]] = None
+        self._lock = threading.Lock()
+        self._counts: dict = {}
+        self._captures: dict = {}
+        self._tls = threading.local()
+        self.fired: list[tuple[str, str, int, str]] = []
+
+    def current_node(self) -> str:
+        fn = self.node_fn
+        return fn() if fn is not None else ""
+
+    def has_rules(self, side: str, method: str) -> bool:
+        return any(r.side == side and r.method == method
+                   for r in self.rules)
+
+    def firing(self, side: str, method: str, node: str):
+        """Advance the call counters and return the [(rule, n)] that
+        fire on this call.  Node-scoped rules match the per-node count,
+        ''-rules the global one."""
+        with self._lock:
+            kg = (side, method, "")
+            ng = self._counts[kg] = self._counts.get(kg, 0) + 1
+            nn = ng
+            if node:
+                kn = (side, method, node)
+                nn = self._counts[kn] = self._counts.get(kn, 0) + 1
+        hits = []
+        for r in self.rules:
+            if r.side != side or r.method != method:
+                continue
+            if r.node and r.node != node:
+                continue
+            n = nn if r.node else ng
+            if not r.on_calls or n in r.on_calls:
+                hits.append((r, n))
+        return hits
+
+    def record_fired(self, rule: AdvRule, n: int, node: str) -> None:
+        """Durable audit entry: the misbehavior changed state some
+        defense can still see (a request that reached its handler, a
+        tampered server-side artifact)."""
+        with self._lock:
+            self.fired.append((rule.attack, rule.method, n, node))
+
+    def record_fired_response(self, rule: AdvRule, n: int,
+                              node: str) -> None:
+        """Audit entry for a RESPONSE-only misbehavior: staged while a
+        delivery scope is open (sim transport), because a mutated or
+        replayed response that dies in flight is never seen by any
+        defense — the honest retry supersedes it, and counting it as
+        fired would be a false soundness violation."""
+        staged = getattr(self._tls, "staged", None)
+        if staged is not None:
+            staged.append((rule.attack, rule.method, n, node))
+        else:
+            self.record_fired(rule, n, node)
+
+    # delivery scopes (sim transport): recordings made between begin
+    # and end land in ``fired`` only if the response was delivered
+    # (commit=True) — or, nested, in the enclosing scope's staging
+    def begin_delivery(self):
+        prev = getattr(self._tls, "staged", None)
+        self._tls.staged = []
+        return prev
+
+    def end_delivery(self, token, commit: bool) -> None:
+        staged = getattr(self._tls, "staged", None) or []
+        self._tls.staged = token
+        if not commit or not staged:
+            return
+        if token is not None:
+            token.extend(staged)
+        else:
+            with self._lock:
+                self.fired.extend(staged)
+
+    # replay support: the first response seen for a method is cached;
+    # a firing replay rule substitutes it for the live answer
+    def wants_capture(self, method: str) -> bool:
+        return any(r.kind == "replay_response" and r.method == method
+                   for r in self.rules)
+
+    def capture(self, method: str, resp) -> None:
+        with self._lock:
+            self._captures.setdefault(method, _copy(resp))
+
+    def captured(self, method: str):
+        with self._lock:
+            resp = self._captures.get(method)
+        return _copy(resp) if resp is not None else None
+
+    def apply_client(self, method: str, node: str, request):
+        """Client-side hook (sim transport): returns
+        ``(request_to_send, pending, forged)`` where ``pending`` is the
+        [(rule, n)] to record as fired once the real dispatch succeeds
+        and ``forged`` is [(rule, n, message)] extra requests to
+        dispatch after it (duplicate/replayed submissions)."""
+        hits = self.firing("client", method, node)
+        req_out, pending, forged = request, [], []
+        for rule, n in hits:
+            if rule.kind == "mutate_request" and rule.mutate is not None:
+                cand = _copy(req_out)
+                if rule.mutate(cand):
+                    req_out = cand
+                    pending.append((rule, n))
+            elif rule.kind == "forge_dup":
+                cand = _copy(request)
+                if rule.mutate is None or rule.mutate(cand):
+                    forged.append((rule, n, cand))
+        return req_out, pending, forged
+
+
+# ------------------------------------------------------- install/clear
+
+_install_lock = threading.Lock()
+_active: Optional[AdversaryPlan] = None
+_loaded_env = False
+
+
+def install(plan: Optional[AdversaryPlan]) -> Optional[AdversaryPlan]:
+    global _active, _loaded_env
+    with _install_lock:
+        _active = plan
+        _loaded_env = True
+    return plan
+
+
+def clear() -> None:
+    install(None)
+
+
+def active_plan() -> Optional[AdversaryPlan]:
+    global _active, _loaded_env
+    with _install_lock:
+        if not _loaded_env:
+            _loaded_env = True
+            _active = _plan_from_env()
+        return _active
+
+
+def _plan_from_env() -> Optional[AdversaryPlan]:
+    # EGTPU_MIX_TAMPER is a thin alias for the mix_tamper_output
+    # adversary: "1" tampers on any server's first stage, any other
+    # value names the one server that tampers.
+    val = os.environ.get("EGTPU_MIX_TAMPER")
+    if not val:
+        return None
+    node = "" if val == "1" else val
+    return AdversaryPlan(build("mix_tamper_output", node, 1))
+
+
+# ------------------------------------------------------------ mutators
+
+
+def _mut_bad_schnorr(resp) -> bool:
+    """Corrupt the first coefficient proof's challenge: the key set no
+    longer validates (kc.bad_proof at the coordinator)."""
+    if resp.error or not resp.coefficient_proofs:
+        return False
+    ch = resp.coefficient_proofs[0].challenge
+    ch.value = _flip(ch.value)
+    return True
+
+
+def _mut_equivocate(resp) -> bool:
+    """Claim another identity for an otherwise-valid key set: the
+    coordinator's identity binding (kc.equivocation) must refuse it."""
+    if resp.error or not resp.guardian_id:
+        return False
+    resp.guardian_id = resp.guardian_id + "-evil"
+    return True
+
+
+def _mut_bad_share(resp) -> bool:
+    """Corrupt the encrypted coordinate's body: the designated guardian's
+    MAC check fails (polynomial share unusable), forcing the challenge
+    path (kc.bad_share)."""
+    if resp.error or not resp.HasField("encrypted_coordinate"):
+        return False
+    enc = resp.encrypted_coordinate
+    enc.c1 = _flip(enc.c1)
+    return True
+
+
+def _mut_bad_challenge(resp) -> bool:
+    """Answer a share challenge with a wrong coordinate: the public
+    commitment-product check must fail (kc.challenge_failed)."""
+    if resp.error or not resp.HasField("coordinate"):
+        return False
+    resp.coordinate.value = _flip(resp.coordinate.value)
+    return True
+
+
+def _mut_swap_commitments(resp) -> bool:
+    """Collude on the permutation transcript: reorder two permutation
+    commitments (each still a valid group element) so the proof no
+    longer matches the shuffle it claims."""
+    if resp.error or not resp.HasField("header"):
+        return False
+    pc = resp.header.proof.permutation_commitments
+    if len(pc) < 2:
+        return False
+    tmp = _copy(pc[0])
+    pc[0].CopyFrom(pc[1])
+    pc[1].CopyFrom(tmp)
+    return True
+
+
+def _mut_malformed_ballot(req) -> bool:
+    """Submit a ballot naming a selection the manifest doesn't have:
+    admission must reject it in-band (serve.invalid_ballot)."""
+    if not req.ballot.contests or not req.ballot.contests[0].selections:
+        return False
+    req.ballot.contests[0].selections[0].selection_id = "evil-write-in"
+    return True
+
+
+def _mut_stale_nonce(req) -> bool:
+    """Replay a registration under the same guardian id with a stale
+    nonce — a relaunched/forged trustee must be refused, not silently
+    merged (rpc.stale_registration)."""
+    if not req.registration_nonce:
+        return False
+    req.registration_nonce = _flip(req.registration_nonce)
+    return True
+
+
+def _mut_noop(resp) -> bool:
+    """Planted no-op 'attack' (test-only, not in the corpus): fires but
+    changes nothing, so NO defense can detect it — the guaranteed
+    soundness-oracle violation the planted tests and the shrinker
+    demonstration need."""
+    return True
+
+
+# ------------------------------------------------------------ registry
+
+
+@dataclass(frozen=True)
+class Attack:
+    """One named in-protocol attack.  ``rules`` are templates
+    ``(method, kind, mutate, every)`` instantiated by :func:`build`;
+    ``expect`` are the named error classes / detection classes ANY ONE
+    of which counts as the defense firing; ``targets`` and
+    ``nth_range`` bound the seed-derived draws in
+    ``schedule.generate_adversary_schedule``."""
+
+    name: str
+    doc: str
+    expect: tuple[str, ...]
+    targets: tuple[str, ...]
+    rules: tuple
+    nth_range: tuple[int, int] = (1, 1)
+    in_corpus: bool = True
+
+
+_GUARDIANS = ("guardian-0", "guardian-1", "guardian-2")
+_MIXERS = ("mix-0", "mix-1")
+_VOTERS = ("voter-0", "voter-1")
+
+ATTACKS: tuple[Attack, ...] = (
+    Attack(
+        "kc_bad_schnorr",
+        "trustee serves a public key set whose Schnorr proof is wrong",
+        expect=("kc.bad_proof",),
+        targets=_GUARDIANS,
+        rules=(("sendPublicKeys", "mutate_response",
+                _mut_bad_schnorr, False),),
+    ),
+    Attack(
+        "kc_equivocate",
+        "trustee claims a different identity to the coordinator than "
+        "it registered under",
+        expect=("kc.equivocation",),
+        targets=_GUARDIANS,
+        rules=(("sendPublicKeys", "mutate_response",
+                _mut_equivocate, False),),
+    ),
+    Attack(
+        "kc_bad_share_mac",
+        "trustee serves an encrypted key share that fails the MAC / "
+        "polynomial check at its designated guardian",
+        expect=("kc.bad_share", "kc.challenge_failed"),
+        targets=_GUARDIANS,
+        rules=(("sendSecretKeyShare", "mutate_response",
+                _mut_bad_share, False),),
+        nth_range=(1, 2),
+    ),
+    Attack(
+        "kc_bad_challenge",
+        "trustee serves a bad share AND answers the resulting challenge "
+        "with a wrong coordinate",
+        expect=("kc.challenge_failed",),
+        targets=_GUARDIANS,
+        rules=(("sendSecretKeyShare", "mutate_response",
+                _mut_bad_share, False),
+               ("challengeShare", "mutate_response",
+                _mut_bad_challenge, True)),
+        nth_range=(1, 2),
+    ),
+    Attack(
+        "mix_tamper_output",
+        "mix server corrupts its shuffled rows AFTER proving "
+        "(the EGTPU_MIX_TAMPER drill, registry form)",
+        expect=("mix.binding", "mix.reencryption", "mix.permutation"),
+        targets=_MIXERS,
+        rules=((MIX_TAMPER_METHOD, "behavior", None, False),),
+    ),
+    Attack(
+        "mix_swap_commitments",
+        "mix server reorders its permutation commitments — a colluded "
+        "transcript over a different permutation than it shuffled",
+        expect=("mix.binding", "mix.permutation", "mix.reencryption",
+                "mix.chain", "mix.membership", "mix.structure"),
+        targets=_MIXERS,
+        rules=(("shuffleStage", "mutate_response",
+                _mut_swap_commitments, False),),
+    ),
+    Attack(
+        "mix_replay_transcript",
+        "mix server answers a stage request with a previous stage's "
+        "full transcript (result AND rows)",
+        # the stage-binding checks (replay/transfer/input_mismatch)
+        # catch a replay against the wrong stage; a replay of a
+        # transcript another attack poisoned instead fails stage
+        # verification, so the whole verify family counts as detection
+        expect=("mix.replay", "mix.transfer", "mix.input_mismatch",
+                "mix.binding", "mix.reencryption", "mix.permutation",
+                "mix.chain", "mix.structure"),
+        targets=("",),
+        rules=(("shuffleStage", "replay_response", None, False),
+               ("pullRows", "replay_response", None, False)),
+        nth_range=(2, 2),
+    ),
+    Attack(
+        "client_malformed_ballot",
+        "client submits a ballot naming a selection outside the "
+        "manifest",
+        expect=("serve.invalid_ballot",),
+        targets=_VOTERS,
+        rules=(("encryptBallot", "mutate_request",
+                _mut_malformed_ballot, False),),
+        nth_range=(1, 2),
+    ),
+    Attack(
+        "client_duplicate_ballot",
+        "client submits the same ballot twice (forged duplicate "
+        "delivery of an honest submission)",
+        expect=("serve.duplicate_ballot",),
+        targets=_VOTERS,
+        rules=(("encryptBallot", "forge_dup", None, False),),
+        nth_range=(1, 2),
+    ),
+    Attack(
+        "client_stale_nonce",
+        "stale/forged re-registration under an existing guardian id "
+        "with a different nonce",
+        expect=("rpc.stale_registration",),
+        targets=_GUARDIANS,
+        rules=(("registerTrustee", "forge_dup",
+                _mut_stale_nonce, False),),
+    ),
+    Attack(
+        "adv_noop",
+        "planted undetectable no-op (test-only): proves the soundness "
+        "oracle fires",
+        expect=(),
+        targets=("",),
+        # mounted on sendPublicKeys, not finish: a sim node stops
+        # serving once it handles finish, so finish's first response
+        # always dies in flight and a response-side firing there would
+        # be (correctly) discarded by the delivery scope
+        rules=(("sendPublicKeys", "mutate_response", _mut_noop, False),),
+        in_corpus=False,
+    ),
+)
+
+REGISTRY: dict[str, Attack] = {a.name: a for a in ATTACKS}
+
+
+def corpus() -> tuple[Attack, ...]:
+    return tuple(a for a in ATTACKS if a.in_corpus)
+
+
+def expected_for(attack_name: str) -> set[str]:
+    a = REGISTRY.get(attack_name)
+    return set(a.expect) if a is not None else set()
+
+
+def build(attack_name: str, node: str, nth: int) -> tuple[AdvRule, ...]:
+    """Instantiate one attack's rules against ``node`` at call ``nth``
+    (rules templated ``every=True`` fire on all of the node's calls)."""
+    a = REGISTRY[attack_name]
+    return tuple(
+        AdvRule(a.name, method, kind,
+                on_calls=() if every else (nth,),
+                node=node, mutate=mutate)
+        for method, kind, mutate, every in a.rules)
+
+
+def plan_from_events(items) -> AdversaryPlan:
+    """An :class:`AdversaryPlan` from ``(attack, node, nth)`` triples
+    (schedule events).  Duplicate MOUNTS are dropped, not just
+    duplicate events: several attacks share involutive mutators (e.g.
+    kc_bad_challenge embeds kc_bad_share_mac's share flip), so two
+    attacks mounting the same (method, kind, node, calls, mutator)
+    would cancel each other — composing them must yield the stronger
+    attack instead."""
+    rules: list[AdvRule] = []
+    seen = set()
+    for name, node, nth in items:
+        if name not in REGISTRY:
+            continue
+        for rule in build(name, node, nth):
+            key = (rule.method, rule.kind, rule.node, rule.on_calls,
+                   rule.mutate)
+            if key in seen:
+                continue
+            seen.add(key)
+            rules.append(rule)
+    return AdversaryPlan(rules)
+
+
+# ------------------------------------------------------------ mounting
+
+
+def wrap_server_impl(method: str, fn):
+    """Server-side mount point (rpc_util.generic_service, via the
+    ``_adversary_wrap`` seam).  Consulted at server-construction time;
+    returns ``fn`` unchanged unless the active plan targets it."""
+    plan = active_plan()
+    if plan is None or not plan.has_rules("server", method):
+        return fn
+
+    def adversarial(request, context):
+        node = plan.current_node()
+        hits = plan.firing("server", method, node)
+        replay = next((h for h in hits
+                       if h[0].kind == "replay_response"), None)
+        if replay is not None:
+            cached = plan.captured(method)
+            if cached is not None:
+                rule, n = replay
+                plan.record_fired_response(rule, n, node)
+                return cached
+        resp = fn(request, context)
+        if plan.wants_capture(method):
+            plan.capture(method, resp)
+        for rule, n in hits:
+            if rule.kind == "mutate_response" and rule.mutate is not None:
+                if rule.mutate(resp):
+                    plan.record_fired_response(rule, n, node)
+        return resp
+
+    return adversarial
+
+
+def mix_tamper_fires(server_id: str) -> bool:
+    """Behavior mount point: the mixfed server asks, once per shuffled
+    stage, whether THIS server tampers with THIS stage's output."""
+    plan = active_plan()
+    if plan is None or not plan.has_rules("server", MIX_TAMPER_METHOD):
+        return False
+    fired = False
+    for rule, n in plan.firing("server", MIX_TAMPER_METHOD, server_id):
+        if rule.kind == "behavior":
+            plan.record_fired(rule, n, server_id)
+            fired = True
+    return fired
+
+
+# late-binding seam: honest processes that never import this module
+# never consult it; any process that CAN host an adversary (the sim, or
+# a mixfed server with EGTPU_MIX_TAMPER set) imports it and thereby
+# mounts the server-side hook
+rpc_util._adversary_wrap = wrap_server_impl

@@ -94,6 +94,10 @@ class SimOutcome:
     liveness_error: str = ""
     workflow_error: str = ""
     task_errors: list = field(default_factory=list)
+    # adversary audit (soundness oracle): attacks that reached the wire
+    # and the named in-band rejections the defenses recorded
+    fired: list = field(default_factory=list)
+    detections: list = field(default_factory=list)
 
 
 class _MemStream:
@@ -233,6 +237,11 @@ def drive(cfg: SimConfig, sched, transport, plan, schedule, seed: int,
                             # committed and recorded — that IS the ack
                             out.acked[b.ballot_id] = None
                             break
+                        if "[serve.invalid_ballot]" in str(e):
+                            # an adversary mangled this submission and
+                            # admission refused it in-band; the honest
+                            # voter resubmits the real ballot
+                            continue
                         raise
                     except grpc.RpcError:
                         if attempt == 3:

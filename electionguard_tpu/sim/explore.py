@@ -2,12 +2,15 @@
 
 ``run_sim(seed)`` derives everything from the seed — the fault schedule
 (stream 1), the scheduler's interleaving choices (stream 2), the
-network's per-message delays (stream 3), and the retry-backoff jitter
-(stream 4) — installs the virtual clock, the in-memory transport, and
-the fault plan, drives the full workflow, and checks every oracle.  The
-same seed replays the same execution bit-for-bit, attested by the
-sha256 event-trace hash in the report; ``schedule=`` overrides the
-generated fault schedule (replay of a shrunk repro).
+network's per-message delays (stream 3), the retry-backoff jitter
+(stream 4), and with ``adversaries=True`` the in-protocol attack draws
+(stream 5, isolated so an adversary run perturbs none of the honest
+streams) — installs the virtual clock, the in-memory transport, the
+fault plan, and the adversary plan, drives the full workflow, and
+checks every oracle including soundness.  The same seed replays the
+same execution bit-for-bit, attested by the sha256 event-trace hash in
+the report; ``schedule=`` overrides the generated schedule (replay of a
+shrunk repro — adversary events ride in the same list).
 
 ``explore(seeds)`` sweeps; the CLI wrapper is ``tools/sim_matrix.py``.
 """
@@ -21,14 +24,14 @@ from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 from electionguard_tpu.remote import rpc_util
-from electionguard_tpu.sim import cluster, oracle
+from electionguard_tpu.sim import adversary, cluster, oracle
 from electionguard_tpu.sim import schedule as schedule_mod
 from electionguard_tpu.sim.scheduler import (SimClock, SimDeadlock,
                                              SimHorizon, SimScheduler)
 from electionguard_tpu.sim.transport import SimTransport
 from electionguard_tpu.testing import faults
 from electionguard_tpu.utils import clock as clock_mod
-from electionguard_tpu.utils import knobs
+from electionguard_tpu.utils import errors, knobs
 
 
 @dataclass
@@ -42,6 +45,11 @@ class SimReport:
     virtual_s: float
     schedule: list[schedule_mod.FaultEvent]
     injected: list[tuple] = field(default_factory=list)
+    #: adversary audit: every attack that actually reached the wire
+    #: (attack, method, call_n, node) and every in-band detection
+    #: (class, detail) the defenses recorded for the run
+    fired: list[tuple] = field(default_factory=list)
+    detections: list[tuple] = field(default_factory=list)
 
     def schedule_json(self) -> str:
         return schedule_mod.to_json(self.schedule)
@@ -49,7 +57,8 @@ class SimReport:
     def summary(self) -> str:
         state = "ok" if self.ok else "FAIL"
         return (f"seed={self.seed} {state} events={self.events} "
-                f"t={self.virtual_s:.1f}s faults={len(self.schedule)}"
+                f"t={self.virtual_s:.1f}s faults={len(self.schedule)} "
+                f"attacks={len(self.fired)}"
                 + ("" if self.ok else f" violations={self.violations}"))
 
 
@@ -61,24 +70,35 @@ def _stream(seed: int, k: int) -> random.Random:
 def run_sim(seed: int,
             schedule: Optional[list[schedule_mod.FaultEvent]] = None,
             plant: Sequence[str] = (),
-            config: Optional[cluster.SimConfig] = None) -> SimReport:
+            config: Optional[cluster.SimConfig] = None,
+            adversaries: bool = False) -> SimReport:
     """One deterministic run of the full virtual-cluster workflow."""
     cfg = config or cluster.SimConfig()
     if schedule is None:
         schedule = schedule_mod.generate_schedule(_stream(seed, 1))
+        if adversaries:
+            schedule = schedule + schedule_mod.generate_adversary_schedule(
+                _stream(seed, 5))
     sched = SimScheduler(seed=seed * 8 + 2, horizon=cfg.horizon)
     net = schedule_mod.net_model(schedule, _stream(seed, 3))
     transport = SimTransport(sched, net)
     plan = schedule_mod.to_fault_plan(schedule)
     plan.crash_cb = transport.crash_current_server
+    adv_plan = schedule_mod.to_adversary_plan(schedule)
+    adv_plan.node_fn = transport.current_node
     backoff = _stream(seed, 4)
     out = cluster.SimOutcome()
     workdir = tempfile.mkdtemp(prefix="egtpu-sim-")
+
+    def _on_reject(cls: str, detail: str) -> None:
+        out.detections.append((cls, detail))
 
     prev_uniform = rpc_util._uniform
     clock_mod.install(SimClock(sched))
     rpc_util.set_transport(transport)
     faults.install(plan)
+    adversary.install(adv_plan)
+    errors.listen(_on_reject)
     rpc_util._uniform = backoff.uniform   # backoff jitter must replay too
     try:
         sched.run(lambda: cluster.drive(cfg, sched, transport, plan,
@@ -90,24 +110,31 @@ def run_sim(seed: int,
         out.workflow_error = repr(e)
     finally:
         rpc_util._uniform = prev_uniform
+        errors.unlisten(_on_reject)
+        adversary.clear()
         faults.clear()
         rpc_util.set_transport(None)
         clock_mod.uninstall()
         shutil.rmtree(workdir, ignore_errors=True)
     out.task_errors = sched.task_errors()
+    out.fired = list(adv_plan.fired)
     violations = oracle.check(out)
     return SimReport(seed=seed, ok=not violations, violations=violations,
                      trace_hash=sched.trace_hash(),
                      events=len(sched.trace), virtual_s=sched.now,
                      schedule=list(schedule),
-                     injected=list(plan.injected))
+                     injected=list(plan.injected),
+                     fired=list(out.fired),
+                     detections=list(out.detections))
 
 
 def explore(seeds: Sequence[int],
             config: Optional[cluster.SimConfig] = None,
-            plant: Sequence[str] = ()) -> list[SimReport]:
+            plant: Sequence[str] = (),
+            adversaries: bool = False) -> list[SimReport]:
     """Run every seed; returns all reports (callers filter failures)."""
-    return [run_sim(s, config=config, plant=plant) for s in seeds]
+    return [run_sim(s, config=config, plant=plant,
+                    adversaries=adversaries) for s in seeds]
 
 
 def default_seeds() -> list[int]:

@@ -17,30 +17,95 @@ the class.
   ``navailable`` guardians with the rest compensated;
 * ``liveness``         — the workflow ran to completion inside the
   virtual-time horizon with no deadlock and no task crash (reported by
-  the run framework via ``liveness_error`` / ``workflow_error``).
+  the run framework via ``liveness_error`` / ``workflow_error``);
+* ``soundness``        — every in-protocol attack that actually fired
+  (``outcome.fired``, the adversary plan's audit log) was DETECTED: an
+  in-band rejection carrying one of the attack's expected named error
+  classes (``utils.errors``), an abort whose error text carries one, or
+  a red verifier check in the attack's family.  A run that stays green
+  with an undetected attack — tampering yielded a clean record — is the
+  violation this oracle exists for.
+
+An abort is a *sound* outcome under attack: when the run ended early
+and some error text names an expected class of a fired attack, the
+abort IS the in-band rejection, so the liveness violations are
+suppressed for that run (the soundness oracle still checks every other
+fired attack was detected too).
 """
 
 from __future__ import annotations
+
+from electionguard_tpu.sim import adversary
+from electionguard_tpu.utils import errors
 
 
 def check(outcome) -> list[str]:
     """All oracle violations for one run's :class:`~electionguard_tpu.
     sim.cluster.SimOutcome` (empty = the run is green)."""
     v: list[str] = []
-    if outcome.liveness_error:
-        v.append(f"liveness: {outcome.liveness_error}")
-    if outcome.workflow_error:
-        v.append(f"liveness: workflow failed: {outcome.workflow_error}")
-    for name, err in outcome.task_errors:
-        v.append(f"liveness: task {name} crashed: {err!r}")
+    detections = _detections(outcome)
+    expected = set()
+    for attack, _method, _n, _node in getattr(outcome, "fired", ()):
+        expected |= adversary.expected_for(attack)
+    sound_abort = (not outcome.completed
+                   and bool(_error_classes(outcome) & expected))
+    if not sound_abort:
+        if outcome.liveness_error:
+            v.append(f"liveness: {outcome.liveness_error}")
+        if outcome.workflow_error:
+            v.append(f"liveness: workflow failed: "
+                     f"{outcome.workflow_error}")
+        for name, err in outcome.task_errors:
+            v.append(f"liveness: task {name} crashed: {err!r}")
     if not outcome.completed:
-        if not v:
+        if not v and not sound_abort:
             v.append("liveness: run ended before the workflow completed")
+        v.extend(_soundness(outcome, detections))
         return v  # downstream oracles need the full artifacts
     v.extend(_no_ballot_lost(outcome))
     v.extend(_chain_contiguous(outcome))
     v.extend(_verifier_green(outcome))
     v.extend(_quorum_tally(outcome))
+    v.extend(_soundness(outcome, detections))
+    return v
+
+
+def _error_classes(o) -> set[str]:
+    texts = [o.liveness_error, o.workflow_error]
+    texts += [str(err) for _name, err in o.task_errors]
+    return errors.classes_over(texts)
+
+
+def _detections(o) -> set[str]:
+    """Every detection class visible for a run: the in-band rejection
+    log, class tokens embedded in abort/task error texts, and red
+    verifier checks (``V15.mix_binding`` contributes both
+    ``verify.mix_binding`` and the in-band form ``mix.binding``)."""
+    seen = {cls for cls, _detail in getattr(o, "detections", ())}
+    seen |= _error_classes(o)
+    vr = o.verify_result
+    if vr is not None:
+        for name, ok in vr.checks.items():
+            if ok:
+                continue
+            last = name.split(".")[-1]
+            seen.add(f"verify.{last}")
+            if last.startswith("mix_"):
+                seen.add("mix." + last[4:])
+    return seen
+
+
+def _soundness(o, detections: set[str]) -> list[str]:
+    v = []
+    for attack, method, n, node in getattr(o, "fired", ()):
+        expect = adversary.expected_for(attack)
+        if expect & detections:
+            continue
+        where = f" on {node}" if node else ""
+        v.append(f"soundness: attack {attack} fired{where} "
+                 f"({method} call {n}) and was never detected — "
+                 f"expected one of {sorted(expect) or ['<nothing>']}, "
+                 f"saw {sorted(detections)}")
     return v
 
 

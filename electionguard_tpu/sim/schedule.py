@@ -26,8 +26,10 @@ from __future__ import annotations
 import json
 from dataclasses import asdict, dataclass
 
+from electionguard_tpu.sim import adversary
 from electionguard_tpu.sim.transport import NetModel, Partition
 from electionguard_tpu.testing.faults import FaultPlan, FaultRule
+from electionguard_tpu.utils import knobs
 
 # rpcs whose response can be dropped after the state change commits:
 # each has an explicit idempotent-replay path (registration nonces,
@@ -72,6 +74,8 @@ class FaultEvent:
     * ``partition``      — a, b, t0, seconds (duration)
     * ``duplicate``      — seconds (delivery-duplication probability)
     * ``conn_death``     — nth (global message index that dies in flight)
+    * ``adversary``      — method (= attack name from sim/adversary.py),
+      a (target node, '' = any), nth (firing call index)
     """
     kind: str
     method: str = ""
@@ -130,6 +134,41 @@ def generate_schedule(rng) -> list[FaultEvent]:
             events.append(FaultEvent(
                 "conn_death", nth=rng.randint(5, 80)))
     return events
+
+
+def generate_adversary_schedule(rng) -> list[FaultEvent]:
+    """Draw 1–EGTPU_SIM_ADV_MAX in-protocol attacks from ``rng`` (its
+    own isolated stream, so adding adversaries never perturbs the fault
+    or scheduler draws of the same seed).  Unlike faults, a schedule
+    always carries at least one attack — an adversary sweep where some
+    seeds are honest would dilute the soundness claim."""
+    try:
+        cap = max(1, knobs.get_int("EGTPU_SIM_ADV_MAX"))
+    except ValueError:
+        cap = 2
+    corpus = adversary.corpus()
+    events: list[FaultEvent] = []
+    seen = set()
+    for _ in range(rng.randint(1, cap)):
+        atk = corpus[rng.randrange(len(corpus))]
+        node = atk.targets[rng.randrange(len(atk.targets))]
+        nth = rng.randint(*atk.nth_range)
+        key = (atk.name, node, nth)
+        if key in seen:
+            continue
+        seen.add(key)
+        events.append(FaultEvent("adversary", method=atk.name, nth=nth,
+                                 a=node))
+    return events
+
+
+def to_adversary_plan(events: list[FaultEvent]):
+    """The adversary slice of a schedule as an
+    :class:`~electionguard_tpu.sim.adversary.AdversaryPlan` (empty plan
+    when the schedule carries no attacks, so the caller can install it
+    unconditionally)."""
+    return adversary.plan_from_events(
+        [(e.method, e.a, e.nth) for e in events if e.kind == "adversary"])
 
 
 def to_fault_plan(events: list[FaultEvent]) -> FaultPlan:

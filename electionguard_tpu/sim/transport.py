@@ -36,6 +36,7 @@ from typing import Optional
 import grpc
 
 from electionguard_tpu.sim.scheduler import SimScheduler
+from electionguard_tpu.sim import adversary
 from electionguard_tpu.testing import faults
 
 _HCD = namedtuple("_HCD", ("method", "invocation_metadata"))
@@ -272,6 +273,8 @@ class _SimMulticallable:
         method = self.path.rsplit("/", 1)[-1]
         src = tr.current_node()
         port = int(self.channel.url.rsplit(":", 1)[-1])
+        adv = None
+        adv_pending, adv_forged = [], []
         if not self.channel.plain:
             plan = faults.active_plan()
             if plan is not None:
@@ -302,25 +305,74 @@ class _SimMulticallable:
             raise SimRpcError(grpc.StatusCode.UNAVAILABLE,
                               f"connection to {self.channel.url} died "
                               f"in flight")
+        if not self.channel.plain:
+            adv = adversary.active_plan()
+        if adv is not None and adv.has_rules("client", method):
+            # client-side adversaries, applied only once the
+            # connection checks passed so rule call-counters index
+            # requests that actually reach the wire (an attempt that
+            # died unreachable must not consume the firing index).
+            # Mutations edit a COPY (Stub retries reuse the same
+            # request object — poisoning it would corrupt the honest
+            # retry); forged duplicates queue for dispatch after the
+            # real one.
+            request, adv_pending, adv_forged = adv.apply_client(
+                method, src, request)
         request_bytes = self.ser(request)
-        response_bytes = tr.dispatch(port, self.path, request_bytes,
-                                     method, src)
-        if net.duplicate():
-            # at-least-once delivery: the peer processes the message
-            # again; the duplicate's response is discarded
-            sched.event("dup-delivery", f"{src}->{port} {method}")
-            try:
-                tr.dispatch(port, self.path, request_bytes, method, src)
-            except SimRpcError:
-                pass
-        sched.sleep(net.delay())                     # response in flight
-        if sched.now > deadline:
-            raise SimRpcError(grpc.StatusCode.DEADLINE_EXCEEDED,
-                              f"{method} deadline exceeded in transit")
-        if not reach():
-            raise SimRpcError(grpc.StatusCode.UNAVAILABLE,
-                              f"connection to {self.channel.url} lost "
-                              f"before response")
+        # delivery scope: response-side misbehaviors (mutated/replayed
+        # responses) count as fired only if this response actually
+        # reaches the client — one that dies in flight was never seen
+        # by any defense, and the honest retry supersedes it
+        tok = adv.begin_delivery() if adv is not None else None
+        delivered = False
+        try:
+            response_bytes = tr.dispatch(port, self.path, request_bytes,
+                                         method, src)
+            for rule, n in adv_pending:
+                # durable: the mutated request reached its handler
+                adv.record_fired(rule, n, src)
+            for rule, n, forged in adv_forged:
+                # forged duplicate/replayed submission: its response is
+                # discarded by the attacker (nested scope, never
+                # committed), but the REQUEST reaching the handler is a
+                # durable firing
+                sched.event("adversary", f"{src}->{port} forged {method}")
+                ftok = adv.begin_delivery()
+                try:
+                    tr.dispatch(port, self.path, self.ser(forged),
+                                method, src)
+                    adv.record_fired(rule, n, src)
+                except SimRpcError:
+                    pass
+                finally:
+                    adv.end_delivery(ftok, False)
+            if net.duplicate():
+                # at-least-once delivery: the peer processes the message
+                # again; the duplicate's response is discarded
+                sched.event("dup-delivery", f"{src}->{port} {method}")
+                dtok = (adv.begin_delivery() if adv is not None
+                        else None)
+                try:
+                    tr.dispatch(port, self.path, request_bytes, method,
+                                src)
+                except SimRpcError:
+                    pass
+                finally:
+                    if adv is not None:
+                        adv.end_delivery(dtok, False)
+            sched.sleep(net.delay())                 # response in flight
+            if sched.now > deadline:
+                raise SimRpcError(grpc.StatusCode.DEADLINE_EXCEEDED,
+                                  f"{method} deadline exceeded in "
+                                  f"transit")
+            if not reach():
+                raise SimRpcError(grpc.StatusCode.UNAVAILABLE,
+                                  f"connection to {self.channel.url} "
+                                  f"lost before response")
+            delivered = True
+        finally:
+            if adv is not None:
+                adv.end_delivery(tok, delivered)
         return self.deser(response_bytes)
 
 

@@ -1,0 +1,67 @@
+"""Named error classes for in-band rejections.
+
+Every rejection path a Byzantine adversary can trigger carries a stable
+machine-matchable class token of the form ``[plane.reason]`` embedded in
+the human-readable error string (``named``), so the sim's soundness
+oracle can assert *which* defense fired without string-matching prose
+(``classes_in`` extracts the tokens back out of any error text).
+
+Rejections that are *contained* — the protocol recovers in-band and the
+run stays green (a challenged key share, a requeued mix stage, a
+discarded duplicate ballot) — never surface in an error string at all,
+so containment sites additionally call ``reject`` which fans out to
+registered listeners.  The sim mounts a listener per run to collect
+these detections; outside the sim the list is empty and ``reject`` is a
+cheap no-op.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Callable, Iterable
+
+_CLASS_RE = re.compile(r"\[([a-z][a-z0-9_]*\.[a-z][a-z0-9_]*)\]")
+
+_lock = threading.Lock()
+_listeners: list[Callable[[str, str], None]] = []
+
+
+def named(cls: str, msg: str) -> str:
+    """Prefix ``msg`` with the class token ``[cls]``."""
+    return f"[{cls}] {msg}"
+
+
+def classes_in(text: str) -> set[str]:
+    """All ``[plane.reason]`` class tokens embedded in ``text``."""
+    return set(_CLASS_RE.findall(text or ""))
+
+
+def listen(cb: Callable[[str, str], None]) -> None:
+    with _lock:
+        _listeners.append(cb)
+
+
+def unlisten(cb: Callable[[str, str], None]) -> None:
+    with _lock:
+        if cb in _listeners:
+            _listeners.remove(cb)
+
+
+def reject(cls: str, detail: str = "") -> None:
+    """Record an in-band rejection (detection) with class ``cls``.
+
+    Called at every site that *contains* a malicious input — listeners
+    (the sim's detection log) see it even when no error string ever
+    reaches the workflow."""
+    with _lock:
+        cbs = list(_listeners)
+    for cb in cbs:
+        cb(cls, detail)
+
+
+def classes_over(texts: Iterable[str]) -> set[str]:
+    out: set[str] = set()
+    for t in texts:
+        out |= classes_in(t)
+    return out

@@ -98,8 +98,11 @@ KNOBS: tuple[Knob, ...] = (
          "Mix-server row-axis shard count; 0 = single device "
          "(mixfed/server)."),
     Knob("EGTPU_MIX_TAMPER", "flag", None,
-         "Test hook: tamper with one mix stage's output so verification "
-         "must catch it (mixfed/server)."),
+         "Drill hook: mounts the mix_tamper_output adversary "
+         "(sim/adversary registry) so one mix stage's output is "
+         "corrupted after proving and verification must catch it; "
+         "1 = any server, any other value = that server id "
+         "(mixfed/server)."),
     Knob("EGTPU_NUM_PROCESSES", "int", None,
          "jax.distributed process count (parallel/distributed)."),
     Knob("EGTPU_OBS_COLLECTOR", "str", "",
@@ -160,6 +163,12 @@ KNOBS: tuple[Knob, ...] = (
     Knob("EGTPU_SHA_DEVICE_MIN", "int", "65536",
          "Min rows before the ballot-code SHA batch runs on the device "
          "(ballot/code_batch)."),
+    Knob("EGTPU_SIM_ADV_MAX", "int", "2",
+         "Max in-protocol attacks drawn per adversary schedule (always "
+         "at least one; sim/schedule)."),
+    Knob("EGTPU_SIM_ADV_SEEDS", "int", "200",
+         "Seed count of the default adversary sweep "
+         "(tools/sim_matrix --adversaries)."),
     Knob("EGTPU_SIM_HORIZON", "float", "600.0",
          "Virtual-time horizon for one deterministic simulation run, "
          "seconds; exceeding it is a liveness violation (sim/cluster)."),

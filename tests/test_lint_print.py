@@ -28,10 +28,13 @@ def test_walk_covers_new_packages_and_obs_modules():
         if len(parts) > 1:
             tops.add(parts[0])
         rels.add("/".join(parts))
-    assert {"mixnet", "mixfed", "obs", "serve", "fabric"} <= tops
+    assert {"mixnet", "mixfed", "obs", "serve", "fabric", "sim"} <= tops
     assert {"obs/collector.py", "obs/slo.py", "obs/assemble.py"} <= rels
     # the Pallas kernel package (its bodies feed the jit-hygiene pass)
     assert {"core/pallas/__init__.py", "core/pallas/engine.py"} <= rels
+    # the Byzantine adversary plane (the corpus and the named-error
+    # registry its soundness oracle matches on)
+    assert {"sim/adversary.py", "utils/errors.py"} <= rels
 
 
 def test_no_bare_print_in_library_code():

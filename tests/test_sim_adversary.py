@@ -1,0 +1,284 @@
+"""Byzantine adversary corpus: planted-attack detection, soundness
+oracle sensitivity, shrinker minimality over adversary events, and the
+pinned mixed attack+fault sweep.
+
+Mirror of test_sim.py's structure for the malice dimension: every named
+in-protocol attack in ``sim/adversary.py`` is planted individually and
+must be detected in-band with one of its expected named error classes
+(``utils/errors.py``) or by the verifier; the planted ``adv_noop``
+attack (fires, changes nothing, detectable by nothing) proves the
+soundness oracle itself is live.  ``tools/sim_matrix.py --adversaries``
+runs the wide sweep and records it in SIM_BYZ_RESULTS.json.
+"""
+
+import pytest
+
+from electionguard_tpu.sim import adversary
+from electionguard_tpu.sim.explore import explore, run_sim
+from electionguard_tpu.sim.schedule import (FaultEvent, from_json,
+                                            generate_adversary_schedule,
+                                            to_adversary_plan, to_json)
+from electionguard_tpu.sim.shrink import shrink
+
+
+def _adv(name: str, node: str = "", nth: int = 1) -> FaultEvent:
+    return FaultEvent("adversary", method=name, nth=nth, a=node)
+
+
+def _classes(report):
+    return {v.split(":", 1)[0] for v in report.violations}
+
+
+def _detected(report):
+    return {cls for cls, _detail in report.detections}
+
+
+# ------------------------------------------------------------- registry
+
+def test_registry_invariants():
+    """Every corpus attack is detectable by construction: a non-empty
+    expect set, concrete targets, and rules that instantiate."""
+    corpus = adversary.corpus()
+    assert len(corpus) >= 8, "ISSUE floor: at least 8 named attacks"
+    sides = set()
+    for atk in corpus:
+        assert atk.expect, f"{atk.name} has no expected detection class"
+        assert atk.targets, f"{atk.name} has no targets"
+        lo, hi = atk.nth_range
+        assert 1 <= lo <= hi
+        rules = adversary.build(atk.name, atk.targets[0], lo)
+        assert rules
+        sides |= {r.side for r in rules}
+    # the corpus spans both mount sides: server (trustees, mixers) and
+    # client (voters, registrations)
+    assert sides == {"client", "server"}
+    # adv_noop is the planted oracle probe, never drawn into sweeps
+    assert "adv_noop" not in {a.name for a in corpus}
+    assert "adv_noop" in adversary.REGISTRY
+
+
+def test_plan_from_events_dedupes_involutive_mounts():
+    """Mounting the same (attack, node, nth) twice must not cancel the
+    involutive mutators — duplicates are dropped."""
+    plan = adversary.plan_from_events(
+        [("kc_bad_schnorr", "guardian-0", 1),
+         ("kc_bad_schnorr", "guardian-0", 1),
+         ("not_a_real_attack", "guardian-0", 1)])
+    assert len(plan.rules) == 1
+
+
+def test_adversary_schedule_generation_is_stream_isolated():
+    import random
+    s1 = generate_adversary_schedule(random.Random(9))
+    s2 = generate_adversary_schedule(random.Random(9))
+    assert s1 == s2 and s1
+    assert all(e.kind == "adversary" for e in s1)
+    assert from_json(to_json(s1)) == s1
+    plan = to_adversary_plan(s1)
+    assert plan.rules
+
+
+def test_mix_tamper_env_alias_mounts_registry_attack(monkeypatch):
+    """EGTPU_MIX_TAMPER is a thin alias over the registry: the env knob
+    mounts mix_tamper_output (any server for '1', one server for an
+    id), through the same lazy plan the sim installs explicitly."""
+    monkeypatch.setenv("EGTPU_MIX_TAMPER", "mix-1")
+    monkeypatch.setattr(adversary, "_loaded_env", False)
+    monkeypatch.setattr(adversary, "_active", None)
+    try:
+        plan = adversary.active_plan()
+        assert plan is not None
+        (rule,) = plan.rules
+        assert rule.attack == "mix_tamper_output"
+        assert rule.node == "mix-1"
+        assert not adversary.mix_tamper_fires("mix-0")
+        assert adversary.mix_tamper_fires("mix-1")
+        assert plan.fired and plan.fired[0][0] == "mix_tamper_output"
+    finally:
+        adversary.clear()
+
+
+# ----------------------------------------------- planted attacks (one each)
+# Each corpus attack planted alone at a known-firing (node, nth): it
+# must actually fire AND be detected with one of its expected classes,
+# with the run either completing green or sound-aborting — never a
+# soundness violation, never an unexplained failure.
+
+PLANTS = [
+    ("kc_bad_schnorr", "guardian-0", 1),
+    ("kc_equivocate", "guardian-1", 1),
+    ("kc_bad_share_mac", "guardian-0", 1),
+    ("kc_bad_challenge", "guardian-2", 1),
+    ("mix_tamper_output", "mix-0", 1),
+    ("mix_swap_commitments", "mix-0", 1),
+    ("mix_replay_transcript", "", 2),
+    ("client_malformed_ballot", "voter-0", 1),
+    ("client_duplicate_ballot", "voter-0", 1),
+    ("client_stale_nonce", "guardian-0", 1),
+]
+
+
+def test_plants_cover_the_whole_corpus():
+    assert {p[0] for p in PLANTS} == {a.name for a in adversary.corpus()}
+
+
+@pytest.mark.parametrize("name,node,nth", PLANTS,
+                         ids=[p[0] for p in PLANTS])
+def test_planted_attack_is_detected(name, node, nth):
+    r = run_sim(3, schedule=[_adv(name, node, nth)])
+    assert r.fired, f"{name} never fired — stale (node, nth) plant"
+    assert all(f[0] == name for f in r.fired)
+    assert adversary.expected_for(name) & _detected(r), (
+        f"{name} fired but no expected class in {sorted(_detected(r))}")
+    assert r.ok, r.summary()
+
+
+def test_honest_run_records_no_attacks():
+    """adversaries=False is byte-identical honest behavior: nothing
+    fires, and the adversary plumbing adds no detections of its own."""
+    r = run_sim(0)
+    assert r.ok
+    assert r.fired == []
+
+
+# ------------------------------------------------------ soundness oracle
+
+def test_soundness_oracle_fires_on_undetected_attack():
+    """adv_noop fires (audit log) but mutates nothing, so no defense
+    can see it: the exact green-undetected record the soundness oracle
+    exists to catch."""
+    r = run_sim(3, schedule=[_adv("adv_noop")])
+    assert r.fired and r.fired[0][0] == "adv_noop"
+    assert not r.ok
+    assert _classes(r) == {"soundness"}
+    assert any("attack adv_noop fired" in v and "never detected" in v
+               for v in r.violations)
+
+
+def test_detected_attack_raises_no_soundness_violation():
+    """The converse: a detected attack contributes no violation even
+    though it fired (detection set intersects the expect set)."""
+    r = run_sim(3, schedule=[_adv("client_malformed_ballot", "voter-0")])
+    assert r.fired
+    assert "soundness" not in _classes(r)
+
+
+# ------------------------------------------------------------- shrinking
+
+ADV_NOOP = _adv("adv_noop")
+
+NOISE = [
+    FaultEvent("latency", method="pullRows", nth=1, seconds=0.2),
+    FaultEvent("unavailable", method="sendPublicKeys", nth=1),
+    FaultEvent("duplicate", seconds=0.02),
+    FaultEvent("adversary", method="client_malformed_ballot", nth=1,
+               a="voter-0"),   # detected attack: removable noise
+]
+
+
+def test_shrinker_minimizes_adversary_events():
+    """ddmin + greedy strips the fault noise AND the detected attack:
+    the minimal repro for the planted soundness violation is the single
+    undetectable adversary event."""
+    padded = NOISE[:2] + [ADV_NOOP] + NOISE[2:]
+    res = shrink(3, padded)
+    assert res.schedule == [ADV_NOOP]
+    assert not res.exhausted
+    assert any(v.startswith("soundness") for v in res.violations)
+    assert from_json(res.repro_json()) == [ADV_NOOP]
+
+
+# ------------------------------------------------------------- the sweep
+
+def test_pinned_mixed_sweep_is_green():
+    """Tier-1 Byzantine sweep: 20 pinned seeds, each composing a
+    crash/network fault schedule (stream 1) with 1-2 drawn attacks
+    (stream 5).  Every run must be green — detected attacks, sound
+    aborts — with zero soundness violations, and the corpus must
+    actually exercise several distinct attacks."""
+    reports = explore(range(20), adversaries=True)
+    bad = [r.summary() for r in reports if not r.ok]
+    assert not bad, f"adversary sweep failures: {bad}"
+    assert all("soundness" not in _classes(r) for r in reports)
+    names = {f[0] for r in reports for f in r.fired}
+    assert len(names) >= 5, f"sweep only exercised {sorted(names)}"
+    assert sum(len(r.fired) for r in reports) >= 10
+
+
+def test_adversary_run_replays_bit_for_bit():
+    """Stream 5 is deterministic: same seed, same attacks, same trace."""
+    a = run_sim(5, adversaries=True)
+    b = run_sim(5, adversaries=True)
+    assert a.trace_hash == b.trace_hash
+    assert a.fired == b.fired
+    assert a.schedule == b.schedule
+
+
+def test_adversary_stream_does_not_perturb_honest_streams():
+    """Adding adversaries must not change which FAULTS a seed draws:
+    the fault slice of the schedule is identical with and without."""
+    honest = run_sim(9)
+    byz = run_sim(9, adversaries=True)
+    faults_only = [e for e in byz.schedule if e.kind != "adversary"]
+    assert faults_only == honest.schedule
+
+
+@pytest.mark.slow
+def test_wide_mixed_sweep_is_green():
+    """The wide Byzantine sweep (seeds 20..219); sim_matrix
+    --adversaries goes wider still and records SIM_BYZ_RESULTS.json."""
+    reports = explore(range(20, 220), adversaries=True)
+    bad = [r.summary() for r in reports if not r.ok]
+    assert not bad, f"adversary sweep failures: {bad}"
+    assert all("soundness" not in _classes(r) for r in reports)
+
+
+# ------------------------------------------------------- regression pins
+
+def test_pinned_regression_attack_exhausts_spares_soundly():
+    """Seeds 30 and 62 of the first Byzantine sweep: attack + fault
+    compositions burned every mix server (tamper/collusion evictions on
+    top of a crash or a double-target draw) and the cascade exhaustion
+    surfaced as a bare 'no registered mix server left' — a liveness red
+    even though every attack WAS detected and the tampered record was
+    never published.  Fixed by carrying the named eviction causes into
+    the exhaustion error, which makes the abort attributable to the
+    attack (a sound abort).  These seeds must stay green."""
+    for seed in (30, 62):
+        r = run_sim(seed, adversaries=True)
+        assert r.ok, r.summary()
+        assert r.fired
+
+
+def test_pinned_regression_inflight_death_is_not_fired():
+    """Seeds 115 and 175 of the 200-seed sweep, both false soundness
+    reds from audit-log fidelity bugs: on 115 a partition killed the
+    mutated sendPublicKeys response in flight — no defense ever saw the
+    bad proof, the honest retry superseded it, yet it was recorded as
+    fired; on 175 two kc attacks mounted the SAME involutive share-flip
+    mutator on one call and cancelled to a byte-identical honest share.
+    Fixed by delivery-scoped fired recording in the sim transport and
+    by deduping rule mounts (composition now yields the stronger
+    attack).  These seeds must stay green."""
+    r115 = run_sim(115, adversaries=True)
+    assert r115.ok, r115.summary()
+    # the attack's only firing chance died in flight: NOT fired
+    assert r115.fired == []
+    r175 = run_sim(175, adversaries=True)
+    assert r175.ok, r175.summary()
+    assert r175.fired
+    assert adversary.expected_for("kc_bad_challenge") & _detected(r175)
+
+
+def test_pinned_regression_replay_of_poisoned_transcript_detected():
+    """Seeds 112 and 125 of the 200-seed sweep: a replayed transcript
+    that ANOTHER mix attack had poisoned was caught by
+    verify-before-forward as mix.binding — detected and never
+    published, but outside the replay attack's expect list, so the
+    soundness oracle raised a false red.  The expect list now spans the
+    whole stage-verification family.  These seeds must stay green."""
+    for seed in (112, 125):
+        r = run_sim(seed, adversaries=True)
+        assert r.ok, r.summary()
+        names = {f[0] for f in r.fired}
+        assert "mix_replay_transcript" in names

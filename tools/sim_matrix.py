@@ -6,6 +6,7 @@ Usage::
     python tools/sim_matrix.py --seeds 20            # quick sweep
     python tools/sim_matrix.py --seeds 1000 --json   # + SIM_RESULTS.json
     python tools/sim_matrix.py --seeds 1000 --procs 8
+    python tools/sim_matrix.py --adversaries --json  # Byzantine sweep
     python tools/sim_matrix.py --replay '<schedule json>' --seed 17
 
 Each seed is one full virtual-cluster run (key ceremony → encryption
@@ -14,6 +15,15 @@ verification) under a seed-derived fault schedule, checked by every
 oracle.  Failing seeds are shrunk to minimal replayable schedules and
 recorded — ``--json`` writes the tracked SIM_RESULTS.json artifact with
 the seeds run, oracle failures, shrunk repros, and honest throughput.
+
+``--adversaries`` runs the attack × fault matrix instead: every seed
+additionally draws 1-2 named in-protocol attacks from the
+``sim/adversary.py`` corpus (stream 5, composed with the same crash /
+network fault schedules), the soundness oracle requires each fired
+attack to be detected in-band or by the verifier, and the artifact
+(default SIM_BYZ_RESULTS.json) records the per-attack fired/detected
+histogram with the detection classes seen.  A green sweep is the
+repo's zero-green-undetected claim.
 
 ``--procs N`` shards the seed range over N worker subprocesses (the
 per-seed cost is JAX dispatch-bound, so sweep throughput scales with
@@ -53,16 +63,35 @@ def _config(fast: bool):
 
 
 def _sweep(start: int, count: int, fast: bool,
-           shrink_budget: int | None) -> dict:
+           shrink_budget: int | None, adversaries: bool = False) -> dict:
     """Run seeds [start, start+count) in THIS process; shrink failures."""
+    from electionguard_tpu.sim import adversary
     from electionguard_tpu.sim.explore import run_sim
     from electionguard_tpu.sim.shrink import shrink
 
     cfg = _config(fast)
     ok = 0
     failures = []
+    attacks: dict[str, dict] = {}
+    fired_total = 0
     for seed in range(start, start + count):
-        r = run_sim(seed, config=cfg)
+        r = run_sim(seed, config=cfg, adversaries=adversaries)
+        if adversaries:
+            # per-attack detection histogram: an instance counts as
+            # detected exactly when the soundness oracle raised no
+            # violation for it (the oracle also sees abort texts and
+            # verifier reds that the reject log alone misses)
+            sound = [v for v in r.violations if v.startswith("soundness")]
+            seen = {cls for cls, _detail in r.detections}
+            for name, _method, _n, _node in r.fired:
+                fired_total += 1
+                a = attacks.setdefault(
+                    name, {"fired": 0, "detected": 0, "via": {}})
+                a["fired"] += 1
+                if not any(f"attack {name} fired" in v for v in sound):
+                    a["detected"] += 1
+                for cls in sorted(adversary.expected_for(name) & seen):
+                    a["via"][cls] = a["via"].get(cls, 0) + 1
         if r.ok:
             ok += 1
             continue
@@ -81,11 +110,13 @@ def _sweep(start: int, count: int, fast: bool,
             entry["shrink_exhausted"] = res.exhausted
         failures.append(entry)
         print(f"FAIL {r.summary()}", file=sys.stderr)
-    return {"ok": ok, "failures": failures}
+    return {"ok": ok, "failures": failures, "attacks": attacks,
+            "fired_total": fired_total}
 
 
 def _sweep_procs(start: int, count: int, procs: int, fast: bool,
-                 shrink_budget: int | None) -> dict:
+                 shrink_budget: int | None,
+                 adversaries: bool = False) -> dict:
     """Shard the range over worker subprocesses, merge their chunks."""
     per = (count + procs - 1) // procs
     jobs = []
@@ -101,10 +132,12 @@ def _sweep_procs(start: int, count: int, procs: int, fast: bool,
                "--chunk-worker", out]
         if fast:
             cmd.append("--fast")
+        if adversaries:
+            cmd.append("--adversaries")
         if shrink_budget is not None:
             cmd += ["--shrink-budget", str(shrink_budget)]
         jobs.append((subprocess.Popen(cmd), out))
-    merged = {"ok": 0, "failures": []}
+    merged = {"ok": 0, "failures": [], "attacks": {}, "fired_total": 0}
     rc = 0
     for proc, out in jobs:
         rc |= proc.wait()
@@ -112,6 +145,14 @@ def _sweep_procs(start: int, count: int, procs: int, fast: bool,
             chunk = json.load(open(out))
             merged["ok"] += chunk["ok"]
             merged["failures"].extend(chunk["failures"])
+            merged["fired_total"] += chunk.get("fired_total", 0)
+            for name, a in chunk.get("attacks", {}).items():
+                m = merged["attacks"].setdefault(
+                    name, {"fired": 0, "detected": 0, "via": {}})
+                m["fired"] += a["fired"]
+                m["detected"] += a["detected"]
+                for cls, n_cls in a["via"].items():
+                    m["via"][cls] = m["via"].get(cls, 0) + n_cls
     if rc:
         raise SystemExit(f"a sweep worker failed (exit {rc})")
     merged["failures"].sort(key=lambda f: f["seed"])
@@ -134,9 +175,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="sim_matrix", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("--seeds", type=int,
-                    default=knobs.get_int("EGTPU_SIM_SEEDS"),
-                    help="how many seeds to sweep")
+    ap.add_argument("--seeds", type=int, default=None,
+                    help="how many seeds to sweep (default "
+                         "EGTPU_SIM_SEEDS, or EGTPU_SIM_ADV_SEEDS "
+                         "under --adversaries)")
     ap.add_argument("--start", type=int,
                     default=knobs.get_int("EGTPU_SIM_SEED"),
                     help="first seed")
@@ -145,19 +187,31 @@ def main(argv=None) -> int:
     ap.add_argument("--fast", action="store_true",
                     help="1 mix stage instead of 2 (faster, less "
                          "cascade coverage)")
+    ap.add_argument("--adversaries", action="store_true",
+                    help="Byzantine sweep: compose each seed's fault "
+                         "schedule with drawn in-protocol attacks and "
+                         "check the soundness oracle")
     ap.add_argument("--shrink-budget", type=int, default=None,
                     help="probe-run cap per failing-schedule shrink")
-    ap.add_argument("--json", nargs="?", const=os.path.join(
-                        REPO_ROOT, "SIM_RESULTS.json"), default=None,
+    ap.add_argument("--json", nargs="?", const="auto", default=None,
                     metavar="PATH",
                     help="write the sweep artifact (default "
-                         "SIM_RESULTS.json at the repo root)")
+                         "SIM_RESULTS.json at the repo root, "
+                         "SIM_BYZ_RESULTS.json under --adversaries)")
     ap.add_argument("--replay", metavar="SCHEDULE_JSON", default=None,
                     help="replay one schedule under --start's seed "
                          "instead of sweeping")
     ap.add_argument("--chunk-worker", metavar="PATH", default=None,
                     help=argparse.SUPPRESS)   # internal: emit one chunk
     args = ap.parse_args(argv)
+    if args.seeds is None:
+        args.seeds = knobs.get_int("EGTPU_SIM_ADV_SEEDS"
+                                   if args.adversaries
+                                   else "EGTPU_SIM_SEEDS")
+    if args.json == "auto":
+        args.json = os.path.join(
+            REPO_ROOT, "SIM_BYZ_RESULTS.json" if args.adversaries
+            else "SIM_RESULTS.json")
 
     if args.replay is not None:
         return _replay(args.start, args.replay, args.fast)
@@ -165,16 +219,17 @@ def main(argv=None) -> int:
     t0 = time.time()
     if args.chunk_worker:
         chunk = _sweep(args.start, args.seeds, args.fast,
-                       args.shrink_budget)
+                       args.shrink_budget, args.adversaries)
         with open(args.chunk_worker, "w") as f:
             json.dump(chunk, f)
         return 0
     if args.procs > 1:
         merged = _sweep_procs(args.start, args.seeds, args.procs,
-                              args.fast, args.shrink_budget)
+                              args.fast, args.shrink_budget,
+                              args.adversaries)
     else:
         merged = _sweep(args.start, args.seeds, args.fast,
-                        args.shrink_budget)
+                        args.shrink_budget, args.adversaries)
     wall = time.time() - t0
 
     result = {
@@ -192,6 +247,24 @@ def main(argv=None) -> int:
     print(f"{merged['ok']}/{args.seeds} seeds green, "
           f"{len(merged['failures'])} failures, {wall:.1f}s "
           f"({result['schedules_per_s']} schedules/s)")
+    if args.adversaries:
+        undetected = sum(a["fired"] - a["detected"]
+                         for a in merged["attacks"].values())
+        result.update({
+            "mode": "adversaries",
+            "attacks": merged["attacks"],
+            "fired_total": merged["fired_total"],
+            "undetected_total": undetected,
+            "attacks_per_s": (round(merged["fired_total"] / wall, 2)
+                              if wall else None),
+        })
+        for name in sorted(merged["attacks"]):
+            a = merged["attacks"][name]
+            via = ", ".join(f"{c}x{n}" for c, n in sorted(a["via"].items()))
+            print(f"  {name}: fired {a['fired']}, detected "
+                  f"{a['detected']} ({via or 'abort/verifier only'})")
+        print(f"  {merged['fired_total']} attacks fired, "
+              f"{undetected} green-undetected")
     for f in merged["failures"]:
         shrunk = f.get("shrunk_schedule")
         print(f"  seed {f['seed']}: {f['violations'][0]}"
