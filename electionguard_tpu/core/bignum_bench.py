@@ -28,8 +28,9 @@ import numpy as np
 from electionguard_tpu.core import bignum_jax as bn
 
 #: ops measurable per backend; "fixed" always runs the full-width
-#: window ladder over the registered g table
-DEFAULT_OPS = ("mulmod", "powmod", "fixed")
+#: window ladder over the registered g table; "msm" times the Pippenger
+#: multi-scalar accumulation end to end (host digit prep included)
+DEFAULT_OPS = ("mulmod", "powmod", "fixed", "msm")
 
 
 def timeit(fn, *args, reps: int = 3) -> float:
@@ -99,4 +100,12 @@ def backend_rows(group, backend: str, batch: int = 64,
         E = jnp.asarray(gops.to_limbs_q(exps))
         row("fixed", timeit(gops._fixed_pow_j, gops.g_table, E,
                             reps=reps), gops.exp_bits)
+    if "msm" in ops:
+        # end-to-end (host window/digit prep + device buckets/combine):
+        # that is the cost the RLC verify plane pays per batch
+        An = np.asarray(gops.to_limbs_p(bases))
+        es = ([e % (1 << bits) for e in exps]
+              if bits != gops.exp_bits else exps)
+        row("msm", timeit(lambda: gops.msm(An, es, exp_bits=bits),
+                          reps=reps), bits)
     return rows

@@ -19,7 +19,8 @@ dominant verification workload the TPU plane batches (SURVEY.md §3.4 phase 5
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+from typing import Optional
 
 from electionguard_tpu.core.group import ElementModP, ElementModQ, GroupContext
 from electionguard_tpu.core.hash import hash_elems
@@ -70,6 +71,13 @@ class DisjunctiveChaumPedersenProof:
     proof_zero_response: ElementModQ
     proof_one_challenge: ElementModQ
     proof_one_response: ElementModQ
+    # Untrusted verification hints: the prover's commitment values
+    # (a0, b0, a1, b1) as plain ints.  Never serialized (the publish
+    # plane writes the four named fields above), excluded from
+    # equality/repr; the RLC batch verifier hash-checks them per row
+    # before use and falls back to the naive path when absent.
+    commitment_hints: Optional[tuple] = field(
+        default=None, compare=False, repr=False)
 
     def is_valid(self, ct: ElGamalCiphertext, public_key: ElementModP,
                  context: ElementModQ) -> bool:
@@ -112,7 +120,9 @@ def make_disjunctive_cp_proof(
         c = hash_elems(g, context, alpha, beta, a0, b0, a1, b1)
         c0 = g.sub_q(c, c_fake)
         v0 = g.sub_q(u, g.mult_q(c0, nonce))
-        return DisjunctiveChaumPedersenProof(c0, v0, c_fake, v_fake)
+        return DisjunctiveChaumPedersenProof(
+            c0, v0, c_fake, v_fake,
+            commitment_hints=(a0.value, b0.value, a1.value, b1.value))
     else:
         # simulated zero-branch
         a0 = g.mult_p(g.g_pow_p(v_fake), g.pow_p(alpha, c_fake))
@@ -122,7 +132,9 @@ def make_disjunctive_cp_proof(
         c = hash_elems(g, context, alpha, beta, a0, b0, a1, b1)
         c1 = g.sub_q(c, c_fake)
         v1 = g.sub_q(u, g.mult_q(c1, nonce))
-        return DisjunctiveChaumPedersenProof(c_fake, v_fake, c1, v1)
+        return DisjunctiveChaumPedersenProof(
+            c_fake, v_fake, c1, v1,
+            commitment_hints=(a0.value, b0.value, a1.value, b1.value))
 
 
 @dataclass(frozen=True)
@@ -136,6 +148,10 @@ class ConstantChaumPedersenProof:
     challenge: ElementModQ
     response: ElementModQ
     constant: int
+    # Untrusted (a, b) commitment hints, same contract as the
+    # disjunctive proof's: unserialized, hash-checked before batch use.
+    commitment_hints: Optional[tuple] = field(
+        default=None, compare=False, repr=False)
 
     def is_valid(self, ct: ElGamalCiphertext, public_key: ElementModP,
                  context: ElementModQ) -> bool:
@@ -161,4 +177,5 @@ def make_constant_cp_proof(
     a, b = g.g_pow_p(u), g.pow_p(public_key, u)
     c = hash_elems(g, context, constant, alpha, beta, a, b)
     v = g.sub_q(u, g.mult_q(c, aggregate_nonce))
-    return ConstantChaumPedersenProof(c, v, constant)
+    return ConstantChaumPedersenProof(
+        c, v, constant, commitment_hints=(a.value, b.value))

@@ -17,7 +17,8 @@ verification of all commitments from all guardians is a batch job
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+from typing import Optional
 
 from electionguard_tpu.core.group import ElementModP, ElementModQ, GroupContext
 from electionguard_tpu.core.hash import hash_elems
@@ -28,6 +29,11 @@ class SchnorrProof:
     public_key: ElementModP
     challenge: ElementModQ
     response: ElementModQ
+    # Untrusted commitment hint h = g^u (plain int): unserialized,
+    # excluded from equality/repr; the RLC batch verifier hash-checks
+    # it per proof before use (see batch_schnorr_verify).
+    commitment_hint: Optional[int] = field(
+        default=None, compare=False, repr=False)
 
     def is_valid(self) -> bool:
         g = self.public_key.group
@@ -42,7 +48,7 @@ def make_schnorr_proof(group: GroupContext, secret: ElementModQ,
     h = group.g_pow_p(nonce)
     c = hash_elems(group, public_key, h)
     v = group.sub_q(nonce, group.mult_q(c, secret))
-    return SchnorrProof(public_key, c, v)
+    return SchnorrProof(public_key, c, v, commitment_hint=h.value)
 
 
 def batch_schnorr_verify(group: GroupContext, proofs,
@@ -59,6 +65,16 @@ def batch_schnorr_verify(group: GroupContext, proofs,
     group, host hash_elems otherwise).  The reference verifies these one
     at a time inside each trustee [ext] (SURVEY.md §3.1 🔥); the
     verifier's V2 runs the whole ceremony's proofs as one batch.
+
+    Under ``EGTPU_VERIFY_BATCH`` (and when every proof carries its
+    ``commitment_hint``) the commitment recompute is replaced by a hash
+    binding of each hint plus ONE random-linear-combination check over
+    the whole batch (two MSMs — verify/rlc.py); an RLC reject bisects
+    recursively with fresh randomizers so each failing proof is still
+    named individually, with per-proof ``is_valid`` as the leaf oracle.
+    Hash-red rows (hint absent from the equation, e.g. stale after
+    tampering) also drop to ``is_valid``, so the returned masks are
+    semantically identical to the naive path in every case.
     """
     import numpy as np
 
@@ -66,6 +82,7 @@ def batch_schnorr_verify(group: GroupContext, proofs,
     from electionguard_tpu.core import sha256_jax
     from electionguard_tpu.core.group_jax import (jax_exp_ops, jax_ops,
                                                   limbs_to_bytes_be)
+    from electionguard_tpu.utils import knobs
 
     B = len(proofs)
     if B == 0:
@@ -82,6 +99,11 @@ def batch_schnorr_verify(group: GroupContext, proofs,
     in_range = np.fromiter(
         (0 < p.public_key.value < group.p for p in proofs),
         dtype=bool, count=B)
+    if (knobs.get_flag("EGTPU_VERIFY_BATCH")
+            and all(p.commitment_hint is not None
+                    and 0 < p.commitment_hint < group.p for p in proofs)):
+        return _rlc_schnorr_verify(group, proofs, check_subgroup,
+                                   eo, k_l, c_l, in_range)
     if check_subgroup:
         q_rep = np.broadcast_to(bn.int_to_limbs(group.q, ee.ne),
                                 c_l.shape)
@@ -107,4 +129,81 @@ def batch_schnorr_verify(group: GroupContext, proofs,
                            group.bytes_to_p(bytes(com_b[i])))
             ok[i] = (c == p.challenge)
     ok = ok & in_range
+    return (ok, sub_ok) if check_subgroup else ok
+
+
+def _rlc_schnorr_verify(group: GroupContext, proofs, check_subgroup,
+                        eo, k_l, c_l, in_range):
+    """RLC batch path of ``batch_schnorr_verify`` (flag-gated by the
+    caller).  Hash-bind every hint, one ``rlc_check_schnorr`` over the
+    bound rows, recursive bisection (fresh randomizers per split) on
+    reject with per-proof ``is_valid`` at the leaves, and a membership
+    RLC for the subgroup mask.  Soundness budget: verify/rlc.py."""
+    import numpy as np
+
+    from electionguard_tpu.core import bignum_jax as bn
+    from electionguard_tpu.core import sha256_jax
+    from electionguard_tpu.core.group_jax import limbs_to_bytes_be
+    from electionguard_tpu.obs import REGISTRY, span
+    from electionguard_tpu.verify import rlc
+
+    B = len(proofs)
+    keys = [p.public_key.value for p in proofs]
+    cs = [p.challenge.value for p in proofs]
+    vs = [p.response.value for p in proofs]
+    hints = [p.commitment_hint for p in proofs]
+    sub_ok = None
+    with span("verify.batch", {"family": "V2.schnorr", "n": B}):
+        REGISTRY.counter("verify_rlc_batches_total").inc()
+        h_l = np.asarray(eo.to_limbs_p(hints))
+        if sha256_jax.supports(group):
+            chal = np.asarray(sha256_jax.batch_challenge_p(
+                group, b"",
+                [limbs_to_bytes_be(k_l), limbs_to_bytes_be(h_l)]))
+            hash_ok = (chal == c_l).all(axis=1)
+        else:
+            hash_ok = np.zeros(B, dtype=bool)
+            for i, p in enumerate(proofs):
+                c = hash_elems(group, p.public_key,
+                               ElementModP(hints[i], group))
+                hash_ok[i] = (c == p.challenge)
+        ok = np.array(hash_ok, dtype=bool)
+        fell_back = False
+        # a hash-red row's hint is not the commitment the challenge was
+        # derived from (absent/stale/tampered) — the proof itself may
+        # still be valid, so judge it from scratch
+        for i in np.nonzero(~hash_ok)[0]:
+            fell_back = True
+            ok[i] = proofs[int(i)].is_valid()
+
+        def bisect(idxs):
+            nonlocal fell_back
+            if rlc.rlc_check_schnorr(
+                    eo, [keys[i] for i in idxs], [cs[i] for i in idxs],
+                    [vs[i] for i in idxs], [hints[i] for i in idxs]):
+                return
+            fell_back = True
+            if len(idxs) == 1:
+                ok[idxs[0]] = proofs[idxs[0]].is_valid()
+                return
+            mid = len(idxs) // 2
+            bisect(idxs[:mid])
+            bisect(idxs[mid:])
+
+        bisect([int(i) for i in np.nonzero(hash_ok)[0]])
+        ok &= in_range
+        if check_subgroup:
+            if rlc.membership_rlc(eo, keys):
+                sub_ok = in_range.copy()
+            else:
+                fell_back = True
+                kq = np.asarray(eo.powmod(
+                    k_l, np.broadcast_to(
+                        bn.int_to_limbs(group.q, c_l.shape[1]),
+                        c_l.shape)))
+                one = np.zeros_like(kq)
+                one[:, 0] = 1
+                sub_ok = in_range & (kq == one).all(axis=1)
+        if fell_back:
+            REGISTRY.counter("verify_rlc_fallbacks_total").inc()
     return (ok, sub_ok) if check_subgroup else ok

@@ -43,7 +43,7 @@ from electionguard_tpu.crypto.chaum_pedersen import (
     ConstantChaumPedersenProof, DisjunctiveChaumPedersenProof)
 from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
 from electionguard_tpu.publish.election_record import ElectionInitialized
-from electionguard_tpu.utils import clock
+from electionguard_tpu.utils import clock, knobs
 
 
 @dataclass
@@ -244,6 +244,12 @@ class BatchEncryptor:
         for i in range(S):
             V_sum[flat.contest_idx[i]] += flat.votes[i]
 
+        # With EGTPU_VERIFY_BATCH on, the prover's commitment values ride
+        # along as unserialized verification hints so the RLC batch
+        # verifier can skip recomputing them (they are produced by both
+        # pipelines anyway; the flag only gates transfer/attachment).
+        with_hints = knobs.get_flag("EGTPU_VERIFY_BATCH")
+        ar_l = br_l = af_l = bf_l = ac_l = bc_l = None
         if sha256_jax.supports(g):
             bids_con = bid_digests[
                 np.asarray([row[0] for row in contest_rows], np.int64)]
@@ -255,12 +261,14 @@ class BatchEncryptor:
             from electionguard_tpu.encrypt.fused import get_fused_encryptor
             fe = get_fused_encryptor(eo, ee, self.mesh)
             seed_row = np.frombuffer(seed.to_bytes(), np.uint8)
-            alpha, beta, R_l, CR_l, VR_l, CF_l, VF_l = \
-                fe.encrypt_selections(
-                    seed_row,
-                    bid_digests[np.asarray(flat.ballot_idx, np.int64)],
-                    np.asarray(sel_ord, np.uint32), votes,
-                    self.K.value, _encode(self.qbar))
+            sel_outs = fe.encrypt_selections(
+                seed_row,
+                bid_digests[np.asarray(flat.ballot_idx, np.int64)],
+                np.asarray(sel_ord, np.uint32), votes,
+                self.K.value, _encode(self.qbar), with_hints=with_hints)
+            alpha, beta, R_l, CR_l, VR_l, CF_l, VF_l = sel_outs[:7]
+            if with_hints:
+                ar_l, br_l, af_l, bf_l = sel_outs[7:]
             # per-contest ΣR mod q from the nonce limbs: unsorted-safe
             # segment sum (a contest with zero selection rows — possible
             # only for an unvalidated votes_allowed=0 manifest — still
@@ -277,14 +285,20 @@ class BatchEncryptor:
             B_c = np.empty((C, eo.n), dtype=np.uint32)
             C2_l = np.empty((C, ee.ne), dtype=np.uint32)
             V2_l = np.empty((C, ee.ne), dtype=np.uint32)
+            if with_hints:
+                ac_l = np.empty((C, eo.n), dtype=np.uint32)
+                bc_l = np.empty((C, eo.n), dtype=np.uint32)
             for limit, idxs in by_limit.items():
                 ix = np.asarray(idxs)
-                a_g, b_g, c2_g, v2_g = fe.encrypt_contests(
+                con_outs = fe.encrypt_contests(
                     seed_row, bids_con[ix], ords_con[ix],
                     RS_l[ix], VS_l[ix], self.K.value,
-                    _encode(self.qbar) + _encode(limit))
-                A_c[ix], B_c[ix] = a_g, b_g
-                C2_l[ix], V2_l[ix] = c2_g, v2_g
+                    _encode(self.qbar) + _encode(limit),
+                    with_hints=with_hints)
+                A_c[ix], B_c[ix] = con_outs[0], con_outs[1]
+                C2_l[ix], V2_l[ix] = con_outs[2], con_outs[3]
+                if with_hints:
+                    ac_l[ix], bc_l[ix] = con_outs[4], con_outs[5]
         else:
             R = np.empty(S, dtype=object)
             U = np.empty(S, dtype=object)
@@ -390,6 +404,9 @@ class BatchEncryptor:
                                         B_b[ci], a_cb[ci], b_cb[ci])
             C2_l = ee.to_limbs(C2)
             V2_l = np.asarray(ee.a_minus_bc(U2_l, C2_l, RS_l))
+            if with_hints:
+                ar_l, br_l, af_l, bf_l = a_real, b_real, a_fake, b_fake
+                ac_l, bc_l = a_c, b_c
 
         # ---- materialize ballots ---------------------------------------
         alpha_i = self.ops.from_limbs(alpha)
@@ -402,19 +419,35 @@ class BatchEncryptor:
         VF_i = ee.from_limbs(VF_l)
         C2_i = ee.from_limbs(C2_l)
         V2 = ee.from_limbs(V2_l)
+        if with_hints:
+            ar_i = self.ops.from_limbs(ar_l)
+            br_i = self.ops.from_limbs(br_l)
+            af_i = self.ops.from_limbs(af_l)
+            bf_i = self.ops.from_limbs(bf_l)
+            ac_i = self.ops.from_limbs(ac_l)
+            bc_i = self.ops.from_limbs(bc_l)
 
         sel_by_contest: dict[int, list[EncryptedSelection]] = {}
         for i in range(S):
             ct = ElGamalCiphertext(ElementModP(alpha_i[i], g),
                                    ElementModP(beta_i[i], g))
             if votes[i] == 0:
+                # hints in hash/proof order (a0, b0, a1, b1): the real
+                # branch is the zero branch here, the simulated branch
+                # the one branch (and vice versa below)
+                hints = ((ar_i[i], br_i[i], af_i[i], bf_i[i])
+                         if with_hints else None)
                 proof = DisjunctiveChaumPedersenProof(
                     g.int_to_q(CR[i]), g.int_to_q(VR[i]),
-                    g.int_to_q(CF_i[i]), g.int_to_q(VF_i[i]))
+                    g.int_to_q(CF_i[i]), g.int_to_q(VF_i[i]),
+                    commitment_hints=hints)
             else:
+                hints = ((af_i[i], bf_i[i], ar_i[i], br_i[i])
+                         if with_hints else None)
                 proof = DisjunctiveChaumPedersenProof(
                     g.int_to_q(CF_i[i]), g.int_to_q(VF_i[i]),
-                    g.int_to_q(CR[i]), g.int_to_q(VR[i]))
+                    g.int_to_q(CR[i]), g.int_to_q(VR[i]),
+                    commitment_hints=hints)
             sel = EncryptedSelection(
                 flat.selection_ids[i], flat.sequence_orders[i], ct, proof,
                 flat.is_placeholder[i])
@@ -424,7 +457,9 @@ class BatchEncryptor:
         for ci, row in enumerate(contest_rows):
             bi, _, contest_id, seq, limit = row[:5]
             proof = ConstantChaumPedersenProof(
-                g.int_to_q(C2_i[ci]), g.int_to_q(V2[ci]), limit)
+                g.int_to_q(C2_i[ci]), g.int_to_q(V2[ci]), limit,
+                commitment_hints=((ac_i[ci], bc_i[ci])
+                                  if with_hints else None))
             contests_by_ballot.setdefault(bi, []).append(
                 EncryptedContest(contest_id, seq,
                                  tuple(sel_by_contest[ci]), proof))

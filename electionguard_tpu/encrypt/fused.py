@@ -88,9 +88,9 @@ class FusedEncryptor:
             from electionguard_tpu.verify.fused import shard_rows
             self.ndp = mesh.shape[DP_AXIS]
             self._sel_j = jax.jit(
-                shard_rows(self._sel_impl, mesh, 3, 4, n_out=7))
+                shard_rows(self._sel_impl, mesh, 3, 4, n_out=11))
             self._con_j = jax.jit(
-                shard_rows(self._con_impl, mesh, 4, 4, n_out=4))
+                shard_rows(self._con_impl, mesh, 4, 4, n_out=6))
 
 
     # -- shared helpers (device) ---------------------------------------
@@ -120,8 +120,10 @@ class FusedEncryptor:
         a_f = g^{V_F + R C_F}, b_f = g^{±C_F} K^{V_F + R C_F};
         c = H(Q̄, α, β, a0, b0, a1, b1) with branch order by vote;
         c_r = c - C_F, v_r = U - c_r R   (all mod q).
-        Returns (α, β, R, c_r, v_r, C_F, V_F) — α/β canonical limbs,
-        scalars as Z_q limbs.
+        Returns (α, β, R, c_r, v_r, C_F, V_F, a_r, b_r, a_f, b_f) —
+        α/β and the four commitment rows (the RLC verifier's hints,
+        already computed for the challenge hash — returning them is
+        free) canonical limbs, scalars as Z_q limbs.
         """
         ops, qc = self.ops, self.qctx
         mm = ops._mm
@@ -160,14 +162,20 @@ class FusedEncryptor:
              jnp.where(v1, arb, afb), jnp.where(v1, brb, bfb)])
         CR = bn.sub_mod(chal, CF, qc.p_limbs)
         VR = bn.sub_mod(U, bn.mulmod(qc, CR, R), qc.p_limbs)
-        return com[:t], com[t:2 * t], R, CR, VR, CF, VF
+        return (com[:t], com[t:2 * t], R, CR, VR, CF, VF,
+                com[2 * t:3 * t], com[3 * t:4 * t],
+                com[4 * t:5 * t], com[5 * t:])
 
     def encrypt_selections(self, seed_row: np.ndarray, bids: np.ndarray,
                            ords: np.ndarray, votes: np.ndarray,
-                           K: int, prefix: bytes):
+                           K: int, prefix: bytes,
+                           with_hints: bool = False):
         """Host entry: (S,32) identity digests + ordinals + votes ->
         [α, β, R, c_real, v_real, c_fake, v_fake] np arrays via the
-        shared tiling policy.  ``K`` is the election public key."""
+        shared tiling policy, plus the four commitment-hint columns
+        (a_real, b_real, a_fake, b_fake) when ``with_hints`` — the
+        device computes them either way; the flag only gates the
+        device->host transfer.  ``K`` is the election public key."""
         from electionguard_tpu.verify.fused import pad_to_dp
         k_table, k_hat = k_tables(self.ops, K)
         prefix_row = jnp.asarray(np.frombuffer(prefix, np.uint8))
@@ -179,6 +187,8 @@ class FusedEncryptor:
             lambda b, o, v: self._sel_j(b, o, v, seed_j, k_table, k_hat,
                                         prefix_row),
             arrays, [False, False, False])
+        if not with_hints:
+            outs = outs[:7]
         return [np.asarray(o)[:n] for o in outs]
 
     # -- contests ------------------------------------------------------
@@ -187,7 +197,8 @@ class FusedEncryptor:
         """One dispatch for a tile of contests sharing one vote limit:
         A = g^ΣR, B = g^ΣV K^ΣR, a = g^{U₂}, b = K^{U₂};
         c₂ = H(Q̄, L, A, B, a, b); v₂ = U₂ - c₂ ΣR.
-        Returns (A, B, c₂, v₂)."""
+        Returns (A, B, c₂, v₂, a, b) — the (a, b) commitment rows are
+        the constant proof's RLC verification hints."""
         ops, qc = self.ops, self.qctx
         mm = ops._mm
         t = bids.shape[0]
@@ -205,13 +216,16 @@ class FusedEncryptor:
         C2 = self._challenge(
             prefix_row, [cb[:t], cb[t:2 * t], cb[2 * t:3 * t], cb[3 * t:]])
         V2 = bn.sub_mod(U2, bn.mulmod(qc, C2, RS), qc.p_limbs)
-        return com[:t], com[t:2 * t], C2, V2
+        return (com[:t], com[t:2 * t], C2, V2,
+                com[2 * t:3 * t], com[3 * t:])
 
     def encrypt_contests(self, seed_row: np.ndarray, bids: np.ndarray,
                          ords: np.ndarray, RS_l: np.ndarray,
-                         VS_l: np.ndarray, K: int, prefix: bytes):
+                         VS_l: np.ndarray, K: int, prefix: bytes,
+                         with_hints: bool = False):
         """Host entry for one vote-limit group (the limit is encoded in
-        ``prefix``): -> [A, B, c₂, v₂] np arrays."""
+        ``prefix``): -> [A, B, c₂, v₂] np arrays, plus the (a, b)
+        commitment-hint columns when ``with_hints``."""
         from electionguard_tpu.verify.fused import pad_to_dp
         k_table, k_hat = k_tables(self.ops, K)
         prefix_row = jnp.asarray(np.frombuffer(prefix, np.uint8))
@@ -222,4 +236,6 @@ class FusedEncryptor:
             lambda b, o, rs, vs: self._con_j(b, o, rs, vs, seed_j,
                                              k_table, k_hat, prefix_row),
             arrays, [False, False, False, False])
+        if not with_hints:
+            outs = outs[:4]
         return [np.asarray(o)[:n] for o in outs]

@@ -41,6 +41,8 @@ from electionguard_tpu.mixnet.proof import MixProof, _ctx_digest, \
     transcript_digests
 from electionguard_tpu.mixnet.stage import MixStage, rows_from_ballots
 from electionguard_tpu.obs import REGISTRY, span
+from electionguard_tpu.utils import knobs
+from electionguard_tpu.verify import rlc
 
 CHECKS = ("mix_structure", "mix_chain", "mix_membership", "mix_binding",
           "mix_permutation", "mix_reencryption")
@@ -88,18 +90,36 @@ def verify_stage(group: GroupContext, public_key: int, qbar,
     ops = ops if ops is not None else jax_ops(group)
     eops = jax_exp_ops(group)
 
+    # RLC batching (EGTPU_VERIFY_BATCH): the membership screen, the
+    # (2+4w) product groups and the t̂ chain all become MSMs
+    # (verify/rlc.py).  Any RLC reject falls back to the exact
+    # per-element/per-row computation below for attribution; a sharded
+    # ops plane has no MSM entry point, so it keeps the naive dispatch.
+    batch = (knobs.get_flag("EGTPU_VERIFY_BATCH")
+             and hasattr(ops, "msm_ints"))
+    if batch:
+        REGISTRY.counter("verify_rlc_batches_total").inc()
+
     # ---- membership: every P element of outputs + transcript ----------
     flat = ([x for row in stage.pads for x in row]
             + [x for row in stage.datas for x in row]
             + list(pr.permutation_commitments) + list(pr.chain_commitments)
             + list(pr.that)
             + [pr.t1, pr.t2, pr.t3, *pr.t41, *pr.t42])
-    okm = np.asarray(ops.is_valid_residue(ops.to_limbs_p(flat)))
-    if not okm.all():
-        res.record(f"{pfx}.mix_membership", False,
-                   f"stage {k}: {int((~okm).sum())} transcript/output "
-                   f"elements outside the order-q subgroup")
-        return False
+    mem_ok = False
+    if batch:
+        with span("verify.batch",
+                  {"family": "V15.membership", "n": len(flat)}):
+            mem_ok = rlc.membership_rlc(ops, flat)
+        if not mem_ok:
+            REGISTRY.counter("verify_rlc_fallbacks_total").inc()
+    if not mem_ok:
+        okm = np.asarray(ops.is_valid_residue(ops.to_limbs_p(flat)))
+        if not okm.all():
+            res.record(f"{pfx}.mix_membership", False,
+                       f"stage {k}: {int((~okm).sum())} transcript/output "
+                       f"elements outside the order-q subgroup")
+            return False
 
     # ---- binding: the Fiat–Shamir challenge re-derives ----------------
     output_hash = rows_digest(group, stage.pads, stage.datas)
@@ -142,10 +162,19 @@ def verify_stage(group: GroupContext, public_key: int, qbar,
         bases.extend(in_datas[i][col] for i in range(n))
         exps.extend(u)
     ngroups = 2 + 4 * w
-    pw = np.asarray(ops.powmod(ops.to_limbs_p(bases),
-                               eops.to_limbs(exps)))
-    stacked = pw.reshape(ngroups, n, ops.n).transpose(1, 0, 2)
-    prods = ops.from_limbs(np.asarray(ops.prod_reduce(stacked)))
+    if batch:
+        # each group ∏ base_i^{exp_i} IS a multi-scalar multiplication:
+        # Pippenger bucketing replaces n full ladders per group with
+        # ~q_bits/w windowed bucket reductions (exact, no randomizers)
+        with span("verify.batch", {"family": "V15.msm", "n": n * ngroups}):
+            prods = [ops.msm_ints(bases[gi * n:(gi + 1) * n],
+                                  exps[gi * n:(gi + 1) * n])
+                     for gi in range(ngroups)]
+    else:
+        pw = np.asarray(ops.powmod(ops.to_limbs_p(bases),
+                                   eops.to_limbs(exps)))
+        stacked = pw.reshape(ngroups, n, ops.n).transpose(1, 0, 2)
+        prods = ops.from_limbs(np.asarray(ops.prod_reduce(stacked)))
     cu, hv = prods[0], prods[1]
     av = prods[2:2 + w]
     bv = prods[2 + w:2 + 2 * w]
@@ -153,13 +182,34 @@ def verify_stage(group: GroupContext, public_key: int, qbar,
     bu = prods[2 + 3 * w:]
 
     # t̂ chain: t̂_i == g^{v̂_i} ĉ_{i-1}^{v'_i} ĉ_i^{-c}, one batch
-    ghat = np.asarray(ops.g_pow(eops.to_limbs(pr.vhat)))
-    p1 = np.asarray(ops.powmod(ops.to_limbs_p([h] + chain[:-1]),
-                               eops.to_limbs(vp)))
-    p2 = np.asarray(ops.powmod(ops.to_limbs_p(chain),
-                               eops.to_limbs([negc] * n)))
-    that_rec = np.asarray(ops.mulmod(np.asarray(ops.mulmod(ghat, p1)), p2))
-    that_ok = (that_rec == np.asarray(ops.to_limbs_p(pr.that))).all(axis=1)
+    that_batch_ok = False
+    if batch:
+        # RLC over the n chain equations: three MSMs + one fixed-base
+        # power.  All bases are prover-supplied, so exponents stay exact
+        # (only g gets the mod-q reduction) — soundness: verify/rlc.py.
+        with span("verify.batch", {"family": "V15.that", "n": n}):
+            s = rlc.sample_randomizers(n)
+            e_g = sum(si * vi for si, vi in zip(s, pr.vhat)) % q
+            lhs = ops.msm_ints(list(pr.that), s, exp_bits=rlc.RLC_BITS)
+            rhs = (pow(g, e_g, p)
+                   * ops.msm_ints([h] + chain[:-1],
+                                  [si * vi for si, vi in zip(s, vp)])
+                   * ops.msm_ints(chain, [si * negc for si in s])) % p
+            that_batch_ok = lhs == rhs
+        if not that_batch_ok:
+            REGISTRY.counter("verify_rlc_fallbacks_total").inc()
+    if that_batch_ok:
+        that_ok = np.ones(n, dtype=bool)
+    else:
+        ghat = np.asarray(ops.g_pow(eops.to_limbs(pr.vhat)))
+        p1 = np.asarray(ops.powmod(ops.to_limbs_p([h] + chain[:-1]),
+                                   eops.to_limbs(vp)))
+        p2 = np.asarray(ops.powmod(ops.to_limbs_p(chain),
+                                   eops.to_limbs([negc] * n)))
+        that_rec = np.asarray(
+            ops.mulmod(np.asarray(ops.mulmod(ghat, p1)), p2))
+        that_ok = (that_rec
+                   == np.asarray(ops.to_limbs_p(pr.that))).all(axis=1)
 
     # scalar combines (host: a handful of single modexps)
     prod_c, prod_h = 1, 1

@@ -103,6 +103,10 @@ KNOBS: tuple[Knob, ...] = (
          "corrupted after proving and verification must catch it; "
          "1 = any server, any other value = that server id "
          "(mixfed/server)."),
+    Knob("EGTPU_MSM_WINDOW", "int", "8",
+         "Pippenger window width in bits for JaxGroupOps.msm; must "
+         "divide 16 (the bignum limb width): 4, 8 or 16 "
+         "(core/group_jax)."),
     Knob("EGTPU_NUM_PROCESSES", "int", None,
          "jax.distributed process count (parallel/distributed)."),
     Knob("EGTPU_OBS_COLLECTOR", "str", "",
@@ -188,6 +192,12 @@ KNOBS: tuple[Knob, ...] = (
     Knob("EGTPU_TILE", "int", "4096",
          "Row cap per device dispatch; bounds compile count AND peak "
          "memory (core/group_jax)."),
+    Knob("EGTPU_VERIFY_BATCH", "flag", None,
+         "Random-linear-combination batch verification: encryptors "
+         "attach commitment hints to proofs and verifiers collapse "
+         "per-proof modexps into fused MSMs, falling back to the naive "
+         "per-proof path on any batch failure (encrypt/encryptor; "
+         "verify/verifier; mixnet/verify_mix; crypto/schnorr)."),
 )
 
 _BY_NAME = {k.name: k for k in KNOBS}

@@ -168,11 +168,15 @@ class FusedVerifier:
             self.ndp = 1
             self._v4_j = jax.jit(self._v4_impl)
             self._v5_j = jax.jit(self._v5_impl)
+            self._v4h_j = jax.jit(self._v4h_impl)
+            self._v5h_j = jax.jit(self._v5h_impl)
         else:
             from electionguard_tpu.parallel.mesh import DP_AXIS
             self.ndp = mesh.shape[DP_AXIS]
             self._v4_j = jax.jit(shard_rows(self._v4_impl, mesh, 6, 3))
             self._v5_j = jax.jit(shard_rows(self._v5_impl, mesh, 5, 3))
+            self._v4h_j = jax.jit(shard_rows(self._v4h_impl, mesh, 8, 1))
+            self._v5h_j = jax.jit(shard_rows(self._v5h_impl, mesh, 6, 1))
 
 
     # -- shared helpers (device) ---------------------------------------
@@ -238,6 +242,46 @@ class FusedVerifier:
                 a, b, x0, y0, x1, y1, k_table, k_hat, prefix_row),
             arrays,
             [True, True, False, False, False, False]))[:n]
+
+    # -- RLC batch-path hash binding (no modexp) -----------------------
+    def _v4h_impl(self, A, B, h0, h1, h2, h3, c0, c1, prefix_row):
+        """Hint hash binding for the RLC batch path: recompute the V4
+        Fiat–Shamir challenge from the PROVIDED commitment hints
+        (h0..h3 = a0, b0, a1, b1) instead of recomputing the
+        commitments — pure device SHA, zero modexps.  Returns (t,) bool
+        of c0 + c1 == H(Q̄, α, β, a0, b0, a1, b1)."""
+        chal = self._challenge(
+            prefix_row, [limbs_to_bytes_j(x)
+                         for x in (A, B, h0, h1, h2, h3)])
+        sum_c = bn.add_mod(c0, c1, self._q_limbs)
+        return jnp.all(sum_c == chal, axis=-1)
+
+    def v4_hint_hash(self, A_l, B_l, h0, h1, h2, h3, c0, c1,
+                     prefix: bytes) -> np.ndarray:
+        prefix_row = jnp.asarray(np.frombuffer(prefix, np.uint8))
+        arrays, n = pad_to_dp([A_l, B_l, h0, h1, h2, h3, c0, c1],
+                              self.ndp)
+        return np.asarray(run_tiled(
+            lambda a, b, x0, x1, x2, x3, y0, y1: self._v4h_j(
+                a, b, x0, x1, x2, x3, y0, y1, prefix_row),
+            arrays, [True, True, True, True, True, True, False, False]
+        ))[:n]
+
+    def _v5h_impl(self, CA, CB, ha, hb, cc, prefix_row):
+        """V5 twin of ``_v4h_impl``: cc == H(Q̄, L, CA, CB, a, b) with
+        (a, b) taken from the hints; L rides in the prefix."""
+        chal = self._challenge(
+            prefix_row, [limbs_to_bytes_j(x) for x in (CA, CB, ha, hb)])
+        return jnp.all(cc == chal, axis=-1)
+
+    def v5_hint_hash(self, CA_l, CB_l, ha, hb, cc,
+                     prefix: bytes) -> np.ndarray:
+        prefix_row = jnp.asarray(np.frombuffer(prefix, np.uint8))
+        arrays, n = pad_to_dp([CA_l, CB_l, ha, hb, cc], self.ndp)
+        return np.asarray(run_tiled(
+            lambda a, b, x0, x1, y: self._v5h_j(a, b, x0, x1, y,
+                                                prefix_row),
+            arrays, [True, True, True, True, False]))[:n]
 
     # -- V5: contest limit (constant CP) proofs ------------------------
     def _v5_impl(self, CA, CB, Lq, cc, cv, k_table, k_hat, prefix_row):

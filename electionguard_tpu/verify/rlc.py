@@ -1,0 +1,169 @@
+"""Random-linear-combination (RLC) batch verification checks.
+
+N proof equations of the form ``com_i == base1^{x_i} base2^{y_i} ...``
+collapse into ONE equation by raising each side to a fresh
+verifier-sampled 128-bit randomizer ``s_i`` and multiplying everything
+together: the single check
+
+    ∏_i com_i^{s_i}  ==  g^{E_g} · K^{E_K} · ∏_i var_i^{s_i·c_i}
+
+costs one variable-base MSM per side (``JaxGroupOps.msm``, Pippenger
+bucketed) plus two fixed-base powers, instead of ~4-6 full 256-bit
+ladders per proof.  The commitments ``com_i`` are the prover's
+*unserialized hints* (see crypto/chaum_pedersen.py); callers MUST
+hash-check each hint row against the proof's Fiat–Shamir challenge
+before calling these functions — the hash check is what binds the hint
+to the published (challenge, response) record and catches any post-
+proving tampering deterministically.
+
+Soundness budget (documented per the batch-verification literature,
+Bellare–Garay–Rabin's small-exponents test):
+
+* Within the order-q subgroup G_q, a batch containing at least one
+  false equation passes with probability ≤ 2^-127 over the verifier's
+  randomizers (128-bit odd randomizers give 2^127 equally likely
+  values; the standard BGR argument bounds the escape probability by
+  1/#randomizers).
+* The ambient group Z_p^* has even cofactor r = (p-1)/q, so an
+  adversarial *hint* could sit outside G_q (Boyd–Pavlovski).  The
+  randomizers here are sampled ODD, which deterministically exposes any
+  single order-2 defect; an even number of colluding order-2 defects
+  still cancels with probability 1/2 per extra defect pair.  Because
+  hints are unserialized and hash-bound, only the record *producer* can
+  craft such defects, and the naive verifier (which recomputes
+  commitments from scratch) remains the authoritative semantics: every
+  RLC reject falls back to the naive path, so batch verification is a
+  sound *accept screen*, never a new accept path — a record accepted
+  under EGTPU_VERIFY_BATCH satisfies the RLC equation AND the per-row
+  hash binding, and any record the batch path rejects is re-judged
+  naively before being reported.
+
+Exponent handling: only the certified order-q bases (g, and the
+election key K, whose subgroup membership verifier check V2 pins) get
+exponents reduced mod q.  Untrusted bases (ciphertext elements, hints,
+guardian keys pre-V2) carry EXACT integer exponents (~384-bit s·c
+products) — ``msm`` takes arbitrary-width host ints, so no reduction
+argument is needed for them.
+"""
+
+from __future__ import annotations
+
+import secrets
+from typing import Sequence
+
+from electionguard_tpu.core.group_jax import JaxGroupOps
+
+RLC_BITS = 128
+
+
+def sample_randomizers(n: int) -> list[int]:
+    """n independent ODD 128-bit randomizers from the OS CSPRNG.
+
+    Odd exponents never annihilate an order-2 component of a defective
+    hint (see module docstring); 2^127 possible values bound the G_q
+    escape probability at 2^-127."""
+    return [2 * secrets.randbits(RLC_BITS - 1) + 1 for _ in range(n)]
+
+
+def rlc_check_v4(ops: JaxGroupOps, K: int,
+                 alphas: Sequence[int], betas: Sequence[int],
+                 c0s: Sequence[int], v0s: Sequence[int],
+                 c1s: Sequence[int], v1s: Sequence[int],
+                 hints: Sequence[tuple]) -> bool:
+    """One RLC check over N disjunctive (V4) proofs.
+
+    Per row the four commitment equations are
+      a0 = g^{v0} α^{c0}     b0 = K^{v0} β^{c0}
+      a1 = g^{v1} α^{c1}     b1 = K^{v1} β^{c1} g^{-c1}
+    Each gets its own randomizer (s0..s3), giving
+      msm(hints, s) == g^{Σ s0·v0 + s2·v1 - s3·c1} · K^{Σ s1·v0 + s3·v1}
+                       · msm(α‖β, [s0·c0 + s2·c1, s1·c0 + s3·c1])
+    with the α/β exponents kept as exact ints."""
+    g = ops.group
+    p, q, n = g.p, g.q, len(alphas)
+    if n == 0:
+        return True
+    s = sample_randomizers(4 * n)
+    hint_bases: list[int] = []
+    var_exps: list[int] = []
+    e_g = e_k = 0
+    for i in range(n):
+        s0, s1, s2, s3 = s[4 * i:4 * i + 4]
+        hint_bases.extend(hints[i])
+        var_exps.append(s0 * c0s[i] + s2 * c1s[i])
+        var_exps.append(s1 * c0s[i] + s3 * c1s[i])
+        e_g += s0 * v0s[i] + s2 * v1s[i] - s3 * c1s[i]
+        e_k += s1 * v0s[i] + s3 * v1s[i]
+    var_bases = [x for ab in zip(alphas, betas) for x in ab]
+    lhs = ops.msm_ints(hint_bases, s, exp_bits=RLC_BITS)
+    rhs = (pow(g.g, e_g % q, p) * pow(K, e_k % q, p)
+           * ops.msm_ints(var_bases, var_exps)) % p
+    return lhs == rhs
+
+
+def rlc_check_v5(ops: JaxGroupOps, K: int,
+                 alphas: Sequence[int], betas: Sequence[int],
+                 limits: Sequence[int], ccs: Sequence[int],
+                 cvs: Sequence[int],
+                 hints: Sequence[tuple]) -> bool:
+    """One RLC check over N constant (V5) contest proofs:
+      a = g^{v} α^{c}        b = K^{v} β^{c} g^{-L·c}
+    -> msm(hints, s‖t) == g^{Σ s·v - t·L·c} · K^{Σ t·v}
+                          · msm(α‖β, [s·c, t·c])."""
+    g = ops.group
+    p, q, n = g.p, g.q, len(alphas)
+    if n == 0:
+        return True
+    s = sample_randomizers(2 * n)
+    hint_bases: list[int] = []
+    var_exps: list[int] = []
+    e_g = e_k = 0
+    for i in range(n):
+        si, ti = s[2 * i], s[2 * i + 1]
+        hint_bases.extend(hints[i])
+        var_exps.append(si * ccs[i])
+        var_exps.append(ti * ccs[i])
+        e_g += si * cvs[i] - ti * limits[i] * ccs[i]
+        e_k += ti * cvs[i]
+    var_bases = [x for ab in zip(alphas, betas) for x in ab]
+    lhs = ops.msm_ints(hint_bases, s, exp_bits=RLC_BITS)
+    rhs = (pow(g.g, e_g % q, p) * pow(K, e_k % q, p)
+           * ops.msm_ints(var_bases, var_exps)) % p
+    return lhs == rhs
+
+
+def rlc_check_schnorr(ops: JaxGroupOps, keys: Sequence[int],
+                      cs: Sequence[int], vs: Sequence[int],
+                      hints: Sequence[int]) -> bool:
+    """One RLC check over N Schnorr equations h = g^{v} K^{c}:
+      msm(hints, s) == g^{Σ s·v} · msm(keys, [s·c]).
+    The keys are untrusted at this point (V2 has not accepted them yet)
+    so their exponents stay exact."""
+    g = ops.group
+    p, q, n = g.p, g.q, len(keys)
+    if n == 0:
+        return True
+    s = sample_randomizers(n)
+    e_g = sum(si * vi for si, vi in zip(s, vs))
+    lhs = ops.msm_ints(list(hints), s, exp_bits=RLC_BITS)
+    rhs = (pow(g.g, e_g % q, p)
+           * ops.msm_ints(list(keys), [si * ci for si, ci in zip(s, cs)])
+           ) % p
+    return lhs == rhs
+
+
+def membership_rlc(ops: JaxGroupOps, elems: Sequence[int]) -> bool:
+    """Batched subgroup screen: every element in canonical range and
+    (∏ x_i^{r_i})^q == 1 with odd 128-bit r_i.  A single non-member
+    escapes with probability ≤ 2^-127 (order-2 defects: caught
+    deterministically by the odd exponents unless they arrive in
+    cancelling pairs — see module docstring).  Callers fall back to the
+    exact per-element ``is_valid_residue`` on failure for attribution."""
+    g = ops.group
+    if not elems:
+        return True
+    if any(not 0 < x < g.p for x in elems):
+        return False
+    acc = ops.msm_ints(list(elems), sample_randomizers(len(elems)),
+                       exp_bits=RLC_BITS)
+    return pow(acc, g.q, g.p) == 1

@@ -43,7 +43,10 @@ from electionguard_tpu.core.hash import _encode, hash_digest, hash_elems
 from electionguard_tpu.crypto.cp_batch import batch_cp_verify
 from electionguard_tpu.decrypt.decryption import lagrange_coefficient
 from electionguard_tpu.keyceremony.trustee import commitment_product
+from electionguard_tpu.obs import REGISTRY, span
 from electionguard_tpu.publish.election_record import ElectionRecord
+from electionguard_tpu.utils import knobs
+from electionguard_tpu.verify import rlc
 
 
 @dataclass
@@ -307,6 +310,95 @@ class Verifier:
                    extended == self.init.extended_base_hash,
                    "extended base hash mismatch")
 
+    # ---- RLC batch screens (EGTPU_VERIFY_BATCH) ----------------------
+    def _v4_rlc_batch(self, g, qbar, K, alphas, betas, c0s, v0s, c1s,
+                      v1s, sel_hints, A_l, B_l, c0_l, c1_l, in_range):
+        """Accept screen for a whole chunk of V4 proofs: hash-bind each
+        hint row to its published challenge, then one membership RLC and
+        one equation RLC (two MSMs) replace ~6 full ladders per proof.
+        Returns True only when EVERY check is green; any failure bumps
+        ``verify_rlc_fallbacks_total`` and the caller re-runs the naive
+        path, which owns per-row error attribution (soundness budget:
+        verify/rlc.py module docstring)."""
+        S = len(alphas)
+        eo = self.ops
+        with span("verify.batch", {"family": "V4", "n": S}):
+            REGISTRY.counter("verify_rlc_batches_total").inc()
+            if any(len(h) != 4 or not all(0 < x < g.p for x in h)
+                   for h in sel_hints):
+                REGISTRY.counter("verify_rlc_fallbacks_total").inc()
+                return False
+            if sha256_jax.supports(g):
+                h_l = [eo.to_limbs_p([h[j] for h in sel_hints])
+                       for j in range(4)]
+                hash_ok = self._fused().v4_hint_hash(
+                    A_l, B_l, h_l[0], h_l[1], h_l[2], h_l[3],
+                    c0_l, c1_l, _encode(qbar))
+            else:
+                hash_ok = np.zeros(S, dtype=bool)
+                for i in range(S):
+                    h = sel_hints[i]
+                    c = hash_elems(
+                        g, qbar,
+                        ElementModP(alphas[i], g), ElementModP(betas[i], g),
+                        ElementModP(h[0], g), ElementModP(h[1], g),
+                        ElementModP(h[2], g), ElementModP(h[3], g))
+                    hash_ok[i] = (c0s[i] + c1s[i]) % g.q == c.value
+            ok = (bool(np.asarray(hash_ok).all())
+                  and bool(in_range.all())
+                  and rlc.membership_rlc(eo, list(alphas) + list(betas))
+                  and rlc.rlc_check_v4(eo, K, alphas, betas,
+                                       c0s, v0s, c1s, v1s, sel_hints))
+        if not ok:
+            REGISTRY.counter("verify_rlc_fallbacks_total").inc()
+        return ok
+
+    def _v5_rlc_batch(self, g, qbar, K, CA_l, CB_l, consts, ccs, cvs,
+                      con_hints, cc_l):
+        """V5 twin of ``_v4_rlc_batch``.  CA/CB are device products of
+        V4 elements that already passed the membership screen, so only
+        the hash binding and the equation RLC run here."""
+        C = len(ccs)
+        eo = self.ops
+        with span("verify.batch", {"family": "V5", "n": C}):
+            REGISTRY.counter("verify_rlc_batches_total").inc()
+            if any(len(h) != 2 or not all(0 < x < g.p for x in h)
+                   for h in con_hints):
+                REGISTRY.counter("verify_rlc_fallbacks_total").inc()
+                return False
+            CA_np, CB_np = np.asarray(CA_l), np.asarray(CB_l)
+            CA_i = eo.from_limbs(CA_np)
+            CB_i = eo.from_limbs(CB_np)
+            if sha256_jax.supports(g):
+                ha_l = np.asarray(eo.to_limbs_p([h[0] for h in con_hints]))
+                hb_l = np.asarray(eo.to_limbs_p([h[1] for h in con_hints]))
+                cc_np = np.asarray(cc_l)
+                hash_ok = np.zeros(C, dtype=bool)
+                fused = self._fused()
+                by_const: dict[int, list[int]] = {}
+                for i, const in enumerate(consts):
+                    by_const.setdefault(const, []).append(i)
+                for const, idxs in by_const.items():
+                    ix = np.asarray(idxs)
+                    hash_ok[ix] = fused.v5_hint_hash(
+                        CA_np[ix], CB_np[ix], ha_l[ix], hb_l[ix],
+                        cc_np[ix], _encode(qbar) + _encode(const))
+            else:
+                hash_ok = np.zeros(C, dtype=bool)
+                for i in range(C):
+                    h = con_hints[i]
+                    c = hash_elems(
+                        g, qbar, consts[i],
+                        ElementModP(CA_i[i], g), ElementModP(CB_i[i], g),
+                        ElementModP(h[0], g), ElementModP(h[1], g))
+                    hash_ok[i] = ccs[i] == c.value
+            ok = (bool(hash_ok.all())
+                  and rlc.rlc_check_v5(eo, K, CA_i, CB_i,
+                                       consts, ccs, cvs, con_hints))
+        if not ok:
+            REGISTRY.counter("verify_rlc_fallbacks_total").inc()
+        return ok
+
     # ==================================================================
     def _verify_ballot_chunk(self, res, ballots, agg: _BallotAggregates):
         """V4/V5/V6 on one chunk + V7/V13 bookkeeping into ``agg``."""
@@ -316,7 +408,7 @@ class Verifier:
         # ---- flatten all selections --------------------------------------
         alphas, betas = [], []
         c0s, v0s, c1s, v1s = [], [], [], []
-        sel_refs = []
+        sel_refs, sel_hints = [], []
         key_rows: dict[tuple, list[int]] = {}  # V7: cast rows per key
         manifest_sels = {(c.object_id, s.object_id)
                          for c in self.init.config.manifest.contests
@@ -403,6 +495,7 @@ class Verifier:
                     v0s.append(p.proof_zero_response.value)
                     c1s.append(p.proof_one_challenge.value)
                     v1s.append(p.proof_one_response.value)
+                    sel_hints.append(p.commitment_hints)
                     sel_refs.append((b.ballot_id, c.contest_id,
                                      s.selection_id))
         S = len(alphas)
@@ -425,7 +518,21 @@ class Verifier:
              for a, b in zip(alphas, betas)), dtype=bool, count=S)
         K = self.init.joint_public_key.value
         q = g.q
-        if sha256_jax.supports(g):
+        # RLC batch screen (EGTPU_VERIFY_BATCH): when every proof in the
+        # chunk carries commitment hints, one hash-binding pass + two
+        # MSMs replace the per-proof modexp ladders.  ANY failure —
+        # missing/corrupt hints, membership, or the RLC equation — falls
+        # through to the naive path below, which re-judges every row and
+        # owns the per-row error attribution.
+        v4_done = False
+        if (knobs.get_flag("EGTPU_VERIFY_BATCH")
+                and all(h is not None for h in sel_hints)):
+            v4_done = self._v4_rlc_batch(
+                g, qbar, K, alphas, betas, c0s, v0s, c1s, v1s,
+                sel_hints, A_l, B_l, c0_l, c1_l, in_range)
+        if v4_done:
+            pass
+        elif sha256_jax.supports(g):
             # fused device program (verify/fused.py): shared-base
             # multi-exp {q, c0, c1} per ciphertext element, commitment
             # recompute, device Fiat–Shamir, challenge compare — one
@@ -488,7 +595,7 @@ class Verifier:
 
         # ---- V5: contest limits ------------------------------------------
         contest_cs, contest_vs, contest_consts = [], [], []
-        contest_refs = []
+        contest_refs, con_hints = [], []
         contest_spans = []   # (start, count) into the V4 selection rows
         contests_by_id = {c.object_id: c
                           for c in self.init.config.manifest.contests}
@@ -500,6 +607,7 @@ class Verifier:
                 contest_cs.append(c.proof.challenge.value)
                 contest_vs.append(c.proof.response.value)
                 contest_consts.append(c.proof.constant)
+                con_hints.append(c.proof.commitment_hints)
                 contest_refs.append((b.ballot_id, c.contest_id))
                 desc = contests_by_id.get(c.contest_id)
                 if desc is not None and c.proof.constant != desc.votes_allowed:
@@ -518,7 +626,15 @@ class Verifier:
              for start, cnt in contest_spans])
         cc_l = np.asarray(ee.to_limbs(contest_cs))
         cv_l = np.asarray(ee.to_limbs(contest_vs))
-        if sha256_jax.supports(g):
+        v5_done = False
+        if (knobs.get_flag("EGTPU_VERIFY_BATCH") and C > 0
+                and all(h is not None for h in con_hints)):
+            v5_done = self._v5_rlc_batch(
+                g, qbar, K, CA_l, CB_l, contest_consts, contest_cs,
+                contest_vs, con_hints, cc_l)
+        if v5_done:
+            pass
+        elif sha256_jax.supports(g):
             # fused device program: (g^-1)^L fixed-base pass, commitment
             # recompute, device Fiat–Shamir, challenge compare — booleans
             # back.  Rows share a hash-message layout only within one

@@ -1,0 +1,307 @@
+"""Differential pinning of RLC batch verification (EGTPU_VERIFY_BATCH).
+
+The batch verifier is an accept SCREEN, never a new accept path: a
+record it accepts satisfies the per-row hash binding of every
+commitment hint AND the random-linear-combination equation (two MSMs),
+and anything it rejects is re-judged by the naive per-proof verifier,
+which owns error attribution.  These tests pin, on the tiny group:
+
+* accept-set equality — the per-check verdicts with the flag on equal
+  the flag-off verdicts on an honest record;
+* every existing tamper class stays red under batch: V4 ciphertext
+  swap, V4 response tamper, V5 challenge tamper, V2 Schnorr response
+  tamper, and the three mixnet classes (binding, re-encryption, chain);
+* Schnorr RLC bisection names exactly the corrupted proof;
+* the membership RLC deterministically rejects an order-2 element.
+
+Soundness budget (verify/rlc.py module docstring): a false equation
+survives the RLC with probability <= 2^-127 over the verifier's odd
+128-bit randomizers; hints are unserialized and hash-bound, so stale
+hints after ``dataclasses.replace`` tampering go hash-red and drop to
+the naive path deterministically — which is exactly what these tamper
+tests exercise.
+"""
+
+import dataclasses
+import os
+from unittest import mock
+
+import pytest
+
+from electionguard_tpu.core.group import tiny_group
+from electionguard_tpu.crypto.schnorr import (batch_schnorr_verify,
+                                              make_schnorr_proof)
+from electionguard_tpu.mixnet import verify_mix
+from electionguard_tpu.obs import REGISTRY
+from electionguard_tpu.publish.election_record import ElectionRecord
+from electionguard_tpu.verify import rlc
+from electionguard_tpu.verify.verifier import VerificationResult, Verifier
+
+_ON = {"EGTPU_VERIFY_BATCH": "1"}
+
+
+@pytest.fixture(scope="module")
+def batch_election(election):
+    """The session election re-encrypted with the flag on: the seed is
+    identical, so ciphertexts and proofs are byte-identical to the
+    fixture's (tally/decryption results stay reusable) — the only
+    difference is that every proof now carries commitment hints."""
+    from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+
+    g = election["group"]
+    with mock.patch.dict(os.environ, _ON):
+        enc = BatchEncryptor(election["init"], g)
+        encrypted, invalid = enc.encrypt_ballots(
+            election["ballots"], seed=g.int_to_q(99))
+    assert not invalid
+    s_new = encrypted[0].contests[0].selections[0]
+    s_old = election["encrypted"][0].contests[0].selections[0]
+    assert s_new.ciphertext == s_old.ciphertext  # determinism holds
+    assert s_new.proof.commitment_hints is not None
+    assert encrypted[0].contests[0].proof.commitment_hints is not None
+    return {**election, "encrypted": encrypted}
+
+
+def _record(e, **overrides):
+    kw = dict(election_init=e["init"],
+              encrypted_ballots=list(e["encrypted"]),
+              tally_result=e["tally_result"],
+              decryption_result=e["decryption_result"])
+    kw.update(overrides)
+    return ElectionRecord(**kw)
+
+
+def _verify_on(record, g):
+    with mock.patch.dict(os.environ, _ON):
+        return Verifier(record, g).verify()
+
+
+def test_batch_accept_set_equals_naive(batch_election):
+    g = batch_election["group"]
+    naive = Verifier(_record(batch_election), g).verify()
+    batches0 = REGISTRY.counter("verify_rlc_batches_total").value
+    batch = _verify_on(_record(batch_election), g)
+    assert batch.ok, batch.summary()
+    assert batch.checks == naive.checks
+    # the batch path actually ran (V4 + V5 + the two V2 ceremony calls)
+    assert REGISTRY.counter("verify_rlc_batches_total").value > batches0
+
+
+def test_batch_rejects_v4_ciphertext_swap(batch_election):
+    """Swapped ciphertexts leave the hints stale: the hash binding goes
+    red, the chunk falls back, and the naive path attributes the row."""
+    g = batch_election["group"]
+    record = _record(batch_election)
+    b = record.encrypted_ballots[1]
+    c = b.contests[0]
+    s0, s1 = c.selections[0], c.selections[1]
+    record.encrypted_ballots[1] = dataclasses.replace(
+        b, contests=(dataclasses.replace(c, selections=(
+            dataclasses.replace(s0, ciphertext=s1.ciphertext),
+            dataclasses.replace(s1, ciphertext=s0.ciphertext))
+            + tuple(c.selections[2:])),) + tuple(b.contests[1:]))
+    falls0 = REGISTRY.counter("verify_rlc_fallbacks_total").value
+    res = _verify_on(record, g)
+    assert not res.checks["V4.selection_proofs"]
+    assert REGISTRY.counter("verify_rlc_fallbacks_total").value > falls0
+
+
+def test_batch_rejects_v4_response_tamper(batch_election):
+    """A tampered response keeps the hash binding green (the hint and
+    challenge are untouched) but fails the RLC equation itself."""
+    g = batch_election["group"]
+    record = _record(batch_election)
+    b = record.encrypted_ballots[2]
+    c = b.contests[0]
+    s0 = c.selections[0]
+    bad = dataclasses.replace(
+        s0, proof=dataclasses.replace(
+            s0.proof, proof_zero_response=g.add_q(
+                s0.proof.proof_zero_response, g.ONE_MOD_Q)))
+    record.encrypted_ballots[2] = dataclasses.replace(
+        b, contests=(dataclasses.replace(
+            c, selections=(bad,) + tuple(c.selections[1:])),)
+        + tuple(b.contests[1:]))
+    res = _verify_on(record, g)
+    assert not res.checks["V4.selection_proofs"]
+    assert any("disjunctive proof fails" in e for e in res.errors)
+
+
+def test_batch_rejects_v5_challenge_tamper(batch_election):
+    g = batch_election["group"]
+    record = _record(batch_election)
+    b = record.encrypted_ballots[0]
+    c = b.contests[0]
+    bad_proof = dataclasses.replace(
+        c.proof, challenge=g.add_q(c.proof.challenge, g.ONE_MOD_Q))
+    record.encrypted_ballots[0] = dataclasses.replace(
+        b, contests=(dataclasses.replace(c, proof=bad_proof),)
+        + tuple(b.contests[1:]))
+    res = _verify_on(record, g)
+    assert not res.checks["V5.contest_limits"]
+    assert res.checks["V4.selection_proofs"]  # selections untouched
+
+
+def test_batch_rejects_v2_schnorr_tamper(batch_election):
+    g = batch_election["group"]
+    init = batch_election["init"]
+    gr = init.guardians[0]
+    pr = gr.coefficient_proofs[0]
+    bad_pr = dataclasses.replace(
+        pr, response=g.add_q(pr.response, g.ONE_MOD_Q))
+    bad_gr = dataclasses.replace(
+        gr, coefficient_proofs=(bad_pr,) + gr.coefficient_proofs[1:])
+    bad_init = dataclasses.replace(
+        init, guardians=(bad_gr,) + init.guardians[1:])
+    res = _verify_on(_record(batch_election, election_init=bad_init), g)
+    assert not res.checks["V2.guardian_keys"]
+
+
+def test_schnorr_bisection_names_offender(tgroup):
+    """One tampered response among 8 proofs: every hint still
+    hash-binds, the batch RLC rejects, and the bisection isolates
+    exactly the corrupted index (leaf oracle = per-proof is_valid)."""
+    g = tgroup
+    proofs = []
+    for i in range(8):
+        s = g.int_to_q(1000 + i)
+        proofs.append(make_schnorr_proof(
+            g, s, g.g_pow_p(s), g.int_to_q(7000 + i)))
+    bad = proofs[5]
+    proofs[5] = dataclasses.replace(
+        bad, response=g.add_q(bad.response, g.ONE_MOD_Q))
+    assert proofs[5].commitment_hint == bad.commitment_hint  # stale, binds
+    falls0 = REGISTRY.counter("verify_rlc_fallbacks_total").value
+    with mock.patch.dict(os.environ, _ON):
+        ok, sub_ok = batch_schnorr_verify(g, proofs, check_subgroup=True)
+    assert list(ok) == [i != 5 for i in range(8)]
+    assert sub_ok.all()
+    assert REGISTRY.counter("verify_rlc_fallbacks_total").value > falls0
+
+
+def test_schnorr_batch_matches_naive_flag_off(tgroup):
+    g = tgroup
+    proofs = [make_schnorr_proof(g, g.int_to_q(300 + i),
+                                 g.g_pow_p(g.int_to_q(300 + i)),
+                                 g.int_to_q(900 + i)) for i in range(5)]
+    naive = batch_schnorr_verify(g, proofs)
+    with mock.patch.dict(os.environ, _ON):
+        batch = batch_schnorr_verify(g, proofs)
+    assert list(naive) == list(batch) == [True] * 5
+
+
+def test_membership_rlc_rejects_order_two_element(tgroup):
+    """p-1 has order 2 in Z_p^*: the ODD randomizers expose it
+    deterministically, not just with probability 1/2."""
+    from electionguard_tpu.core.group_jax import jax_ops
+
+    g = tgroup
+    ops = jax_ops(g)
+    good = [pow(g.g, e, g.p) for e in (3, 5, 9)]
+    assert rlc.membership_rlc(ops, good)
+    assert not rlc.membership_rlc(ops, good + [g.p - 1])
+    assert not rlc.membership_rlc(ops, [0])      # out of range
+    assert rlc.membership_rlc(ops, [])
+
+
+@pytest.mark.slow
+def test_batch_production_fused_path(pelection):
+    """Production group: the batch path's hash binding runs the fused
+    device SHA programs (v4_hint_hash/v5_hint_hash).  Accept set equals
+    naive, and a tampered response still goes red under batch."""
+    g = pelection["group"]
+    from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+
+    with mock.patch.dict(os.environ, _ON):
+        enc = BatchEncryptor(pelection["init"], g)
+        encrypted, invalid = enc.encrypt_ballots(
+            pelection["ballots"], seed=g.int_to_q(11))
+    assert not invalid
+    e = {**pelection, "encrypted": encrypted}
+    s_new = encrypted[0].contests[0].selections[0]
+    assert s_new.proof.commitment_hints is not None
+    naive = Verifier(_record(e), g).verify()
+    batch = _verify_on(_record(e), g)
+    assert batch.ok, batch.summary()
+    assert batch.checks == naive.checks
+
+    record = _record(e)
+    b = record.encrypted_ballots[0]
+    c = b.contests[0]
+    s0 = c.selections[0]
+    bad = dataclasses.replace(
+        s0, proof=dataclasses.replace(
+            s0.proof, proof_zero_response=g.add_q(
+                s0.proof.proof_zero_response, g.ONE_MOD_Q)))
+    record.encrypted_ballots[0] = dataclasses.replace(
+        b, contests=(dataclasses.replace(
+            c, selections=(bad,) + tuple(c.selections[1:])),))
+    res = _verify_on(record, g)
+    assert not res.checks["V4.selection_proofs"]
+
+
+# ---------------------------------------------------------------------------
+# mixnet (V15): the three tamper classes stay red under batch
+# ---------------------------------------------------------------------------
+
+def test_mix_batch_honest_and_tampered():
+    """Honest cascade green under batch; tampered-output (binding),
+    wrong-permutation (re-encryption) and replayed-transcript (chain)
+    classes each stay red with the same layer attribution as naive."""
+    import copy
+
+    from tests.test_mixnet import (_encrypt_rows, _qbar,
+                                   _two_stage_cascade, _Init)
+    from electionguard_tpu.crypto.elgamal import ElGamalKeypair
+    from electionguard_tpu.mixnet.proof import prove_shuffle, rows_digest
+    from electionguard_tpu.mixnet.shuffle import Shuffler
+    from electionguard_tpu.mixnet.stage import MixStage, run_stage
+
+    g = tiny_group()
+    kp = ElGamalKeypair.from_secret(g.int_to_q(987654321))
+    K, qbar = kp.public_key, _qbar(g)
+    pads, datas, stages = _two_stage_cascade(g, K, qbar)
+    init = _Init(K, qbar)
+
+    with mock.patch.dict(os.environ, _ON):
+        batches0 = REGISTRY.counter("verify_rlc_batches_total").value
+        res = VerificationResult()
+        assert verify_mix.verify_stages(g, init, stages, res,
+                                        lambda: (pads, datas))
+        assert res.ok, res.summary()
+        assert REGISTRY.counter(
+            "verify_rlc_batches_total").value > batches0
+
+        # binding: output ciphertext modified after proving
+        bad = copy.deepcopy(stages[1])
+        bad.pads[0][0] = bad.pads[0][0] * g.g % g.p
+        res = VerificationResult()
+        assert not verify_mix.verify_stages(
+            g, init, [stages[0], bad], res, lambda: (pads, datas))
+        assert not res.checks["V15.mix_binding"]
+
+        # re-encryption: outputs don't follow the committed permutation
+        pads2, datas2 = _encrypt_rows(g, K, 16, 2)
+        sh = Shuffler(g, K.value)
+        out_p, out_d, perm, rand = sh.shuffle(pads2, datas2, b"cheat")
+        out_p[0], out_p[1] = out_p[1], out_p[0]
+        out_d[0], out_d[1] = out_d[1], out_d[0]
+        ih = rows_digest(g, pads2, datas2)
+        proof = prove_shuffle(g, K.value, qbar, 0, pads2, datas2,
+                              out_p, out_d, perm, rand, b"cheat",
+                              input_hash=ih)
+        cheat = MixStage(0, 16, 2, ih, out_p, out_d, proof)
+        res = VerificationResult()
+        assert not verify_mix.verify_stages(
+            g, init, [cheat], res, lambda: (pads2, datas2))
+        assert not res.checks["V15.mix_reencryption"]
+        assert res.checks["V15.mix_binding"]  # transcript DID bind
+
+        # chain: transcript replayed from a different input
+        other_p, other_d = _encrypt_rows(g, K, 16, 2, seed=9999)
+        replay = run_stage(g, K.value, qbar, 1, other_p, other_d,
+                           seed=b"replay")
+        res = VerificationResult()
+        assert not verify_mix.verify_stages(
+            g, init, [stages[0], replay], res, lambda: (pads, datas))
+        assert not res.checks["V15.mix_chain"]

@@ -79,6 +79,12 @@ def _backend_mode(args) -> int:
             print("off-TPU pallas: skipping fixed (hat-table build is "
                   "hours in interpret mode)")
             bops = tuple(o for o in bops if o != "fixed")
+        if backend == "pallas" and not on_tpu and "msm" in bops:
+            # the bucket suffix scan alone is ~2^w emulated montmul
+            # launches per window
+            print("off-TPU pallas: skipping msm (bucket combine is "
+                  "hours in interpret mode)")
+            bops = tuple(o for o in bops if o != "msm")
         got = bignum_bench.backend_rows(
             production_group(), backend, batch=batch, ops=bops,
             exp_bits=args.exp_bits, reps=reps)
@@ -101,7 +107,8 @@ def _backend_mode(args) -> int:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=None)
-    ap.add_argument("--ops", default="mulmod,powmod,fixed,fixedmulti,residue")
+    ap.add_argument(
+        "--ops", default="mulmod,powmod,fixed,fixedmulti,residue,msm")
     ap.add_argument("--backend", default=None,
                     choices=["cios", "ntt", "pallas", "all"],
                     help="time these backends via core.bignum_bench "
@@ -174,6 +181,19 @@ def main() -> int:
         dt = _timeit(ops._verify_residue_j, A, q_exp)
         print(f"residue: {dt*1e3:8.2f} ms  "
               f"{B/dt:12.0f} el/s  {dt/B*1e6:8.1f} us/el")
+    if "msm" in which:
+        # the RLC verify plane's variable-base accumulation: one
+        # Pippenger MSM (host digit prep + device buckets) vs B
+        # independent 256-bit ladders folded through a product tree
+        An = np.asarray(A)
+        dt = _timeit(lambda: ops.msm(An, exps))
+        print(f"msm    : {dt*1e3:8.2f} ms  "
+              f"{B/dt:12.0f} el/s  {dt/B*1e6:8.1f} us/el")
+        dt_var = _timeit(lambda: ops.prod_reduce(
+            np.asarray(ops.powmod(A, E))[:, None, :]))
+        print(f"ladders: {dt_var*1e3:8.2f} ms  "
+              f"{B/dt_var:12.0f} el/s  {dt_var/B*1e6:8.1f} us/el  "
+              f"(per-row powmod + product; msm is {dt_var/dt:.1f}x faster)")
     if "fused" in which:
         # the production pipelines: fused selection encryption and fused
         # V4 verification, rows/s at this batch shape (selection rows;

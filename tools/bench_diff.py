@@ -50,6 +50,7 @@ METRICS: dict[str, tuple[bool, float]] = {
     "encrypt_per_s": (True, 0.15),
     "tally_s": (False, 0.20),
     "verify_s": (False, 0.20),
+    "verify_batch_per_s": (True, 0.20),  # RLC/MSM verify (ballots/s/chip)
     "mixnet_rows_per_s": (True, 0.20),
     "mixfed_stages_per_s": (True, 0.20),
     "obs_spans_per_s": (True, 0.25),
