@@ -624,6 +624,17 @@ def run_workload(nballots: int, n_chips: int) -> None:
         RESULT["bignum_error"] = f"{type(e).__name__}: {e}"
     flush_partial()
 
+    # ---- race phase: monitor overhead on one deterministic sim run ------
+    # the detector's cost: instrumented vs plain wall time for the same
+    # seed (schedules are bit-identical — asserted), plus the monitor's
+    # access-event throughput.  Best-effort like the planes above.
+    try:
+        _bench_race()
+    except Exception as e:  # noqa: BLE001 — diagnostics
+        note(f"race phase failed: {type(e).__name__}: {e}")
+        RESULT["race_error"] = f"{type(e).__name__}: {e}"
+    flush_partial()
+
     import jax
     if jax.devices()[0].platform != "cpu":
         # the NTT-vs-CIOS shootout only means something on the chip; on
@@ -632,6 +643,38 @@ def run_workload(nballots: int, n_chips: int) -> None:
             _microbench(g)
         except Exception as e:  # noqa: BLE001 — diagnostics
             note(f"microbench skipped: {type(e).__name__}: {e}")
+
+
+def _bench_race() -> None:
+    """Race-monitor overhead: one fast-profile sim seed run plain and
+    then with the happens-before + lockset monitor attached.  The two
+    runs dispatch the bit-identical schedule (asserted via trace hash),
+    so the wall-time delta IS the monitor: vector-clock updates plus
+    one callback per watched attribute access."""
+    from electionguard_tpu.sim.cluster import SimConfig
+    from electionguard_tpu.sim.explore import run_sim
+
+    cfg = SimConfig(n_mix_stages=1)
+    run_sim(0, config=cfg)                       # warm jit compiles
+    t0 = time.perf_counter()
+    plain = run_sim(0, config=cfg)
+    t_off = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    raced = run_sim(0, config=cfg, race=True)
+    t_on = time.perf_counter() - t0
+    if raced.trace_hash != plain.trace_hash:
+        raise RuntimeError("race monitor perturbed the schedule")
+    overhead = (t_on - t_off) / t_off * 100
+    RESULT["race_monitor"] = {
+        "events": raced.race_events,
+        "events_per_s": round(raced.race_events / t_on, 1),
+        "run_off_s": round(t_off, 3),
+        "run_on_s": round(t_on, 3),
+        "overhead_pct": round(overhead, 1),
+    }
+    note(f"race monitor: {raced.race_events} events "
+         f"({raced.race_events / t_on:.0f}/s), "
+         f"{t_off:.2f}s -> {t_on:.2f}s (+{overhead:.0f}%)")
 
 
 def _bench_mixnet(g, init, record, n_chips: int) -> None:

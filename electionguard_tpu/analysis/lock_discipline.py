@@ -90,25 +90,61 @@ def _touches(method: ast.FunctionDef, lock_attrs: set[str]
         yield from visit(stmt, False)
 
 
+def _class_inference(cls: ast.ClassDef) -> tuple[
+        set[str], dict[str, list[tuple[str, int, bool, bool]]], set[str]]:
+    """Shared inference: (lock_attrs, per-method touches, guarded set)."""
+    lock_attrs = _lock_attrs_of(cls)
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    per_method = {m.name: list(_touches(m, lock_attrs)) for m in methods}
+    guarded: set[str] = set()
+    for touches in per_method.values():
+        for attr, _line, is_write, under in touches:
+            if is_write and under:
+                guarded.add(attr)
+    return lock_attrs, per_method, guarded
+
+
+def infer_guards(project: core.Project) -> list[dict]:
+    """Machine-readable per-class guard sets for the dynamic race
+    monitor (``analysis/race_instrument.py``): every class with at
+    least one guarded attribute, keyed by import path, sorted."""
+    out = []
+    for f in project.files():
+        module = f.rel[:-3].replace("/", ".")
+        if module.endswith(".__init__"):
+            module = module[: -len(".__init__")]
+        for cls in [n for n in ast.walk(f.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            lock_attrs, _per_method, guarded = _class_inference(cls)
+            if not guarded:
+                continue
+            out.append({"module": module, "class": cls.name,
+                        "lock_attrs": sorted(lock_attrs),
+                        "guarded": sorted(guarded)})
+    return sorted(out, key=lambda g: (g["module"], g["class"]))
+
+
+def render_guards(project: core.Project) -> str:
+    """ANALYSIS_GUARDS.json content (drift-gated like ENV_KNOBS.md)."""
+    import json
+    doc = {"generated_by": "python tools/eglint.py --write-guards",
+           "rule": RULE, "classes": infer_guards(project)}
+    return json.dumps(doc, indent=2, sort_keys=False) + "\n"
+
+
 @core.register(RULE, doc="attributes written under a lock in one method "
                          "but touched lock-free in another")
 def run(project: core.Project) -> Iterator[core.Finding]:
     for f in project.files():
         for cls in [n for n in ast.walk(f.tree)
                     if isinstance(n, ast.ClassDef)]:
-            lock_attrs = _lock_attrs_of(cls)
+            lock_attrs, per_method, guarded = _class_inference(cls)
             if not lock_attrs:
                 continue
             methods = [n for n in cls.body
                        if isinstance(n, (ast.FunctionDef,
                                          ast.AsyncFunctionDef))]
-            per_method = {m.name: list(_touches(m, lock_attrs))
-                          for m in methods}
-            guarded: set[str] = set()
-            for touches in per_method.values():
-                for attr, _line, is_write, under in touches:
-                    if is_write and under:
-                        guarded.add(attr)
             for m in methods:
                 if m.name in _EXEMPT_METHODS:
                     continue

@@ -1,0 +1,426 @@
+"""egrace — dynamic happens-before + lockset race detection over the
+deterministic sim.
+
+The cooperative scheduler (``sim/scheduler.py``) runs exactly one task
+at a time, so two accesses race iff no *explicit* happens-before edge
+orders them.  Because every interleaving point is owned by the
+scheduler, the HB relation here is precise — there are no accidental
+real-time orderings to hide a race the way they do under a wall-clock
+runtime.  Edges:
+
+=====================  ==============================================
+edge                   drawn at
+=====================  ==============================================
+spawn                  child's clock starts as a copy of the parent's
+task finish            finisher publishes into the global seam clock
+lock release→acquire   ``TrackedLock``/``TrackedCondition`` proxies
+                       (release publishes the holder's clock to the
+                       lock; acquire joins it)
+message send→receive   inherited: sim-transport RPC handlers run
+                       inline on the sender's task, so the edge is a
+                       program-order edge by construction
+server start→dispatch  ``SimServer.start()`` publishes the starting
+                       task's clock; every dispatch to that port joins
+                       it (models ``grpc.Server.start()``'s handler
+                       publication — handlers and their captured state
+                       are built before ``start()``)
+clock-seam wait        a predicate wait that *succeeds* joins the
+                       global seam clock (every task publishes into
+                       it at each yield); plain sleeps and timeouts
+                       create no edge
+=====================  ==============================================
+
+Two detectors share the event stream:
+
+* **FastTrack-style HB** — per-variable last-write epoch + read map;
+  fires only on accesses genuinely unordered in *this* schedule.
+* **Eraser-style lockset** — candidate-lockset intersection with a
+  one-time ownership transfer (a handoff that happens-after the
+  variable's whole history re-assigns the owner once).  Predictive:
+  it can flag a pair that this schedule happened to order via a seam
+  wait but that no common lock protects.
+
+Races are waivable only via ``analysis/race_waivers.json`` (each entry
+needs a ``note``); the file ships empty and the tier-1 gate keeps it
+that way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+WAIVERS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "race_waivers.json")
+
+#: monitor/instrumentation frames are skipped when attributing a site
+_SKIP_FRAME_FILES = ("analysis/race.py", "analysis/race_instrument.py",
+                     "sim/scheduler.py")
+
+MAX_RACES = 50          # stop recording (not detecting) past this
+_STACK_DEPTH = 4
+
+
+# ---------------------------------------------------------------- reports
+
+@dataclass
+class RaceSide:
+    task: str
+    op: str                      # "read" | "write"
+    site: str                    # repo-relative file:line
+    stack: list = field(default_factory=list)
+    locks: list = field(default_factory=list)
+    rpc: Optional[str] = None    # rpc method the access ran under
+
+    def to_dict(self) -> dict:
+        return {"task": self.task, "op": self.op, "site": self.site,
+                "stack": list(self.stack), "locks": list(self.locks),
+                "rpc": self.rpc}
+
+
+@dataclass
+class RaceReport:
+    kind: str                    # "hb" | "lockset"
+    var: str                     # "Class.attr"
+    pair: str                    # "w/w" | "r/w" | "w/r"
+    prior: RaceSide
+    current: RaceSide
+    vtime: float
+
+    def key(self) -> tuple:
+        return (self.kind, self.var, self.pair,
+                self.prior.site, self.current.site)
+
+    def summary(self) -> str:
+        return (f"{self.kind} {self.pair} {self.var} "
+                f"{self.prior.task}@{self.prior.site} vs "
+                f"{self.current.task}@{self.current.site}")
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "var": self.var, "pair": self.pair,
+                "prior": self.prior.to_dict(),
+                "current": self.current.to_dict(),
+                "vtime": round(self.vtime, 6)}
+
+
+# ---------------------------------------------------------------- waivers
+
+def load_waivers(path: str = None) -> list[dict]:
+    """``race_waivers.json`` entries; every entry must carry a note."""
+    path = path or WAIVERS_PATH
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        doc = json.load(f)
+    waivers = doc.get("waivers", [])
+    for w in waivers:
+        if not str(w.get("note", "")).strip():
+            raise ValueError(
+                f"race waiver for {w.get('var')!r} has no note — every "
+                f"waiver needs a rationale")
+        if "var" not in w:
+            raise ValueError(f"race waiver missing 'var': {w!r}")
+    return waivers
+
+
+def waived(report: RaceReport, waivers: list[dict]) -> bool:
+    for w in waivers:
+        if w["var"] != report.var:
+            continue
+        if w.get("kind", "*") in ("*", report.kind):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------- state
+
+class _Var:
+    """Per-variable FastTrack + Eraser state."""
+
+    __slots__ = ("name", "wtask", "wclock", "wmeta", "reads", "rmeta",
+                 "state", "owner", "creator", "transferred", "cand",
+                 "last", "written", "ls_reported")
+
+    def __init__(self, name: str):
+        self.name = name
+        # FastTrack: last-write epoch + per-task read clocks
+        self.wtask: Optional[int] = None
+        self.wclock = 0
+        self.wmeta: Optional[RaceSide] = None
+        self.reads: dict[int, int] = {}
+        self.rmeta: dict[int, RaceSide] = {}
+        # Eraser: exclusive -> shared -> shared-mod, one transfer
+        self.state = "virgin"
+        self.owner: Optional[int] = None
+        self.creator: Optional[int] = None
+        self.transferred = False
+        self.cand: Optional[frozenset] = None
+        self.last: Optional[tuple] = None   # (locks frozenset, RaceSide)
+        self.written = False
+        self.ls_reported = False
+
+    def covered_by(self, vc: dict[int, int]) -> bool:
+        """Does ``vc`` happen-after every recorded access?"""
+        if self.wtask is not None and vc.get(self.wtask, 0) < self.wclock:
+            return False
+        return all(vc.get(t, 0) >= c for t, c in self.reads.items())
+
+
+class RaceMonitor:
+    """Consumes scheduler + instrumentation events, produces reports.
+
+    Attaches itself as ``sched.monitor``; the scheduler calls the
+    ``on_*`` hooks at its synchronization points and the instrumented
+    classes report attribute accesses through :meth:`on_access`.  The
+    monitor adds no yield points and never touches the scheduler RNG,
+    so a race-enabled run is bit-for-bit the same schedule as a plain
+    one (asserted in tests via the trace hash).
+    """
+
+    def __init__(self, sched):
+        self.sched = sched
+        sched.monitor = self
+        self._vc: dict[int, dict[int, int]] = {}      # task seq -> VC
+        self._lock_vc: dict[int, dict[int, int]] = {}  # lock id -> VC
+        self._chan: dict[object, dict[int, int]] = {}  # publication VCs
+        self._global: dict[int, int] = {}             # seam clock
+        self._held: dict[int, list] = {}              # task -> locks
+        self._rpc: dict[int, list[str]] = {}          # task -> rpc stack
+        self._vars: dict[tuple, _Var] = {}
+        self._pins: dict[int, object] = {}            # keep ids stable
+        self._seen: set = set()
+        self.races: list[RaceReport] = []
+        self.dropped = 0
+        self.events = 0
+        self._busy = False
+        self._retired = False
+
+    # ---------------- clocks
+
+    def _clock(self, seq: int) -> dict[int, int]:
+        vc = self._vc.get(seq)
+        if vc is None:
+            vc = self._vc[seq] = dict(self._global)
+            vc[seq] = vc.get(seq, 0) + 1
+        return vc
+
+    @staticmethod
+    def _join(into: dict[int, int], other: dict[int, int]) -> None:
+        for t, c in other.items():
+            if into.get(t, 0) < c:
+                into[t] = c
+
+    def _task(self):
+        if self._retired:
+            return None
+        return self.sched.current_task()
+
+    # ---------------- scheduler hooks
+
+    def on_spawn(self, parent, child) -> None:
+        if parent is not None:
+            pvc = self._clock(parent.seq)
+            cvc = dict(pvc)
+            pvc[parent.seq] += 1
+        else:
+            cvc = dict(self._global)
+        cvc[child.seq] = cvc.get(child.seq, 0) + 1
+        self._vc[child.seq] = cvc
+
+    def on_yield(self, task) -> None:
+        vc = self._clock(task.seq)
+        self._join(self._global, vc)
+        vc[task.seq] += 1
+
+    def on_wait_ok(self, task) -> None:
+        self._join(self._clock(task.seq), self._global)
+
+    def on_finish(self, task) -> None:
+        self._join(self._global, self._clock(task.seq))
+
+    # ---------------- lock hooks (from Tracked proxies)
+
+    def on_acquire(self, lock) -> None:
+        task = self._task()
+        if task is None:
+            return
+        self._held.setdefault(task.seq, []).append(lock)
+        lvc = self._lock_vc.get(id(lock))
+        if lvc:
+            self._join(self._clock(task.seq), lvc)
+        self._pins[id(lock)] = lock
+
+    def on_release(self, lock) -> None:
+        task = self._task()
+        if task is None:
+            return
+        held = self._held.get(task.seq, [])
+        if lock in held:
+            held.remove(lock)
+        vc = self._clock(task.seq)
+        self._lock_vc[id(lock)] = dict(vc)
+        vc[task.seq] += 1
+
+    # ---------------- publication channels (server start → dispatch)
+
+    def on_publish(self, key) -> None:
+        """One-way edge source: merge the current task's clock into
+        channel ``key`` (e.g. a sim server starting on a port)."""
+        task = self._task()
+        if task is None:
+            return
+        vc = self._clock(task.seq)
+        self._join(self._chan.setdefault(key, {}), vc)
+        vc[task.seq] += 1
+
+    def on_subscribe(self, key) -> None:
+        """Edge sink: the current task happens-after every publish to
+        ``key`` (e.g. dispatching an rpc to a started server)."""
+        task = self._task()
+        if task is None:
+            return
+        ch = self._chan.get(key)
+        if ch:
+            self._join(self._clock(task.seq), ch)
+
+    # ---------------- rpc context (from sim transport)
+
+    def rpc_begin(self, method: str) -> None:
+        task = self._task()
+        if task is not None:
+            self._rpc.setdefault(task.seq, []).append(method)
+
+    def rpc_end(self) -> None:
+        task = self._task()
+        if task is not None:
+            stack = self._rpc.get(task.seq)
+            if stack:
+                stack.pop()
+
+    # ---------------- access events (from race_instrument)
+
+    def on_access(self, obj, cname: str, attr: str, is_write: bool) -> None:
+        if self._busy or self._retired:
+            return
+        task = self._task()
+        if task is None:
+            return          # scheduler-thread pred eval, or outside sim
+        self._busy = True
+        try:
+            self.events += 1
+            self._record(obj, cname, attr, is_write, task)
+        finally:
+            self._busy = False
+
+    def _record(self, obj, cname, attr, is_write, task) -> None:
+        key = (id(obj), attr)
+        var = self._vars.get(key)
+        if var is None:
+            var = self._vars[key] = _Var(f"{cname}.{attr}")
+            self._pins[id(obj)] = obj
+        t = task.seq
+        vc = self._clock(t)
+        locks = frozenset(id(k) for k in self._held.get(t, ()))
+        meta = self._side(task, is_write, t)
+
+        # --- FastTrack happens-before
+        if is_write:
+            if (var.wtask is not None and var.wtask != t
+                    and vc.get(var.wtask, 0) < var.wclock):
+                self._report("hb", var, "w/w", var.wmeta, meta)
+            else:
+                for rt, rc in var.reads.items():
+                    if rt != t and vc.get(rt, 0) < rc:
+                        self._report("hb", var, "r/w", var.rmeta[rt], meta)
+                        break
+            var.wtask, var.wclock, var.wmeta = t, vc[t], meta
+            var.reads, var.rmeta = {}, {}
+        else:
+            if (var.wtask is not None and var.wtask != t
+                    and vc.get(var.wtask, 0) < var.wclock):
+                self._report("hb", var, "w/r", var.wmeta, meta)
+            var.reads[t] = vc[t]
+            var.rmeta[t] = meta
+
+        # --- Eraser lockset (with ownership transfer: the creating
+        # task hands off for free — construction precedes sharing —
+        # and ONE further happens-after-all-history handoff is allowed
+        # before the variable counts as shared)
+        if var.state == "virgin":
+            var.state, var.owner, var.creator = "exclusive", t, t
+        elif var.state == "exclusive" and t != var.owner:
+            if var.covered_by(vc) and (var.owner == var.creator
+                                       or not var.transferred):
+                if var.owner != var.creator:
+                    var.transferred = True
+                var.owner = t
+            else:
+                var.state = ("shared-mod"
+                             if (is_write or var.written) else "shared")
+                prev = var.last[0] if var.last else frozenset()
+                var.cand = prev & locks
+        elif var.state != "exclusive":
+            var.cand = (var.cand if var.cand is not None
+                        else locks) & locks
+            if is_write:
+                var.state = "shared-mod"
+        if (var.state == "shared-mod" and not var.cand
+                and not var.ls_reported):
+            var.ls_reported = True
+            prior = var.last[1] if var.last else meta
+            self._report("lockset", var,
+                         "w/w" if is_write else "w/r", prior, meta)
+        if is_write:
+            var.written = True
+        var.last = (locks, meta)
+
+    # ---------------- reporting
+
+    def _side(self, task, is_write: bool, seq: int) -> RaceSide:
+        stack = self._stack()
+        rpc = self._rpc.get(seq)
+        names = [getattr(k, "_name", "?")
+                 for k in self._held.get(seq, ())]
+        return RaceSide(
+            task=task.name, op="write" if is_write else "read",
+            site=stack[0] if stack else "?", stack=stack,
+            locks=sorted(names), rpc=rpc[-1] if rpc else None)
+
+    def _stack(self) -> list[str]:
+        out = []
+        f = sys._getframe(2)
+        while f is not None and len(out) < _STACK_DEPTH:
+            fn = f.f_code.co_filename
+            rel = os.path.relpath(fn, _REPO_ROOT).replace(os.sep, "/")
+            if not rel.startswith("..") and not any(
+                    rel.endswith(s) for s in _SKIP_FRAME_FILES):
+                out.append(f"{rel}:{f.f_lineno}:{f.f_code.co_name}")
+            f = f.f_back
+        return out
+
+    def _report(self, kind: str, var: _Var, pair: str,
+                prior: Optional[RaceSide], current: RaceSide) -> None:
+        r = RaceReport(kind=kind, var=var.name, pair=pair,
+                       prior=prior or current, current=current,
+                       vtime=self.sched.now)
+        if r.key() in self._seen:
+            return
+        self._seen.add(r.key())
+        if len(self.races) >= MAX_RACES:
+            self.dropped += 1
+            return
+        self.races.append(r)
+
+    # ---------------- lifecycle
+
+    def retire(self) -> None:
+        """Detach: later events (e.g. from still-wrapped singleton
+        locks) become no-ops."""
+        self._retired = True
+        if getattr(self.sched, "monitor", None) is self:
+            self.sched.monitor = None

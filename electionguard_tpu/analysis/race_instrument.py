@@ -1,0 +1,268 @@
+"""Attribute-access instrumentation for the dynamic race monitor.
+
+The static lock-discipline pass exports, per class, the attributes it
+inferred to be lock-guarded (``ANALYSIS_GUARDS.json``).  This module
+patches exactly those classes' ``__getattribute__`` / ``__setattr__``
+so every touch of a guarded attribute reports a read/write event —
+carrying the held lockset and the current vector-clock epoch — to a
+:class:`~electionguard_tpu.analysis.race.RaceMonitor`.  The static pass
+*seeds* the dynamic monitor; the monitor then validates (a schedule
+exhibits the race) or refutes (every schedule orders the accesses)
+what lexical analysis could only suspect.
+
+Locks are observed by proxy: assigning a ``threading`` Lock/RLock/
+Condition to a lock-ish attribute of an instrumented class stores a
+``TrackedLock`` / ``TrackedCondition`` wrapper instead, whose
+acquire/release notify the monitor (release→acquire is an HB edge and
+the held set feeds the Eraser lockset).  Instances created *before*
+installation (module singletons) get their locks wrapped lazily on
+first attribute read.
+
+Infrastructure packages are excluded at runtime — the sim scheduler,
+the analysis layer, and the fault machinery implement the watching and
+must not watch themselves.  ``EGTPU_RACE_WATCH`` extends the surface:
+``pkg.mod:Class=attr1+attr2;pkg.other:Cls=attr``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import threading
+from typing import Iterable, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+GUARDS_PATH = os.path.join(REPO_ROOT, "ANALYSIS_GUARDS.json")
+
+#: the machinery implementing the sim/monitor cannot be watched by it
+EXCLUDE_PREFIXES = (
+    "electionguard_tpu.sim.", "electionguard_tpu.analysis.",
+    "electionguard_tpu.testing.", "electionguard_tpu.utils.",
+)
+
+_LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))
+
+
+class TrackedLock:
+    """Forwarding proxy for ``threading.Lock``/``RLock`` that reports
+    acquire/release to the monitor.  ``release`` notifies *before*
+    releasing so the holder publishes its clock while still exclusive."""
+
+    def __init__(self, inner, name: str, monitor):
+        object.__setattr__(self, "_inner", inner)
+        object.__setattr__(self, "_name", name)
+        object.__setattr__(self, "_mon", monitor)
+
+    def acquire(self, *a, **kw):
+        got = self._inner.acquire(*a, **kw)
+        if got:
+            self._mon.on_acquire(self)
+        return got
+
+    def release(self):
+        self._mon.on_release(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class TrackedCondition:
+    """Forwarding proxy for ``threading.Condition``.  The condition
+    object itself is the tracked lock; ``wait`` reports the implicit
+    release/reacquire pair.  (In the sim, CV waits go through the clock
+    seam's explicit release/sleep/acquire, which hits the same hooks.)"""
+
+    def __init__(self, inner, name: str, monitor):
+        object.__setattr__(self, "_inner", inner)
+        object.__setattr__(self, "_name", name)
+        object.__setattr__(self, "_mon", monitor)
+
+    def acquire(self, *a, **kw):
+        got = self._inner.acquire(*a, **kw)
+        if got:
+            self._mon.on_acquire(self)
+        return got
+
+    def release(self):
+        self._mon.on_release(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def wait(self, timeout=None):
+        self._mon.on_release(self)
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            self._mon.on_acquire(self)
+
+    def wait_for(self, predicate, timeout=None):
+        self._mon.on_release(self)
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            self._mon.on_acquire(self)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _wrap_lock(value, name: str, monitor):
+    """Wrap a raw lock in a tracked proxy; rewrap a proxy left behind by
+    a previous (retired) monitor; pass everything else through."""
+    if isinstance(value, (TrackedLock, TrackedCondition)):
+        if value._mon is monitor:
+            return value
+        value = value._inner            # previous run's wrapper: peel
+    if isinstance(value, _LOCK_TYPES):
+        return TrackedLock(value, name, monitor)
+    if isinstance(value, threading.Condition):
+        return TrackedCondition(value, name, monitor)
+    return value
+
+
+# ---------------------------------------------------------------- config
+
+def load_guards(path: Optional[str] = None) -> list[dict]:
+    path = path or GUARDS_PATH
+    with open(path) as f:
+        return json.load(f)["classes"]
+
+
+def parse_watch(spec: str) -> list[dict]:
+    """``pkg.mod:Class=attr1+attr2;...`` → guard entries (no lock attrs:
+    extension targets are watched, their locks inferred by name)."""
+    out = []
+    for part in filter(None, (p.strip() for p in spec.split(";"))):
+        try:
+            modcls, attrs = part.split("=", 1)
+            module, cls = modcls.rsplit(":", 1)
+        except ValueError:
+            raise ValueError(
+                f"bad EGTPU_RACE_WATCH entry {part!r} "
+                f"(want pkg.mod:Class=attr1+attr2)") from None
+        out.append({"module": module, "class": cls,
+                    "lock_attrs": [],
+                    "guarded": [a for a in attrs.split("+") if a]})
+    return out
+
+
+# ---------------------------------------------------------------- patching
+
+class Instrumentation:
+    """Handle over a set of patched classes; ``uninstall`` restores the
+    original descriptors and retires the monitor."""
+
+    def __init__(self, monitor):
+        self.monitor = monitor
+        self._patched: list[tuple[type, object, object]] = []
+        self.classes: list[str] = []
+
+    def add(self, cls: type, watched: Iterable[str],
+            lock_attrs: Iterable[str]) -> None:
+        watched = frozenset(watched)
+        lock_attrs = frozenset(lock_attrs)
+        monitor = self.monitor
+        orig_get = cls.__getattribute__
+        orig_set = cls.__setattr__
+        cname = cls.__name__
+
+        def __getattribute__(self, name):
+            val = orig_get(self, name)
+            if name in lock_attrs and not (
+                    isinstance(val, (TrackedLock, TrackedCondition))
+                    and val._mon is monitor):
+                # lazy wrap: instance predates install() (singleton) or
+                # carries a retired wrapper from an earlier run
+                wrapped = _wrap_lock(val, f"{cname}.{name}", monitor)
+                if wrapped is not val:
+                    orig_set(self, name, wrapped)
+                return wrapped
+            if name in watched:
+                monitor.on_access(self, cname, name, False)
+            return val
+
+        def __setattr__(self, name, value):
+            if name in lock_attrs:
+                value = _wrap_lock(value, f"{cname}.{name}", monitor)
+            orig_set(self, name, value)
+            if name in watched:
+                monitor.on_access(self, cname, name, True)
+
+        cls.__getattribute__ = __getattribute__
+        cls.__setattr__ = __setattr__
+        self._patched.append((cls, orig_get, orig_set))
+        self.classes.append(f"{cls.__module__}.{cname}")
+
+    def uninstall(self) -> None:
+        for cls, orig_get, orig_set in self._patched:
+            cls.__getattribute__ = orig_get
+            cls.__setattr__ = orig_set
+        self._patched.clear()
+        self.monitor.retire()
+
+
+def install(monitor, guards: Optional[list[dict]] = None,
+            watch: Optional[str] = None,
+            extra: Optional[list[tuple[type, Iterable[str],
+                                       Iterable[str]]]] = None
+            ) -> Instrumentation:
+    """Patch every non-excluded guarded class (plus ``EGTPU_RACE_WATCH``
+    entries and explicit ``extra`` (cls, attrs, lock_attrs) triples)."""
+    from electionguard_tpu.utils import knobs
+
+    if guards is None:
+        guards = load_guards()
+    if watch is None:
+        watch = knobs.get_str("EGTPU_RACE_WATCH")
+    entries = [g for g in guards
+               if not any(g["module"].startswith(p)
+                          for p in EXCLUDE_PREFIXES)]
+    entries += parse_watch(watch)
+
+    inst = Instrumentation(monitor)
+    for g in entries:
+        try:
+            mod = importlib.import_module(g["module"])
+            cls = getattr(mod, g["class"])
+        except (ImportError, AttributeError) as e:
+            raise RuntimeError(
+                f"race watch target {g['module']}:{g['class']} not "
+                f"importable: {e}") from e
+        inst.add(cls, g["guarded"], g["lock_attrs"] or _infer_locks(cls))
+    for cls, attrs, lock_attrs in (extra or ()):
+        inst.add(cls, attrs, lock_attrs)
+    return inst
+
+
+def _infer_locks(cls: type) -> list[str]:
+    """Best-effort lock attrs for EGTPU_RACE_WATCH targets (no static
+    inference available): any init-assigned attr with a lock-ish name."""
+    import re
+    pat = re.compile(r"lock|mutex|cv|cond", re.IGNORECASE)
+    init = getattr(cls, "__init__", None)
+    names = set()
+    code = getattr(init, "__code__", None)
+    if code is not None:
+        names = {n for n in code.co_names if pat.search(n)}
+    return sorted(names)

@@ -65,12 +65,13 @@ def main(argv=None) -> int:
                       coord.ready(), args.navailable)
             return 2
         coord.mark_started()
-        registered = {p.id for p in coord.proxies}
+        proxies = coord.registered()
+        registered = {p.id for p in proxies}
         missing = [g.guardian_id for g in init.guardians
                    if g.guardian_id not in registered]
         log.info("registered=%s missing=%s", sorted(registered), missing)
 
-        decryption = Decryption(group, init, coord.proxies, missing)
+        decryption = Decryption(group, init, proxies, missing)
         decrypted = decryption.decrypt(tally_result.encrypted_tally)
         result = DecryptionResult(
             tally_result, decrypted,

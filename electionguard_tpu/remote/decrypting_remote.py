@@ -185,6 +185,16 @@ class DecryptionCoordinator:
         with self._lock:
             return len(self.proxies)
 
+    def registered(self) -> list:
+        """Lock-held snapshot of the registered proxies.  External
+        callers must use this instead of reading ``proxies`` directly:
+        registration handlers mutate the list under ``_lock`` on other
+        threads (found by the egrace monitor as a lockset violation on
+        DecryptionCoordinator.proxies — ready() vs the sim driver's
+        lock-free read)."""
+        with self._lock:
+            return list(self.proxies)
+
     def wait_for_registrations(self, timeout: float = 300.0,
                                poll: float = 0.25) -> bool:
         deadline = clock.monotonic() + timeout

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 from dataclasses import dataclass, field
 
 import grpc
@@ -98,6 +99,84 @@ class SimOutcome:
     # and the named in-band rejections the defenses recorded
     fired: list = field(default_factory=list)
     detections: list = field(default_factory=list)
+    # race detector reports (analysis/race.RaceReport) when the run had
+    # the monitor attached; the race oracle turns unwaived ones red
+    races: list = field(default_factory=list)
+
+
+class RaceProbeBox:
+    """Planted-race target for the detector's self-tests.  ``shared``
+    is watched whenever the monitor is on (``run_sim(race=True)``
+    passes it as an explicit extra target — it is not part of
+    ANALYSIS_GUARDS.json because no production code path touches it)."""
+
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self.shared = 0
+
+
+def _spawn_race_probes(sched, plant: frozenset) -> None:
+    """Detector self-test fixtures: each plant spawns a tiny task pair
+    against a fresh :class:`RaceProbeBox`, concurrent with the real
+    workflow but touching nothing else.
+
+    * ``race-hb``      — lock-free read+write pair ordered only by
+      sleeps (sleeps create no HB edge): the happens-before detector
+      must fire on ``RaceProbeBox.shared``;
+    * ``race-lockset`` — three accesses, every one locked and every
+      pair HB-ordered by an event handoff, but the locks DIFFER: only
+      the lockset heuristic can flag that no common lock protects the
+      variable;
+    * ``race-handoff`` — lock-free write, event set, lock-free read: a
+      legal message-passing publication that must stay green (the
+      false-positive guard for both detectors).
+    """
+    if "race-hb" in plant:
+        box = RaceProbeBox()
+
+        def hb_writer(k):
+            def go():
+                clock.sleep(0.001 * k)
+                box.shared = box.shared + k
+            return go
+
+        sched.spawn("race-hb-1", hb_writer(1), node="driver")
+        sched.spawn("race-hb-2", hb_writer(2), node="driver")
+    if "race-lockset" in plant:
+        box = RaceProbeBox()
+        ev1, ev2 = threading.Event(), threading.Event()
+
+        def ls_first():
+            with box._lock_a:
+                box.shared = 1
+            ev1.set()
+            clock.wait_event(ev2, 30.0)
+            with box._lock_a:
+                box.shared = 3
+
+        def ls_second():
+            clock.wait_event(ev1, 30.0)
+            with box._lock_b:
+                box.shared = 2
+            ev2.set()
+
+        sched.spawn("race-ls-1", ls_first, node="driver")
+        sched.spawn("race-ls-2", ls_second, node="driver")
+    if "race-handoff" in plant:
+        box = RaceProbeBox()
+        ev = threading.Event()
+
+        def ho_writer():
+            box.shared = 41
+            ev.set()
+
+        def ho_reader():
+            clock.wait_event(ev, 30.0)
+            assert box.shared == 41
+
+        sched.spawn("race-ho-1", ho_writer, node="driver")
+        sched.spawn("race-ho-2", ho_reader, node="driver")
 
 
 class _MemStream:
@@ -168,6 +247,7 @@ def drive(cfg: SimConfig, sched, transport, plan, schedule, seed: int,
         # its stage (coordinator requeue path)
 
     transport.on_crash = on_crash
+    _spawn_race_probes(sched, plant)
 
     # ---- phase 1: key ceremony ---------------------------------------
     def kc_task():
@@ -322,9 +402,10 @@ def drive(cfg: SimConfig, sched, transport, plan, schedule, seed: int,
             if not coord.wait_for_registrations(timeout=90.0):
                 raise RuntimeError("decryption registrations timed out")
             coord.mark_started()
-            registered = {p.id for p in coord.proxies}
+            proxies = coord.registered()
+            registered = {p.id for p in proxies}
             missing = [g for g in guardian_ids if g not in registered]
-            decryption = Decryption(group, init, coord.proxies, missing,
+            decryption = Decryption(group, init, proxies, missing,
                                     dlog)
             decrypted = decryption.decrypt(tally_result.encrypted_tally)
             out.decryption_result = DecryptionResult(

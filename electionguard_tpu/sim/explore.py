@@ -50,6 +50,13 @@ class SimReport:
     #: (class, detail) the defenses recorded for the run
     fired: list[tuple] = field(default_factory=list)
     detections: list[tuple] = field(default_factory=list)
+    #: race detector output (run_sim(race=True)): one dict per distinct
+    #: report (kind, var, access pair, sites, locksets), plus the
+    #: scheduler strategy that produced this schedule and the raw
+    #: instrumentation event count (the bench overhead denominator)
+    races: list[dict] = field(default_factory=list)
+    strategy: str = "random"
+    race_events: int = 0
 
     def schedule_json(self) -> str:
         return schedule_mod.to_json(self.schedule)
@@ -59,6 +66,7 @@ class SimReport:
         return (f"seed={self.seed} {state} events={self.events} "
                 f"t={self.virtual_s:.1f}s faults={len(self.schedule)} "
                 f"attacks={len(self.fired)}"
+                + (f" races={len(self.races)}" if self.races else "")
                 + ("" if self.ok else f" violations={self.violations}"))
 
 
@@ -71,7 +79,9 @@ def run_sim(seed: int,
             schedule: Optional[list[schedule_mod.FaultEvent]] = None,
             plant: Sequence[str] = (),
             config: Optional[cluster.SimConfig] = None,
-            adversaries: bool = False) -> SimReport:
+            adversaries: bool = False,
+            race: bool = False,
+            strategy: Optional[str] = None) -> SimReport:
     """One deterministic run of the full virtual-cluster workflow."""
     cfg = config or cluster.SimConfig()
     if schedule is None:
@@ -79,7 +89,14 @@ def run_sim(seed: int,
         if adversaries:
             schedule = schedule + schedule_mod.generate_adversary_schedule(
                 _stream(seed, 5))
-    sched = SimScheduler(seed=seed * 8 + 2, horizon=cfg.horizon)
+    race = race or knobs.get_flag("EGTPU_RACE")
+    strategy = strategy or knobs.get_str("EGTPU_SIM_STRATEGY")
+    # PCT draws (priorities + change points) live on their own stream
+    # (6) so strategy choice perturbs no honest stream
+    sched = SimScheduler(seed=seed * 8 + 2, horizon=cfg.horizon,
+                         strategy=strategy,
+                         pct_depth=knobs.get_int("EGTPU_SIM_PCT_DEPTH"),
+                         pct_rng=_stream(seed, 6))
     net = schedule_mod.net_model(schedule, _stream(seed, 3))
     transport = SimTransport(sched, net)
     plan = schedule_mod.to_fault_plan(schedule)
@@ -92,6 +109,19 @@ def run_sim(seed: int,
 
     def _on_reject(cls: str, detail: str) -> None:
         out.detections.append((cls, detail))
+
+    monitor = None
+    inst = None
+    if race:
+        from electionguard_tpu.analysis import race as race_mod
+        from electionguard_tpu.analysis import race_instrument
+        monitor = race_mod.RaceMonitor(sched)
+        # the planted-race probe rides along whenever the monitor is on
+        # (idle unless a race-* plant spawns its tasks)
+        inst = race_instrument.install(
+            monitor,
+            extra=[(cluster.RaceProbeBox, ("shared",),
+                    ("_lock_a", "_lock_b"))])
 
     prev_uniform = rpc_util._uniform
     clock_mod.install(SimClock(sched))
@@ -109,6 +139,8 @@ def run_sim(seed: int,
     except Exception as e:                # noqa: BLE001 - becomes a verdict
         out.workflow_error = repr(e)
     finally:
+        if inst is not None:
+            inst.uninstall()
         rpc_util._uniform = prev_uniform
         errors.unlisten(_on_reject)
         adversary.clear()
@@ -118,6 +150,8 @@ def run_sim(seed: int,
         shutil.rmtree(workdir, ignore_errors=True)
     out.task_errors = sched.task_errors()
     out.fired = list(adv_plan.fired)
+    if monitor is not None:
+        out.races = list(monitor.races)
     violations = oracle.check(out)
     return SimReport(seed=seed, ok=not violations, violations=violations,
                      trace_hash=sched.trace_hash(),
@@ -125,16 +159,22 @@ def run_sim(seed: int,
                      schedule=list(schedule),
                      injected=list(plan.injected),
                      fired=list(out.fired),
-                     detections=list(out.detections))
+                     detections=list(out.detections),
+                     races=[r.to_dict() for r in out.races],
+                     strategy=strategy,
+                     race_events=monitor.events if monitor else 0)
 
 
 def explore(seeds: Sequence[int],
             config: Optional[cluster.SimConfig] = None,
             plant: Sequence[str] = (),
-            adversaries: bool = False) -> list[SimReport]:
+            adversaries: bool = False,
+            race: bool = False,
+            strategy: Optional[str] = None) -> list[SimReport]:
     """Run every seed; returns all reports (callers filter failures)."""
     return [run_sim(s, config=config, plant=plant,
-                    adversaries=adversaries) for s in seeds]
+                    adversaries=adversaries, race=race,
+                    strategy=strategy) for s in seeds]
 
 
 def default_seeds() -> list[int]:

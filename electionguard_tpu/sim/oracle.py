@@ -18,6 +18,11 @@ the class.
 * ``liveness``         — the workflow ran to completion inside the
   virtual-time horizon with no deadlock and no task crash (reported by
   the run framework via ``liveness_error`` / ``workflow_error``);
+* ``race``             — no unwaived data race reported by the dynamic
+  happens-before/lockset monitor when the run had it attached
+  (``run_sim(race=True)``); waivers live in
+  ``analysis/race_waivers.json`` (ships empty, every entry needs a
+  note);
 * ``soundness``        — every in-protocol attack that actually fired
   (``outcome.fired``, the adversary plan's audit log) was DETECTED: an
   in-band rejection carrying one of the attack's expected named error
@@ -61,13 +66,25 @@ def check(outcome) -> list[str]:
         if not v and not sound_abort:
             v.append("liveness: run ended before the workflow completed")
         v.extend(_soundness(outcome, detections))
+        v.extend(_races(outcome))
         return v  # downstream oracles need the full artifacts
     v.extend(_no_ballot_lost(outcome))
     v.extend(_chain_contiguous(outcome))
     v.extend(_verifier_green(outcome))
     v.extend(_quorum_tally(outcome))
     v.extend(_soundness(outcome, detections))
+    v.extend(_races(outcome))
     return v
+
+
+def _races(o) -> list[str]:
+    reports = getattr(o, "races", ())
+    if not reports:
+        return []
+    from electionguard_tpu.analysis import race as race_mod
+    waivers = race_mod.load_waivers()
+    return [f"race: {r.summary()}" for r in reports
+            if not race_mod.waived(r, waivers)]
 
 
 def _error_classes(o) -> set[str]:

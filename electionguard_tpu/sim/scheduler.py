@@ -34,14 +34,16 @@ import threading
 from typing import Callable, Optional
 
 from electionguard_tpu.utils import clock as clock_mod
+from electionguard_tpu.utils import knobs
 
 #: virtual seconds a condition-variable wait parks before rechecking its
 #: predicate (Condition has no pollable state, so the sim quantizes it)
 CV_QUANTUM = 0.005
 
-#: real seconds the scheduler waits for the running task to yield before
-#: declaring it stuck outside the clock seam (native block / real bug)
-WATCHDOG_S = 60.0
+#: PCT draws its priority change points from [1, PCT_STEPS); runs longer
+#: than this many dispatches keep the last assigned priorities (the PCT
+#: guarantee is over the first k steps — this is the k estimate)
+PCT_STEPS = 4096
 
 _NEW, _READY, _RUNNING, _PARKED, _DONE = range(5)
 
@@ -89,11 +91,24 @@ class _Task:
 class SimScheduler:
     """One simulated run: spawn tasks, ``run(main)``, read the trace."""
 
-    def __init__(self, seed: int, horizon: float = 600.0):
+    def __init__(self, seed: int, horizon: float = 600.0,
+                 strategy: str = "random", pct_depth: int = 3,
+                 pct_rng: Optional[random.Random] = None):
+        if strategy not in ("random", "pct"):
+            raise ValueError(f"unknown sim strategy {strategy!r}")
         self.rng = random.Random(seed)
         self.horizon = horizon
+        #: real seconds the running task may go without yielding before
+        #: the liveness watchdog declares it stuck outside the clock
+        #: seam; sweep drivers raise it so cold jit compiles under CPU
+        #: contention are not misdiagnosed as deadlocks
+        self.watchdog_s = knobs.get_float("EGTPU_SIM_WATCHDOG_S")
         self.now = 0.0
         self.trace: list[tuple[int, str, str]] = []
+        self.strategy = strategy
+        #: the race monitor's hook sink (``analysis/race.py``); None when
+        #: race detection is off — hooks then cost one attribute load
+        self.monitor = None
         self._tasks: list[_Task] = []
         self._by_ident: dict[int, _Task] = {}
         self._lock = threading.Lock()
@@ -101,6 +116,17 @@ class SimScheduler:
         self._seq = 0
         self._running: Optional[_Task] = None
         self._finishing = False
+        # PCT (probabilistic concurrency testing): random per-task
+        # priorities + depth-1 priority change points at random steps;
+        # dispatch always picks the highest-priority runnable task.  Own
+        # RNG stream so fault/net streams stay strategy-independent.
+        self._pct_rng = pct_rng or random.Random(seed ^ 0x9E3779B9)
+        self._prio: dict[int, float] = {}
+        self._change_points = sorted(
+            self._pct_rng.randrange(1, PCT_STEPS)
+            for _ in range(max(1, pct_depth) - 1))
+        self._demotions = 0
+        self._step = 0
 
     # ---- trace -------------------------------------------------------
     def event(self, kind: str, detail: str = "") -> None:
@@ -116,10 +142,14 @@ class SimScheduler:
     def spawn(self, name: str, fn: Callable[[], None],
               node: Optional[str] = None) -> None:
         """Create a task; it becomes runnable at the next dispatch."""
+        parent = self._current()
         with self._lock:
             task = _Task(name, node or name, self._seq, fn)
             self._seq += 1
             self._tasks.append(task)
+        self._prio[task.seq] = self._pct_rng.random()
+        if self.monitor is not None:
+            self.monitor.on_spawn(parent, task)
         task.thread = threading.Thread(
             target=self._task_body, args=(task,), name=f"sim:{name}",
             daemon=True)
@@ -136,6 +166,9 @@ class SimScheduler:
             self._seq += 1
             task.adopted = True
             self._tasks.append(task)
+        self._prio[task.seq] = self._pct_rng.random()
+        if self.monitor is not None:
+            self.monitor.on_spawn(parent, task)
         orig_run = thread.run
 
         def run():
@@ -152,6 +185,9 @@ class SimScheduler:
                 if not task.killed:
                     task.error = e
             finally:
+                mon = self.monitor
+                if mon is not None:
+                    mon.on_finish(task)
                 task.state = _DONE
                 self._wake.set()
 
@@ -171,12 +207,21 @@ class SimScheduler:
             if not task.killed:
                 task.error = e
         finally:
+            mon = self.monitor
+            if mon is not None:
+                mon.on_finish(task)
             task.state = _DONE
             self._wake.set()
 
     def _current(self) -> Optional[_Task]:
         with self._lock:
             return self._by_ident.get(threading.get_ident())
+
+    def current_task(self) -> Optional[_Task]:
+        """The sim task running on the calling thread, or None on a
+        foreign thread (the race monitor uses this to drop events that
+        do not belong to any task, e.g. scheduler-thread pred evals)."""
+        return self._current()
 
     def current_node(self) -> str:
         t = self._current()
@@ -205,6 +250,12 @@ class SimScheduler:
                                "(scheduler thread or foreign thread)")
         if task.killed:
             raise TaskKilled()
+        mon = self.monitor
+        if mon is not None:
+            # publish this task's clock into the seam clock before it
+            # parks: anything it did so far happens-before any wait that
+            # succeeds after this point
+            mon.on_yield(task)
         task.pred = pred
         task.wake_at = wake_at
         task.go.clear()
@@ -213,6 +264,10 @@ class SimScheduler:
         task.go.wait()
         if task.killed:
             raise TaskKilled()
+        if mon is not None and pred is not None and task.wait_ok:
+            # a *successful* predicate wait is a synchronization point:
+            # join the seam clock (timeouts and plain sleeps are not)
+            mon.on_wait_ok(task)
         return task.wait_ok
 
     def sleep(self, seconds: float) -> None:
@@ -224,6 +279,11 @@ class SimScheduler:
         expires (False).  The scheduler evaluates the predicate, so no
         context switches burn while it is false."""
         if pred():
+            mon = self.monitor
+            if mon is not None:
+                task = self._current()
+                if task is not None:
+                    mon.on_wait_ok(task)
             return True
         wake_at = None if timeout is None else self.now + max(0.0, timeout)
         return self._yield(pred, wake_at)
@@ -275,8 +335,23 @@ class SimScheduler:
                 self.now = max(self.now, target)
                 continue
             ready.sort(key=lambda t: t.seq)
-            pick = self.rng.choice(ready)
+            if self.strategy == "pct":
+                pick = self._pct_pick(ready)
+            else:
+                pick = self.rng.choice(ready)
             self._dispatch(pick)
+
+    def _pct_pick(self, ready: list[_Task]) -> _Task:
+        """PCT dispatch: highest priority wins; at each change point the
+        current top priority drops below everything assigned so far."""
+        self._step += 1
+        while self._change_points and self._step >= self._change_points[0]:
+            self._change_points.pop(0)
+            top = max(ready,
+                      key=lambda t: (self._prio.get(t.seq, 0.0), -t.seq))
+            self._demotions += 1
+            self._prio[top.seq] = -float(self._demotions)
+        return max(ready, key=lambda t: (self._prio.get(t.seq, 0.0), -t.seq))
 
     def _dispatch(self, task: _Task) -> None:
         # wait_ok tells a pred-parked task whether its predicate held
@@ -291,11 +366,11 @@ class SimScheduler:
         self._wake.clear()
         task.go.set()
         while task.state == _RUNNING:
-            if not self._wake.wait(WATCHDOG_S):
+            if not self._wake.wait(self.watchdog_s):
                 raise SimStuck(
                     f"task {task.name} did not yield within "
-                    f"{WATCHDOG_S:.0f}s real time — blocked outside the "
-                    f"clock seam")
+                    f"{self.watchdog_s:.0f}s real time — blocked outside "
+                    f"the clock seam")
             self._wake.clear()
 
     def _finish_all(self) -> None:
@@ -315,7 +390,7 @@ class SimScheduler:
                 self._wake.clear()
                 t.go.set()
                 while t.state == _RUNNING:
-                    if not self._wake.wait(WATCHDOG_S):
+                    if not self._wake.wait(self.watchdog_s):
                         raise SimStuck(
                             f"task {t.name} stuck during unwind")
                     self._wake.clear()

@@ -40,14 +40,19 @@ def shrink(seed: int,
            plant: Sequence[str] = (),
            config: Optional[cluster.SimConfig] = None,
            oracle_classes: Optional[frozenset[str]] = None,
-           budget: Optional[int] = None) -> ShrinkResult:
+           budget: Optional[int] = None,
+           race: bool = False,
+           strategy: Optional[str] = None) -> ShrinkResult:
     """Minimize ``schedule`` while a violation of the same oracle class
     persists under ``run_sim(seed, candidate)``.
 
     ``oracle_classes`` defaults to the classes the full schedule
     violates (so the shrinker cannot wander onto an unrelated failure);
     ``budget`` caps the number of probe runs
-    (``EGTPU_SIM_SHRINK_BUDGET``).
+    (``EGTPU_SIM_SHRINK_BUDGET``).  ``race``/``strategy`` replay with
+    the race monitor attached under the same scheduler strategy, so a
+    ``race:`` violation reproduces during probes (its oracle class is
+    ``race`` like any other).
     """
     from electionguard_tpu.sim.explore import run_sim   # avoid cycle
     from electionguard_tpu.utils import knobs
@@ -60,7 +65,7 @@ def shrink(seed: int,
         nonlocal runs
         runs += 1
         report = run_sim(seed, schedule=candidate, plant=plant,
-                         config=config)
+                         config=config, race=race, strategy=strategy)
         hits = [v for v in report.violations
                 if oracle_classes is None
                 or _oracle_class(v) in oracle_classes]
@@ -73,6 +78,15 @@ def shrink(seed: int,
     if oracle_classes is None:
         oracle_classes = frozenset(_oracle_class(v) for v in base)
         base = [v for v in base if _oracle_class(v) in oracle_classes]
+
+    # trivial minimum first: a violation that reproduces with NO faults
+    # (typical for races — the interleaving is the bug) short-circuits
+    # the whole ddmin descent with the truly minimal repro
+    if schedule:
+        hits = failing([])
+        if hits:
+            return ShrinkResult(schedule=[], violations=hits, runs=runs,
+                                history=[(runs, 0)])
 
     current = list(schedule)
     violations = base

@@ -155,6 +155,12 @@ class SimServer:
     def start(self) -> None:
         self.up = True
         self.transport.sched.event("server-up", f"{self.node}:{self.port}")
+        # race-monitor HB edge: starting a server publishes its handlers
+        # (and everything they captured — metrics counters, impl state)
+        # to every future dispatcher, same as ``grpc.Server.start()``
+        mon = self.transport.sched.monitor
+        if mon is not None:
+            mon.on_publish(("server", self.port))
 
     def stop(self, grace=None) -> threading.Event:
         if self.up:
@@ -250,11 +256,21 @@ class SimTransport:
                               f"sim port {port} not serving")
         self.sched.event("rpc", f"{src}->{srv.node}:{port} {method}")
         self._stack().append(srv)
+        # race-monitor context: the handler runs inline on the sender's
+        # task (the send→receive HB edge is program order by
+        # construction); tagging the span lets race reports name the
+        # rpc a racy access ran under
+        mon = self.sched.monitor
+        if mon is not None:
+            mon.on_subscribe(("server", port))
+            mon.rpc_begin(f"{srv.node}:{method}")
         try:
             return srv.dispatch(path, request_bytes, src)
         except _Abort as a:
             raise SimRpcError(a.code, a.details) from None
         finally:
+            if mon is not None:
+                mon.rpc_end()
             self._stack().pop()
 
 

@@ -141,6 +141,15 @@ KNOBS: tuple[Knob, ...] = (
     Knob("EGTPU_PROFILE", "path", None,
          "JAX profiler trace dir, one subdir per workflow phase "
          "(utils.profile_phase)."),
+    Knob("EGTPU_RACE", "flag", None,
+         "Enable the dynamic race detector on every sim run: guarded "
+         "attribute accesses are instrumented and checked by the "
+         "happens-before + lockset monitor (sim/explore; "
+         "analysis/race)."),
+    Knob("EGTPU_RACE_WATCH", "str", "",
+         "Extra race-monitor targets beyond ANALYSIS_GUARDS.json: "
+         "'pkg.mod:Class=attr1+attr2;pkg.other:Cls=attr' "
+         "(analysis/race_instrument)."),
     Knob("EGTPU_RPC_CONNECT_WINDOW", "float", "5.0",
          "Max seconds one wait_for_ready retry may block "
          "(remote/rpc_util)."),
@@ -176,15 +185,30 @@ KNOBS: tuple[Knob, ...] = (
     Knob("EGTPU_SIM_HORIZON", "float", "600.0",
          "Virtual-time horizon for one deterministic simulation run, "
          "seconds; exceeding it is a liveness violation (sim/cluster)."),
+    Knob("EGTPU_SIM_PCT_DEPTH", "int", "3",
+         "PCT bug depth d under EGTPU_SIM_STRATEGY=pct: d-1 priority "
+         "change points are drawn per run (sim/explore; "
+         "sim/scheduler)."),
     Knob("EGTPU_SIM_SEED", "int", "0",
          "First seed of the default simulation sweep range "
          "(sim/explore; tools/sim_matrix)."),
     Knob("EGTPU_SIM_SEEDS", "int", "20",
          "Seed count of the default simulation sweep range "
          "(sim/explore; tools/sim_matrix)."),
+    Knob("EGTPU_SIM_WATCHDOG_S", "float", "60.0",
+         "Real-time seconds a sim task may run without yielding before "
+         "the liveness watchdog declares it stuck; sweep drivers raise "
+         "it so cold jit compiles under CPU contention are not "
+         "misdiagnosed as deadlocks (sim/scheduler; tools/race_matrix "
+         "sets 300 for its workers)."),
     Knob("EGTPU_SIM_SHRINK_BUDGET", "int", "60",
          "Max probe runs the failing-schedule shrinker may spend "
          "(sim/shrink)."),
+    Knob("EGTPU_SIM_STRATEGY", "str", "random",
+         "Scheduler exploration strategy: 'random' (uniform over "
+         "runnable tasks) or 'pct' (priority-based probabilistic "
+         "concurrency testing, own RNG stream) (sim/explore; "
+         "sim/scheduler)."),
     Knob("EGTPU_TABLE_CACHE", "path", None,
          "On-disk cache dir for host-precomputed setup tables (NttCtx "
          "constants, PowRadix tables), keyed by group fingerprint; "

@@ -1,0 +1,303 @@
+"""Race-detector tests: monitor invisibility, planted-fixture
+detection at exact access pairs, false-positive guards, PCT replay
+from the RACE_RESULTS.json repro, the guards drift gate, and the
+tier-1 in-process detector sweep over the fault/adversary suites.
+
+Trace hashes are compared INSIDE one process only (see test_sim.py's
+module docstring: the event-trace hash is seed-deterministic but
+PYTHONHASHSEED-sensitive across processes), so the artifact replay
+test re-derives its own baseline hash instead of trusting the one
+recorded by another process.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from electionguard_tpu.analysis import race as race_mod
+from electionguard_tpu.analysis import race_instrument
+from electionguard_tpu.sim.cluster import SimConfig
+from electionguard_tpu.sim.explore import run_sim
+from electionguard_tpu.sim.schedule import from_json
+from electionguard_tpu.sim.shrink import shrink
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAST = SimConfig(n_mix_stages=1)
+
+
+def _pairs(report):
+    """(kind, var, prior task, current task) for every race found."""
+    return [(d["kind"], d["var"], d["prior"]["task"], d["current"]["task"])
+            for d in report.races]
+
+
+# ------------------------------------------------------------ invisibility
+
+def test_monitor_is_schedule_invisible():
+    """The central invariant: attaching the monitor changes NOTHING
+    about the execution — same trace hash bit-for-bit — because it adds
+    no yield points and never touches the honest RNG streams."""
+    plain = run_sim(0, config=FAST)
+    raced = run_sim(0, config=FAST, race=True)
+    assert plain.ok and raced.ok
+    assert raced.trace_hash == plain.trace_hash
+    assert raced.race_events > 0          # it did actually watch
+    assert plain.race_events == 0
+
+
+def test_race_off_run_reports_no_monitor_state():
+    r = run_sim(1, config=FAST)
+    assert r.races == [] and r.race_events == 0
+
+
+# ------------------------------------------------------- planted fixtures
+
+@pytest.mark.race
+def test_planted_hb_race_detected_at_exact_pair():
+    """race-hb: two sleep-ordered lock-free writers.  Sleeps create no
+    HB edge, so the FastTrack detector must fire on RaceProbeBox.shared
+    naming both planted tasks at their write site."""
+    r = run_sim(3, plant=("race-hb",), config=FAST, race=True,
+                strategy="pct")
+    hb = [d for d in r.races
+          if d["kind"] == "hb" and d["var"] == "RaceProbeBox.shared"]
+    assert hb, f"HB detector missed the planted race: {_pairs(r)}"
+    tasks = {d["prior"]["task"] for d in hb} | {d["current"]["task"]
+                                               for d in hb}
+    assert tasks == {"race-hb-1", "race-hb-2"}
+    for d in hb:
+        assert d["prior"]["site"].endswith(":go")
+        assert d["current"]["site"].endswith(":go")
+        assert "sim/cluster.py" in d["current"]["site"]
+    assert any(v.startswith("race: hb") for v in r.violations)
+
+
+@pytest.mark.race
+def test_planted_lockset_race_is_lockset_only():
+    """race-lockset: every access locked and every pair HB-ordered by
+    an event handoff, but under DIFFERENT locks — only the lockset
+    heuristic can see it (and HB must stay quiet: the handoffs order
+    the accesses in this schedule)."""
+    r = run_sim(3, plant=("race-lockset",), config=FAST, race=True,
+                strategy="pct")
+    kinds = {d["kind"] for d in r.races}
+    assert kinds == {"lockset"}, f"expected lockset only, got {_pairs(r)}"
+    d = next(d for d in r.races if d["var"] == "RaceProbeBox.shared")
+    sides = {d["prior"]["site"].rsplit(":", 1)[-1],
+             d["current"]["site"].rsplit(":", 1)[-1]}
+    assert sides == {"ls_first", "ls_second"}
+    locks = set(d["prior"]["locks"]) | set(d["current"]["locks"])
+    assert locks == {"RaceProbeBox._lock_a", "RaceProbeBox._lock_b"}
+
+
+@pytest.mark.race
+def test_message_passing_handoff_stays_green():
+    """race-handoff: lock-free write, Event set, lock-free read — legal
+    publication.  The false-positive guard for both detectors (the
+    seam-wait HB edge orders the pair; the Eraser ownership transfer
+    keeps the lockset heuristic quiet)."""
+    r = run_sim(3, plant=("race-handoff",), config=FAST, race=True,
+                strategy="pct")
+    assert r.ok, r.violations
+    assert r.races == [], f"false positive: {_pairs(r)}"
+
+
+@pytest.mark.race
+def test_planted_race_shrinks_to_empty_schedule():
+    """ddmin minimality: the planted race needs no faults at all, so
+    the minimized repro is the EMPTY schedule — just the racing pair."""
+    r = run_sim(3, plant=("race-hb",), config=FAST, race=True,
+                strategy="pct")
+    assert not r.ok
+    res = shrink(3, r.schedule, plant=("race-hb",), config=FAST,
+                 oracle_classes=frozenset(["race"]), race=True,
+                 strategy="pct")
+    assert res.schedule == []
+    assert any("RaceProbeBox.shared" in v for v in res.violations)
+
+
+# ------------------------------------------------------------ PCT strategy
+
+def test_pct_is_deterministic_and_distinct_from_random():
+    """Same seed + pct replays bit-for-bit; the PCT priority schedule
+    dispatches differently from the uniform-random strategy."""
+    a = run_sim(5, config=FAST, strategy="pct")
+    b = run_sim(5, config=FAST, strategy="pct")
+    assert a.ok and b.ok
+    assert a.trace_hash == b.trace_hash
+    c = run_sim(5, config=FAST, strategy="random")
+    assert c.trace_hash != a.trace_hash
+
+
+@pytest.mark.race
+def test_pct_replay_from_race_results_repro():
+    """The RACE_RESULTS.json selftest repro is sufficient to replay:
+    same seed + strategy + shrunk schedule + plant reproduce the same
+    race pair, bit-for-bit across two in-process runs."""
+    path = os.path.join(REPO_ROOT, "RACE_RESULTS.json")
+    assert os.path.exists(path), "run python tools/race_matrix.py --json"
+    doc = json.load(open(path))
+    entry = doc["selftest"]["race-hb"]
+    config = FAST if doc["profile"] == "fast" else SimConfig()
+    sched = from_json(json.dumps(entry["shrunk_schedule"]))
+    a = run_sim(entry["seed"], schedule=sched, plant=(entry["plant"],),
+                config=config, race=True, strategy=entry["strategy"])
+    b = run_sim(entry["seed"], schedule=sched, plant=(entry["plant"],),
+                config=config, race=True, strategy=entry["strategy"])
+    assert a.trace_hash == b.trace_hash          # bit-for-bit replay
+    assert [d["var"] for d in a.races] == [d["var"] for d in b.races]
+    got = {(d["kind"], d["var"]) for d in a.races}
+    assert ("hb", "RaceProbeBox.shared") in got
+    # the recorded violations name the same access pair
+    assert any("RaceProbeBox.shared" in v
+               for v in entry["shrunk_violations"])
+
+
+def test_race_results_artifact_is_green():
+    """The committed sweep artifact: every run green, no failures, the
+    waiver baseline empty, the selftest fixtures all detected."""
+    doc = json.load(open(os.path.join(REPO_ROOT, "RACE_RESULTS.json")))
+    assert doc["failed"] == 0 and doc["failures"] == []
+    assert doc["ok"] == doc["runs"]
+    assert doc["races_distinct"] == 0
+    assert doc["waivers"] == 0
+    assert doc["selftest"]["ok"]
+    for plant in ("race-hb", "race-lockset", "race-handoff"):
+        assert doc["selftest"][plant]["ok"], plant
+
+
+# -------------------------------------------------------------- regressions
+
+@pytest.mark.race
+def test_fixed_races_stay_fixed_seed0():
+    """Pinned regressions for the two access pairs the first sweep
+    surfaced (both at seed 0 / random):
+
+    * lockset w/r ``DecryptionCoordinator.proxies`` — ``ready()``'s
+      lock-held read vs the sim driver's lock-free ``coord.proxies``
+      read; fixed by the ``registered()`` snapshot accessor.
+    * hb w/r ``Counter._v`` — ``_observe_server``'s counter built
+      under ``MetricsRegistry._lock`` vs a remote task's ``inc()``;
+      fixed by the server start→dispatch HB edge (real gRPC publishes
+      handlers at ``start()``).
+    """
+    r = run_sim(0, config=FAST, race=True, strategy="random")
+    assert r.ok, r.violations
+    racy_vars = {d["var"] for d in r.races}
+    assert "DecryptionCoordinator.proxies" not in racy_vars
+    assert "Counter._v" not in racy_vars
+    assert not r.races, f"new race appeared: {_pairs(r)}"
+
+
+def test_registered_snapshots_under_lock():
+    """The proxies fix itself: ``registered()`` returns a copy, not the
+    live list registration handlers mutate under ``_lock``."""
+    import threading
+    from electionguard_tpu.remote.decrypting_remote import (
+        DecryptionCoordinator)
+    coord = DecryptionCoordinator.__new__(DecryptionCoordinator)
+    coord._lock = threading.Lock()
+    coord.proxies = [1, 2]
+    snap = coord.registered()
+    assert snap == [1, 2] and snap is not coord.proxies
+
+
+# ------------------------------------------------------------------ waivers
+
+def test_waiver_baseline_ships_empty():
+    assert race_mod.load_waivers() == []
+
+
+def test_waivers_require_notes(tmp_path):
+    p = tmp_path / "w.json"
+    p.write_text(json.dumps(
+        {"waivers": [{"var": "X.y", "kind": "hb"}]}))
+    with pytest.raises(ValueError, match="no note"):
+        race_mod.load_waivers(str(p))
+    p.write_text(json.dumps(
+        {"waivers": [{"var": "X.y", "note": "known benign"}]}))
+    (w,) = race_mod.load_waivers(str(p))
+    rep = race_mod.RaceReport(
+        kind="hb", var="X.y", pair="w/w",
+        prior=race_mod.RaceSide("a", "write", "f:1"),
+        current=race_mod.RaceSide("b", "write", "f:2"), vtime=0.0)
+    assert race_mod.waived(rep, [w])          # kind defaults to "*"
+    rep2 = race_mod.RaceReport(
+        kind="hb", var="Other.z", pair="w/w",
+        prior=rep.prior, current=rep.current, vtime=0.0)
+    assert not race_mod.waived(rep2, [w])
+
+
+def test_watch_knob_parses_targets():
+    got = race_instrument.parse_watch("pkg.mod:Cls=a+b;other.mod:K=x")
+    assert got == [
+        {"module": "pkg.mod", "class": "Cls", "lock_attrs": [],
+         "guarded": ["a", "b"]},
+        {"module": "other.mod", "class": "K", "lock_attrs": [],
+         "guarded": ["x"]}]
+    with pytest.raises(ValueError, match="bad EGTPU_RACE_WATCH"):
+        race_instrument.parse_watch("no-equals-sign")
+
+
+# ----------------------------------------------------------- guards drift
+
+def test_analysis_guards_artifact_in_sync():
+    """ANALYSIS_GUARDS.json is generated from the lock-discipline
+    pass's inferred guarded-attribute sets; the committed artifact must
+    match a fresh inference (same gate pattern as ENV_KNOBS.md)."""
+    from electionguard_tpu.analysis import core, lock_discipline
+    path = os.path.join(REPO_ROOT, "ANALYSIS_GUARDS.json")
+    assert os.path.exists(path), \
+        "run python tools/eglint.py --write-guards"
+    committed = open(path).read()
+    fresh = lock_discipline.render_guards(core.Project())
+    assert committed == fresh, (
+        "ANALYSIS_GUARDS.json drifted from the lock-discipline "
+        "inference: run python tools/eglint.py --write-guards")
+
+
+# ------------------------------------------------- tier-1 detector sweeps
+
+@pytest.mark.race
+@pytest.mark.parametrize("strategy", ["random", "pct"])
+def test_detector_sweep_fault_suite(strategy):
+    """Tier-1 gate: the detector over the in-process fault suite (the
+    generated per-seed fault schedules) finds no unwaived race under
+    either exploration strategy."""
+    for seed in range(4):
+        r = run_sim(seed, config=FAST, race=True, strategy=strategy)
+        assert r.ok, f"seed {seed}/{strategy}: {r.violations}"
+        assert not r.races, (f"seed {seed}/{strategy} raced: "
+                             f"{_pairs(r)}")
+
+
+@pytest.mark.race
+def test_detector_sweep_adversary_suite():
+    """Tier-1 gate: same, with the Byzantine adversary corpus composed
+    into the runs (stream 5)."""
+    for seed in (0, 1, 2):
+        r = run_sim(seed, config=FAST, adversaries=True, race=True,
+                    strategy="pct")
+        assert r.ok, f"adversary seed {seed}: {r.violations}"
+        assert not r.races, f"adversary seed {seed}: {_pairs(r)}"
+
+
+@pytest.mark.race
+@pytest.mark.slow
+def test_wide_race_sweep_subprocess(tmp_path):
+    """The wide sweep via the actual CLI (fresh process, selftest
+    included): a RACE_RESULTS-shaped artifact with zero failures."""
+    artifact = tmp_path / "race_results.json"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "race_matrix.py"),
+         "--seeds", "12", "--fast", "--json", str(artifact)],
+        capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(artifact.read_text())
+    assert doc["failed"] == 0 and doc["ok"] == doc["runs"] == 24
+    assert doc["selftest"]["ok"]

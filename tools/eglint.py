@@ -8,6 +8,7 @@ Usage::
     python tools/eglint.py --json          # also write ANALYSIS.json
     python tools/eglint.py --rule secret-taint --rule raw-channel
     python tools/eglint.py --write-knobs   # regenerate ENV_KNOBS.md
+    python tools/eglint.py --write-guards  # regenerate ANALYSIS_GUARDS.json
 
 Findings are suppressed either inline (``# eglint: disable=RULE`` on
 the offending line) or via ``electionguard_tpu/analysis/baseline.json``
@@ -49,12 +50,26 @@ def main(argv=None) -> int:
     ap.add_argument("--write-knobs", action="store_true",
                     help="regenerate ENV_KNOBS.md from utils/knobs.py "
                          "and exit")
+    ap.add_argument("--write-guards", action="store_true",
+                    help="regenerate ANALYSIS_GUARDS.json (the "
+                         "lock-discipline guard sets that seed the "
+                         "dynamic race monitor) and exit")
     args = ap.parse_args(argv)
 
     if args.write_knobs:
         out = os.path.join(REPO_ROOT, "ENV_KNOBS.md")
         with open(out, "w") as f:
             f.write(knobs.render_table())
+        print(f"wrote {os.path.relpath(out)}")
+        return 0
+
+    if args.write_guards:
+        from electionguard_tpu.analysis import lock_discipline
+        project = core.Project(package_dir=args.package) if args.package \
+            else core.Project()
+        out = os.path.join(REPO_ROOT, "ANALYSIS_GUARDS.json")
+        with open(out, "w") as f:
+            f.write(lock_discipline.render_guards(project))
         print(f"wrote {os.path.relpath(out)}")
         return 0
 

@@ -1,0 +1,313 @@
+#!/usr/bin/env python3
+"""race_matrix — the dynamic race-detector seed sweep runner.
+
+Usage::
+
+    python tools/race_matrix.py --seeds 20             # quick sweep
+    python tools/race_matrix.py --seeds 200 --json     # + RACE_RESULTS.json
+    python tools/race_matrix.py --seeds 200 --procs 8
+    python tools/race_matrix.py --adversaries          # attack matrix too
+
+Each seed runs the full virtual-cluster workflow TWICE with the
+happens-before + lockset monitor attached (``run_sim(race=True)``):
+once under the default uniform-random scheduler and once under PCT
+(priority-based probabilistic concurrency testing, own RNG stream), so
+rare interleavings get systematically explored.  Every oracle still
+runs — a ``race:`` violation is an oracle class like any other — and
+failing seeds are ddmin-shrunk (race-aware probes replay with the same
+strategy) to minimal replayable schedules; a race that reproduces with
+NO faults shrinks to the empty schedule, leaving just the racing task
+pair.
+
+The sweep also runs the detector's self-test fixtures (``race-hb``,
+``race-lockset``, ``race-handoff`` plants) and asserts: the HB
+detector and the lockset heuristic each fire at their exact planted
+access pair, the handoff guard stays green, and a same-seed rerun is
+bit-for-bit identical (trace hash).  The fixture repros land in the
+artifact so tests can replay them.
+
+``--json`` writes the tracked RACE_RESULTS.json artifact.  Trace
+hashes are deterministic per process; to compare across processes pin
+PYTHONHASHSEED.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import asdict
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(), "egtpu-jax-cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
+# instrumented runs + N-way cold jit compiles contend for the CPU; a
+# slow first run is not a deadlock (workers inherit this)
+os.environ.setdefault("EGTPU_SIM_WATCHDOG_S", "300")
+
+STRATEGIES = ("random", "pct")
+
+#: fixed coordinates of the self-test fixture runs recorded in the
+#: artifact (tests replay these bit-for-bit)
+SELFTEST_SEED = 3
+
+
+def _config(fast: bool):
+    from electionguard_tpu.sim.cluster import SimConfig
+    return SimConfig(n_mix_stages=1) if fast else SimConfig()
+
+
+def _sweep(start: int, count: int, fast: bool,
+           shrink_budget: int | None, adversaries: bool = False) -> dict:
+    """Race-sweep seeds [start, start+count) in THIS process."""
+    from electionguard_tpu.sim.explore import run_sim
+    from electionguard_tpu.sim.shrink import shrink
+
+    cfg = _config(fast)
+    ok = 0
+    runs = 0
+    events_total = 0
+    failures = []
+    races: dict[str, dict] = {}
+    for seed in range(start, start + count):
+        for strategy in STRATEGIES:
+            r = run_sim(seed, config=cfg, adversaries=adversaries,
+                        race=True, strategy=strategy)
+            runs += 1
+            events_total += r.race_events
+            for d in r.races:
+                key = (f"{d['kind']} {d['pair']} {d['var']} "
+                       f"{d['prior']['site']} vs {d['current']['site']}")
+                e = races.setdefault(key, {"n": 0, "first": None,
+                                           "report": d})
+                e["n"] += 1
+                if e["first"] is None:
+                    e["first"] = {"seed": seed, "strategy": strategy}
+            if r.ok:
+                ok += 1
+                continue
+            entry = {
+                "seed": seed,
+                "strategy": strategy,
+                "violations": r.violations,
+                "schedule": [asdict(e) for e in r.schedule],
+                "trace_hash": r.trace_hash,
+            }
+            if r.schedule:
+                res = shrink(seed, r.schedule, config=cfg,
+                             budget=shrink_budget, race=True,
+                             strategy=strategy)
+                entry["shrunk_schedule"] = [asdict(e)
+                                            for e in res.schedule]
+                entry["shrunk_violations"] = res.violations
+                entry["shrink_runs"] = res.runs
+            failures.append(entry)
+            print(f"FAIL {r.summary()}", file=sys.stderr)
+    return {"ok": ok, "runs": runs, "failures": failures,
+            "events_total": events_total, "races": races}
+
+
+def _sweep_procs(start: int, count: int, procs: int, fast: bool,
+                 shrink_budget: int | None,
+                 adversaries: bool = False) -> dict:
+    """Shard the seed range over worker subprocesses, merge chunks."""
+    per = (count + procs - 1) // procs
+    jobs = []
+    tmpdir = tempfile.mkdtemp(prefix="egtpu-race-matrix-")
+    for i in range(procs):
+        s = start + i * per
+        n = min(per, start + count - s)
+        if n <= 0:
+            break
+        out = os.path.join(tmpdir, f"chunk-{i}.json")
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--start", str(s), "--seeds", str(n),
+               "--chunk-worker", out]
+        if fast:
+            cmd.append("--fast")
+        if adversaries:
+            cmd.append("--adversaries")
+        if shrink_budget is not None:
+            cmd += ["--shrink-budget", str(shrink_budget)]
+        jobs.append((subprocess.Popen(cmd), out))
+    merged = {"ok": 0, "runs": 0, "failures": [], "events_total": 0,
+              "races": {}}
+    rc = 0
+    for proc, out in jobs:
+        rc |= proc.wait()
+        if os.path.exists(out):
+            chunk = json.load(open(out))
+            merged["ok"] += chunk["ok"]
+            merged["runs"] += chunk["runs"]
+            merged["events_total"] += chunk["events_total"]
+            merged["failures"].extend(chunk["failures"])
+            for key, e in chunk["races"].items():
+                m = merged["races"].setdefault(
+                    key, {"n": 0, "first": e["first"],
+                          "report": e["report"]})
+                m["n"] += e["n"]
+    if rc:
+        raise SystemExit(f"a sweep worker failed (exit {rc})")
+    merged["failures"].sort(key=lambda f: (f["seed"], f["strategy"]))
+    return merged
+
+
+def _selftest(fast: bool, shrink_budget: int | None) -> dict:
+    """Planted-fixture gate: HB and lockset fire at their exact pairs,
+    the handoff guard stays green, repros shrink to minimal schedules,
+    and same-seed reruns are bit-for-bit identical."""
+    from electionguard_tpu.sim.explore import run_sim
+    from electionguard_tpu.sim.shrink import shrink
+
+    cfg = _config(fast)
+    out = {}
+    expect = {
+        "race-hb": ("hb", "RaceProbeBox.shared"),
+        "race-lockset": ("lockset", "RaceProbeBox.shared"),
+        "race-handoff": None,
+    }
+    all_ok = True
+    for plant, want in expect.items():
+        entry = {"plant": plant, "seed": SELFTEST_SEED, "strategy": "pct"}
+        r = run_sim(SELFTEST_SEED, plant=(plant,), config=cfg,
+                    race=True, strategy="pct")
+        r2 = run_sim(SELFTEST_SEED, plant=(plant,), config=cfg,
+                     race=True, strategy="pct")
+        entry["deterministic"] = r.trace_hash == r2.trace_hash
+        if want is None:
+            entry["ok"] = entry["deterministic"] and r.ok
+            entry["races"] = list(r.races)
+        else:
+            kind, var = want
+            hits = [d for d in r.races
+                    if d["kind"] == kind and d["var"] == var]
+            entry["detected"] = bool(hits)
+            entry["races"] = hits
+            res = shrink(SELFTEST_SEED, r.schedule, plant=(plant,),
+                         config=cfg, budget=shrink_budget,
+                         oracle_classes=frozenset(["race"]),
+                         race=True, strategy="pct")
+            rr = run_sim(SELFTEST_SEED, schedule=res.schedule,
+                         plant=(plant,), config=cfg,
+                         race=True, strategy="pct")
+            entry["shrunk_schedule"] = [asdict(e) for e in res.schedule]
+            entry["shrunk_violations"] = res.violations
+            entry["repro_trace_hash"] = rr.trace_hash
+            entry["ok"] = (entry["deterministic"] and bool(hits)
+                           and bool(res.violations))
+        all_ok = all_ok and entry["ok"]
+        print(f"  selftest {plant}: "
+              f"{'ok' if entry['ok'] else 'FAIL'} "
+              f"(deterministic={entry['deterministic']})")
+        out[plant] = entry
+    out["ok"] = all_ok
+    return out
+
+
+def main(argv=None) -> int:
+    from electionguard_tpu.utils import knobs
+
+    ap = argparse.ArgumentParser(
+        prog="race_matrix", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--seeds", type=int, default=None,
+                    help="how many seeds to sweep (default "
+                         "EGTPU_SIM_SEEDS); each seed runs once per "
+                         "strategy (random, pct)")
+    ap.add_argument("--start", type=int,
+                    default=knobs.get_int("EGTPU_SIM_SEED"),
+                    help="first seed")
+    ap.add_argument("--procs", type=int, default=1,
+                    help="worker subprocesses to shard the range over")
+    ap.add_argument("--fast", action="store_true",
+                    help="1 mix stage instead of 2")
+    ap.add_argument("--adversaries", action="store_true",
+                    help="compose the in-protocol attack corpus into "
+                         "every run (stream 5)")
+    ap.add_argument("--shrink-budget", type=int, default=None,
+                    help="probe-run cap per failing-schedule shrink")
+    ap.add_argument("--no-selftest", action="store_true",
+                    help="skip the planted-fixture gate")
+    ap.add_argument("--json", nargs="?", const=os.path.join(
+                        REPO_ROOT, "RACE_RESULTS.json"), default=None,
+                    metavar="PATH",
+                    help="write the sweep artifact (default "
+                         "RACE_RESULTS.json at the repo root)")
+    ap.add_argument("--chunk-worker", metavar="PATH", default=None,
+                    help=argparse.SUPPRESS)   # internal: emit one chunk
+    args = ap.parse_args(argv)
+    if args.seeds is None:
+        args.seeds = knobs.get_int("EGTPU_SIM_SEEDS")
+
+    t0 = time.time()
+    if args.chunk_worker:
+        chunk = _sweep(args.start, args.seeds, args.fast,
+                       args.shrink_budget, args.adversaries)
+        with open(args.chunk_worker, "w") as f:
+            json.dump(chunk, f)
+        return 0
+    if args.procs > 1:
+        merged = _sweep_procs(args.start, args.seeds, args.procs,
+                              args.fast, args.shrink_budget,
+                              args.adversaries)
+    else:
+        merged = _sweep(args.start, args.seeds, args.fast,
+                        args.shrink_budget, args.adversaries)
+    selftest = ({"ok": True, "skipped": True} if args.no_selftest
+                else _selftest(args.fast, args.shrink_budget))
+    wall = time.time() - t0
+
+    n_runs = merged["runs"]
+    result = {
+        "generated_by": "tools/race_matrix.py",
+        "seed_start": args.start,
+        "n_seeds": args.seeds,
+        "strategies": list(STRATEGIES),
+        "adversaries": args.adversaries,
+        "profile": "fast" if args.fast else "default",
+        "procs": args.procs,
+        "runs": n_runs,
+        "ok": merged["ok"],
+        "failed": len(merged["failures"]),
+        "failures": merged["failures"],
+        "races_distinct": len(merged["races"]),
+        "races": {k: merged["races"][k]
+                  for k in sorted(merged["races"])},
+        "monitor_events_total": merged["events_total"],
+        "selftest": selftest,
+        "waivers": 0,   # the baseline ships empty; the gate keeps it so
+        "wall_s": round(wall, 1),
+        "runs_per_s": round(n_runs / wall, 2) if wall else None,
+    }
+    print(f"{merged['ok']}/{n_runs} runs green "
+          f"({args.seeds} seeds x {len(STRATEGIES)} strategies), "
+          f"{len(merged['races'])} distinct races, "
+          f"{merged['events_total']} monitor events, {wall:.1f}s")
+    for key, e in sorted(merged["races"].items()):
+        print(f"  race x{e['n']}: {key} (first seed "
+              f"{e['first']['seed']}/{e['first']['strategy']})")
+    for f in merged["failures"]:
+        shrunk = f.get("shrunk_schedule")
+        print(f"  seed {f['seed']}/{f['strategy']}: "
+              f"{f['violations'][0]}"
+              + (f"  [shrunk to {len(shrunk)} events]"
+                 if shrunk is not None else ""))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(result, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {os.path.relpath(args.json)}")
+    return 1 if (merged["failures"] or not selftest["ok"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
