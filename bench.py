@@ -613,6 +613,18 @@ def run_workload(nballots: int, n_chips: int) -> None:
         RESULT["fabric_error"] = f"{type(e).__name__}: {e}"
     flush_partial()
 
+    # ---- live phase: incremental verifier chunks/s + residual drain -----
+    # the live verification plane's numbers: chunks/s the tailer+fold
+    # sustains while the stream grows, the audit-lag p99 it holds, and
+    # the residual finalize seconds once the election closes — plane
+    # overhead, not modexp, so it pins the tiny group like mixfed/obs
+    try:
+        _bench_live()
+    except Exception as e:  # noqa: BLE001 — diagnostics
+        note(f"live phase failed: {type(e).__name__}: {e}")
+        RESULT["live_error"] = f"{type(e).__name__}: {e}"
+    flush_partial()
+
     # ---- bignum phase: per-backend primitive rates (cios/ntt/pallas) ----
     # the roofline's raw numbers — mulmod/powmod/fixed rows through the
     # shared core.bignum_bench helper, labeled requested-vs-effective.
@@ -643,6 +655,94 @@ def run_workload(nballots: int, n_chips: int) -> None:
             _microbench(g)
         except Exception as e:  # noqa: BLE001 — diagnostics
             note(f"microbench skipped: {type(e).__name__}: {e}")
+
+
+def _bench_live(nballots: int = 64, chunk: int = 8) -> None:
+    """Live verification plane: a 1-guardian tiny election is written
+    ballot-by-ballot through the framed stream while a ``LiveVerifier``
+    tails it — every write is followed by a poll, so the measured tail
+    time is pure plane cost (tailer read, chunk fold, ledger append,
+    checkpoint fsync).  Then the terminal artifacts land and the
+    residual drain + record-level finalize is timed separately: that is
+    the work LEFT at election close, the e2e ``-liveVerify`` <5% gate's
+    denominator."""
+    import shutil
+    import tempfile
+
+    from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+    from electionguard_tpu.core.dlog import DLog
+    from electionguard_tpu.core.group import tiny_group
+    from electionguard_tpu.decrypt.decryption import Decryption
+    from electionguard_tpu.decrypt.trustee import DecryptingTrustee
+    from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+    from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+    from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+    from electionguard_tpu.publish.election_record import (DecryptionResult,
+                                                           ElectionConfig)
+    from electionguard_tpu.publish.publisher import Publisher
+    from electionguard_tpu.tally.accumulate import accumulate_ballots
+    from electionguard_tpu.verify.live import LiveVerifier
+    from electionguard_tpu.workflow.e2e import sample_manifest
+
+    g = tiny_group()
+    manifest = sample_manifest(1, 2)
+    trustees = [KeyCeremonyTrustee(g, "guardian-0", 1, 1)]
+    init = key_ceremony_exchange(trustees, g).make_election_initialized(
+        ElectionConfig(manifest, 1, 1), {"created_by": "bench"})
+    ballots = list(RandomBallotProvider(manifest, nballots,
+                                        seed=3).ballots())
+    encrypted, invalid = BatchEncryptor(init, g).encrypt_ballots(
+        ballots, seed=g.int_to_q(77))
+    assert not invalid
+
+    tally_result = accumulate_ballots(init, encrypted)
+    dec = Decryption(
+        g, init,
+        [DecryptingTrustee.from_state(
+            g, trustees[0].decrypting_trustee_state())],
+        [], DLog(g, max_exponent=max(16, nballots + 2)))
+    dr = DecryptionResult(tally_result,
+                          dec.decrypt(tally_result.encrypted_tally),
+                          tuple(dec.get_available_guardians()))
+
+    out = tempfile.mkdtemp(prefix="bench_live_")
+    try:
+        pub = Publisher(out)
+        pub.write_election_initialized(init)
+        live = LiveVerifier(out, g, chunk=chunk)
+        lags = []
+        t_tail = 0.0
+        with pub.open_encrypted_ballots() as stream:
+            for eb in encrypted:
+                stream.write(eb)
+                stream.flush()
+                t0 = time.perf_counter()
+                live.poll()
+                t_tail += time.perf_counter() - t0
+                lags.append(live.audit_lag_frames())
+        pub.write_tally_result(tally_result)
+        pub.write_decryption_result(dr)
+        t0 = time.perf_counter()
+        res = live.finalize()
+        t_resid = time.perf_counter() - t0
+        if not res.ok:
+            raise RuntimeError(f"live bench record went red: {res.errors}")
+        n_chunks = len(live.ledger.chunks)
+        lags.sort()
+        p99 = lags[min(len(lags) - 1, int(0.99 * len(lags)))]
+        RESULT.update(
+            live_chunks_per_s=round(n_chunks / max(t_tail, 1e-9), 2),
+            live_chunk_s=round(t_tail / max(n_chunks, 1), 4),
+            live_audit_lag_p99=p99,
+            live_residual_verify_s=round(t_resid, 3),
+            live_nballots=nballots, live_chunk_frames=chunk,
+        )
+        RESULT["phases_done"] = RESULT.get("phases_done", "") + " live"
+        note(f"live {nballots} ballots in chunks of {chunk}: "
+             f"{n_chunks / max(t_tail, 1e-9):.1f} chunks/s tailing "
+             f"(lag p99 {p99} frames), residual finalize {t_resid:.2f}s")
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
 
 
 def _bench_race() -> None:

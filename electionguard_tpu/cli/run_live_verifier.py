@@ -1,0 +1,136 @@
+"""Live verifier binary: audit the election record WHILE it is written.
+
+Tails the record dir's framed ballot stream + admission journal
+(verify/live), verifying chunk-at-a-time and serving the commitment
+ledger on a BulletinBoardService port mid-election.  When the terminal
+artifacts land (``decryption_result.pb``) and the stream goes quiet,
+it drains the residual tail, runs the record-level checks, writes a
+machine-readable audit artifact (``-audit``), and exits 0 green /
+1 red — the same verdict contract as ``run_verifier``, reached while
+the election was still running.
+
+SIGKILL-safe: the checkpoint in the record dir makes a relaunched
+instance resume at the last committed chunk with an identical final
+verdict and commitment root (tests/test_live_verify.py pins this).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from electionguard_tpu.cli.common import (Stopwatch, add_group_flag,
+                                          resolve_group, setup_logging)
+from electionguard_tpu.utils import knobs
+
+
+def main(argv=None) -> int:
+    log = setup_logging("RunLiveVerifier")
+    ap = argparse.ArgumentParser("RunLiveVerifier")
+    ap.add_argument("-in", dest="input", required=True,
+                    help="election record dir (may still be growing)")
+    ap.add_argument("-port", type=int, default=0,
+                    help="BulletinBoardService port (0 = ephemeral)")
+    ap.add_argument("-chunk", type=int,
+                    default=knobs.get_int("EGTPU_LIVE_CHUNK"),
+                    help="ballot frames per verified/committed chunk")
+    ap.add_argument("-poll", type=float,
+                    default=knobs.get_float("EGTPU_LIVE_POLL_S"),
+                    help="tail poll period, seconds")
+    ap.add_argument("-audit", default=None,
+                    help="write the final audit JSON here "
+                         "(default <record>/live_audit.json)")
+    ap.add_argument("-timeout", type=float, default=0,
+                    help="give up after this many seconds of tailing "
+                         "(0 = wait forever for the terminal artifacts)")
+    add_group_flag(ap)
+    args = ap.parse_args(argv)
+
+    group = resolve_group(args)
+    from electionguard_tpu.verify.live import BulletinBoard, LiveVerifier
+
+    # the record dir must hold election_initialized.pb before we can
+    # fold anything — wait for the producing workflow's phase 1
+    init_path = os.path.join(args.input, "election_initialized.pb")
+    t0 = time.monotonic()
+    while not os.path.exists(init_path):
+        if args.timeout and time.monotonic() - t0 > args.timeout:
+            log.error("timed out waiting for %s", init_path)
+            return 1
+        time.sleep(args.poll)
+
+    live = LiveVerifier(args.input, group, chunk=args.chunk)
+    board = BulletinBoard(live, port=args.port)
+    log.info("bulletin board on port %d (chunk=%d poll=%.2fs, resumed "
+             "at frame %d)", board.port, args.chunk, args.poll,
+             live.verified_frames)
+    print(f"bulletin board port: {board.port}", flush=True)
+
+    decr_path = os.path.join(args.input, "decryption_result.pb")
+    sw = Stopwatch()
+    residual_frames = None
+    quiet = 0
+    try:
+        while True:
+            with board._lock:
+                n = live.poll()
+            if n:
+                s = live.audit_state()
+                log.info("committed %d chunk(s): %d/%d frames verified, "
+                         "lag %d", n, s["frames_verified"],
+                         s["frames_published"], s["audit_lag_frames"])
+            # terminal condition: decryption landed and two quiet polls
+            # (the producer fsyncs frames before the terminal artifact,
+            # so "quiet after decryption" means the stream is closed)
+            if os.path.exists(decr_path):
+                if residual_frames is None:
+                    # the audit-lag figure the e2e acceptance gates on:
+                    # how much work was LEFT when the election ended
+                    live.poll()
+                    residual_frames = (live.frames_published()
+                                       - live.verified_frames)
+                quiet = quiet + 1 if n == 0 else 0
+                if quiet >= 2:
+                    break
+            elif args.timeout and time.monotonic() - t0 > args.timeout:
+                log.error("timed out tailing %s (no decryption result "
+                          "after %.0fs)", args.input, args.timeout)
+                return 1
+            time.sleep(args.poll)
+
+        total = max(live.frames_published(), 1)
+        drain_sw = Stopwatch()
+        with board._lock:
+            res = live.finalize()
+        residual_s = drain_sw.elapsed()
+    finally:
+        board.shutdown()
+
+    audit = dict(live.audit_state())
+    audit.update({
+        "root": live.ledger.root().hex(),
+        "chain_head": live.ledger.head.hex(),
+        "n_chunks": len(live.ledger.chunks),
+        "residual_frames_at_close": residual_frames or 0,
+        "residual_fraction": (residual_frames or 0) / total,
+        "residual_verify_s": residual_s,
+    })
+    audit_path = args.audit or os.path.join(args.input,
+                                            "live_audit.json")
+    with open(audit_path, "w") as f:
+        json.dump(audit, f, indent=2)
+    print(res.summary())
+    log.info("%s; ok=%s root=%s residual=%.1f%% (%d frames, %.2fs "
+             "drain)", sw.took("live verification",
+                               max(live.verified_frames, 1)),
+             res.ok, live.ledger.root().hex()[:16],
+             100.0 * audit["residual_fraction"],
+             audit["residual_frames_at_close"], residual_s)
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

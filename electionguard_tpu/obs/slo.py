@@ -25,6 +25,11 @@ env var.  Objectives:
   queue alerts;
 * ``stage_lag_s`` — a SERVING process whose reported phase has not
   advanced for this long alerts (a wedged mix/verify stage);
+* ``audit_lag_frames`` — the live verification plane (verify/live)
+  reports ``live_audit_lag_frames`` (ballot frames published but not
+  yet verified); a lag past the objective means the auditor has fallen
+  behind the election it is supposed to be watching.  ``objective:
+  null`` (the default) resolves the ``EGTPU_LIVE_AUDIT_LAG_MAX`` knob;
 * ``heartbeat`` — liveness: a process that misses ``miss_threshold``
   consecutive heartbeat intervals without having said goodbye
   (status EXITING) is declared dead.  This fires in
@@ -58,6 +63,11 @@ DEFAULT_SLO: dict = {
     },
     "queue_depth_max": 256,
     "stage_lag_s": 300.0,
+    "audit_lag_frames": {
+        # None -> resolved from the EGTPU_LIVE_AUDIT_LAG_MAX knob at
+        # evaluation time (config JSON may still pin a number)
+        "objective": None,
+    },
     "heartbeat": {
         "interval_s": 1.0,
         "miss_threshold": 3,
@@ -170,6 +180,7 @@ class SLOEngine:
         fired += self._check_serving_p99(t, metrics)
         fired += self._check_queues(t, processes)
         fired += self._check_stage_lag(t, processes)
+        fired += self._check_audit_lag(t, metrics)
         self.fired.extend(fired)
         return fired
 
@@ -280,6 +291,24 @@ class SLOEngine:
                 f"phase {p.get('phase')!r} unchanged for {lag:.0f}s "
                 f"(> {limit:.0f}s)", t,
                 attrs={"phase": p.get("phase"), "lag_s": round(lag, 1)}))
+        return out
+
+    def _check_audit_lag(self, t: float, metrics) -> list[Alert]:
+        limit = self.config["audit_lag_frames"]["objective"]
+        if limit is None:
+            from electionguard_tpu.utils import knobs
+            limit = knobs.get_int("EGTPU_LIVE_AUDIT_LAG_MAX")
+        out = []
+        for flat, v in metrics.get("gauges", {}).items():
+            name, _ = parse_labels(flat)
+            if name != "live_audit_lag_frames":
+                continue
+            out += self._fire(v > limit, lambda flat=flat, v=v,
+                              limit=limit: Alert(
+                "audit_lag", flat,
+                f"live verification is {v:.0f} frames behind the "
+                f"published stream (> {limit})", t,
+                attrs={"lag_frames": v, "limit": limit}))
         return out
 
     # ---- rollup ------------------------------------------------------

@@ -1,0 +1,233 @@
+"""Framed-stream primitives: ONE torn-frame policy for the whole repo.
+
+Every durable stream in the record directory is framed as a 4-byte
+big-endian length header + payload bytes.  Before this module, three
+places each re-implemented the "what does an incomplete tail mean"
+decision — ``publisher.repair_frame_stream`` (crash recovery),
+``Consumer``'s slice readers (ingestion), and ``serve.journal.replay``
+(its JSON-lines analogue).  They now share a single policy:
+
+* **torn tail** — the stream ends mid-header or mid-payload.  That is
+  the expected shape of a SIGKILL during an append (or of a reader
+  racing a writer): the unfinished frame was never acknowledged, so it
+  is *retryable* — recovery truncates it, a tailer waits for the rest.
+* **corrupt frame** — a header that cannot be a frame at all (length
+  above the sanity bound).  No amount of waiting completes it; readers
+  must go red immediately with an attributable named error.
+
+``TruncatedFrameError`` / ``CorruptFrameError`` subclass ``IOError`` so
+pre-existing ``except IOError`` call sites keep working, and carry
+``utils.errors`` class tokens (``[publish.truncated_frame]``,
+``[publish.corrupt_frame]``) so the sim's soundness oracle can attribute
+ingestion rejections to the framing defense that fired.
+
+``FramedTailer`` is the incremental face of the same policy: it follows
+a stream that is still being written, yielding each frame exactly once
+and treating a torn tail as "not yet", which is what the live
+verification plane (``verify/live``) is built on.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, Optional
+
+from electionguard_tpu.utils import errors
+
+HEADER_LEN = 4
+#: sanity bound on a single frame: anything larger than this is not a
+#: torn write, it is garbage in the header (no record message comes
+#: within orders of magnitude of it) — overridable per-reader
+DEFAULT_MAX_FRAME = 64 << 20
+
+
+class FramingError(IOError):
+    """Base for framed-stream decode failures (an ``IOError`` so legacy
+    ``except IOError`` recovery paths keep catching it)."""
+
+
+class TruncatedFrameError(FramingError):
+    """Stream ends mid-frame: a torn tail (retryable — the write never
+    completed, or the writer is still appending)."""
+
+    CLS = "publish.truncated_frame"
+
+    def __init__(self, msg: str):
+        super().__init__(errors.named(self.CLS, msg))
+
+
+class CorruptFrameError(FramingError):
+    """A frame header that cannot be valid (length over the sanity
+    bound): unrecoverable, the reader must go red."""
+
+    CLS = "publish.corrupt_frame"
+
+    def __init__(self, msg: str):
+        super().__init__(errors.named(self.CLS, msg))
+
+
+def write_frame(f, data: bytes) -> None:
+    f.write(struct.pack(">I", len(data)))
+    f.write(data)
+
+
+def read_frames_slice(path: str, offset: int = 0,
+                      count: int | None = None,
+                      max_frame: int = DEFAULT_MAX_FRAME
+                      ) -> Iterator[bytes]:
+    """Decode frames from ``offset``: exactly ``count`` of them, or to
+    EOF when ``count`` is None — the ONE definition of the framing."""
+    with open(path, "rb") as f:
+        f.seek(offset)
+        remaining = count
+        while remaining is None or remaining > 0:
+            hdr = f.read(HEADER_LEN)
+            if not hdr and remaining is None:
+                return
+            if len(hdr) != HEADER_LEN:
+                raise TruncatedFrameError(
+                    f"truncated frame header in {path}")
+            (n,) = struct.unpack(">I", hdr)
+            if n > max_frame:
+                raise CorruptFrameError(
+                    f"frame length {n} exceeds sanity bound "
+                    f"{max_frame} in {path}")
+            data = f.read(n)
+            if len(data) != n:
+                raise TruncatedFrameError(f"truncated frame in {path}")
+            yield data
+            if remaining is not None:
+                remaining -= 1
+
+
+def read_frames(path: str) -> Iterator[bytes]:
+    return read_frames_slice(path)
+
+
+def scan_frame_shards(path: str,
+                      n_shards: int) -> list[tuple[int, int, int]]:
+    """Split a framed stream into ≤ n_shards contiguous ``(byte_offset,
+    frame_count, last_frame_offset)`` slices by reading only the 4-byte
+    length headers — file-offset slicing, no payload decode (README
+    §Scaling model: the election record is a framed stream, so sharding
+    it across feeder processes is offset arithmetic).  The last-frame
+    offset lets a coordinator decode exactly ONE boundary ballot per
+    shard (its confirmation code seeds the next feeder's V6 chain)."""
+    offsets: list[int] = []
+    with open(path, "rb") as f:
+        pos = 0
+        while True:
+            hdr = f.read(HEADER_LEN)
+            if not hdr:
+                break
+            if len(hdr) != HEADER_LEN:
+                raise TruncatedFrameError(
+                    f"truncated frame header in {path}")
+            (n,) = struct.unpack(">I", hdr)
+            offsets.append(pos)
+            pos += HEADER_LEN + n
+            f.seek(pos)
+    total = len(offsets)
+    if total == 0:
+        return []
+    per = -(-total // n_shards)  # ceil
+    return [(offsets[i], min(per, total - i),
+             offsets[min(i + per, total) - 1])
+            for i in range(0, total, per)]
+
+
+def repair_frame_stream(path: str) -> tuple[int, Optional[bytes]]:
+    """Truncate a framed stream to its last COMPLETE frame (a SIGKILL can
+    tear the final write) and return ``(n_frames, last_frame_bytes)``.
+    The one frame decode the caller needs for chain continuity (the last
+    ballot's confirmation code) comes back without re-reading the file."""
+    if not os.path.exists(path):
+        return 0, None
+    n = 0
+    last: Optional[bytes] = None
+    good_end = 0
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(HEADER_LEN)
+            if len(hdr) < HEADER_LEN:
+                break
+            (size,) = struct.unpack(">I", hdr)
+            data = f.read(size)
+            if len(data) != size:
+                break
+            n += 1
+            last = data
+            good_end += HEADER_LEN + size
+    actual = os.path.getsize(path)
+    if actual != good_end:
+        with open(path, "r+b") as f:
+            f.truncate(good_end)
+    return n, last
+
+
+def complete_lines(data: bytes) -> tuple[list[bytes], bytes]:
+    """The JSON-lines face of the torn-tail policy: split a byte blob
+    into its COMPLETE (newline-terminated) lines plus the torn tail
+    (bytes after the last newline — a mid-append crash, or a writer the
+    reader is racing).  Empty lines are dropped; the tail is returned
+    verbatim so a tailer can retry once the writer finishes it."""
+    if not data:
+        return [], b""
+    body, sep, tail = data.rpartition(b"\n")
+    lines = [ln for ln in body.split(b"\n") if ln] if sep else []
+    return lines, tail
+
+
+class FramedTailer:
+    """Incremental reader over a framed stream that is still being
+    written.  ``poll()`` returns every frame that has fully landed since
+    the last call and advances the cursor past them; a torn tail (header
+    or payload not yet complete) is simply left for the next poll.  A
+    header over the sanity bound raises ``CorruptFrameError`` — that is
+    never a partial write, the stream itself is bad.
+
+    The cursor (``offset``/``frames``) is plain state, so a checkpointed
+    consumer can persist it and resume a fresh tailer exactly where the
+    killed one stopped."""
+
+    def __init__(self, path: str, offset: int = 0, frames: int = 0,
+                 max_frame: int = DEFAULT_MAX_FRAME):
+        self.path = path
+        self.offset = int(offset)     # byte offset of the next frame
+        self.frames = int(frames)     # frames consumed so far
+        self.max_frame = int(max_frame)
+
+    def poll(self) -> list[bytes]:
+        """All newly COMPLETE frames past the cursor ([] when the file
+        does not exist yet or only a torn tail landed)."""
+        if not os.path.exists(self.path):
+            return []
+        out: list[bytes] = []
+        with open(self.path, "rb") as f:
+            f.seek(self.offset)
+            while True:
+                hdr = f.read(HEADER_LEN)
+                if len(hdr) < HEADER_LEN:
+                    break   # torn header: retry next poll
+                (n,) = struct.unpack(">I", hdr)
+                if n > self.max_frame:
+                    raise CorruptFrameError(
+                        f"frame length {n} exceeds sanity bound "
+                        f"{self.max_frame} at byte {self.offset} "
+                        f"in {self.path}")
+                data = f.read(n)
+                if len(data) != n:
+                    break   # torn payload: retry next poll
+                out.append(data)
+                self.offset += HEADER_LEN + n
+                self.frames += 1
+        return out
+
+    def torn_bytes(self) -> int:
+        """Bytes sitting past the cursor that never completed a frame —
+        0 on a cleanly closed stream, >0 exactly when the writer died
+        mid-append (matches what ``repair_frame_stream`` would cut)."""
+        if not os.path.exists(self.path):
+            return 0
+        return max(0, os.path.getsize(self.path) - self.offset)

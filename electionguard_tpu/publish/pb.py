@@ -118,3 +118,9 @@ TelemetryAck = msg("TelemetryAck")
 FleetStatusRequest = msg("FleetStatusRequest")
 FleetProcess = msg("FleetProcess")
 FleetStatusResponse = msg("FleetStatusResponse")
+BulletinRootRequest = msg("BulletinRootRequest")
+BulletinRootResponse = msg("BulletinRootResponse")
+InclusionProofRequest = msg("InclusionProofRequest")
+InclusionProofResponse = msg("InclusionProofResponse")
+AuditStateRequest = msg("AuditStateRequest")
+AuditStateResponse = msg("AuditStateResponse")

@@ -29,18 +29,18 @@ ballot records stream without loading everything in memory.
 from __future__ import annotations
 
 import os
-import struct
-from typing import Iterator, Optional
+from typing import Iterator
 
 from electionguard_tpu.ballot.ciphertext import EncryptedBallot
 from electionguard_tpu.ballot.plaintext import PlaintextBallot
 from electionguard_tpu.ballot.tally import PlaintextTally
 from electionguard_tpu.core.group import GroupContext
-from electionguard_tpu.publish import pb, serialize
+from electionguard_tpu.publish import framing, pb, serialize
 from electionguard_tpu.publish.election_record import (DecryptionResult,
                                                        ElectionInitialized,
                                                        ElectionRecord,
                                                        TallyResult)
+from electionguard_tpu.utils import errors
 
 _INIT = "election_initialized.pb"
 _BALLOTS = "encrypted_ballots.pb"
@@ -49,69 +49,15 @@ _DECRYPTION = "decryption_result.pb"
 _SPOILED = "spoiled_ballot_tallies.pb"
 _MIX_FMT = "mix_stage_{:03d}.pb"   # framed: header frame + n_rows MixRow
 
-
-def _write_frame(f, data: bytes):
-    f.write(struct.pack(">I", len(data)))
-    f.write(data)
-
-
-def _read_frames_slice(path: str, offset: int = 0,
-                       count: int | None = None) -> Iterator[bytes]:
-    """Decode frames from ``offset``: exactly ``count`` of them, or to
-    EOF when ``count`` is None — the ONE definition of the framing."""
-    with open(path, "rb") as f:
-        f.seek(offset)
-        remaining = count
-        while remaining is None or remaining > 0:
-            hdr = f.read(4)
-            if not hdr and remaining is None:
-                return
-            if len(hdr) != 4:
-                raise IOError(f"truncated frame header in {path}")
-            (n,) = struct.unpack(">I", hdr)
-            data = f.read(n)
-            if len(data) != n:
-                raise IOError(f"truncated frame in {path}")
-            yield data
-            if remaining is not None:
-                remaining -= 1
-
-
-def _read_frames(path: str) -> Iterator[bytes]:
-    return _read_frames_slice(path)
-
-
-def scan_frame_shards(path: str,
-                      n_shards: int) -> list[tuple[int, int, int]]:
-    """Split a framed stream into ≤ n_shards contiguous ``(byte_offset,
-    frame_count, last_frame_offset)`` slices by reading only the 4-byte
-    length headers — file-offset slicing, no payload decode (README
-    §Scaling model: the election record is a framed stream, so sharding
-    it across feeder processes is offset arithmetic).  The last-frame
-    offset lets a coordinator decode exactly ONE boundary ballot per
-    shard (its confirmation code seeds the next feeder's V6 chain)."""
-    offsets: list[int] = []
-    with open(path, "rb") as f:
-        pos = 0
-        while True:
-            hdr = f.read(4)
-            if not hdr:
-                break
-            if len(hdr) != 4:
-                raise IOError(f"truncated frame header in {path}")
-            (n,) = struct.unpack(">I", hdr)
-            offsets.append(pos)
-            pos += 4 + n
-            f.seek(pos)
-    total = len(offsets)
-    if total == 0:
-        return []
-    per = -(-total // n_shards)  # ceil
-    return [(offsets[i], min(per, total - i),
-             offsets[min(i + per, total) - 1])
-            for i in range(0, total, per)]
-
-
+# The framing itself (header layout, torn-tail policy, shard scan,
+# crash repair) lives in ``publish.framing`` — one policy shared with
+# journal recovery and the live-verification tailer.  These aliases keep
+# the long-standing import surface of this module stable.
+_write_frame = framing.write_frame
+_read_frames_slice = framing.read_frames_slice
+_read_frames = framing.read_frames
+scan_frame_shards = framing.scan_frame_shards
+repair_frame_stream = framing.repair_frame_stream
 
 
 class Publisher:
@@ -196,35 +142,6 @@ class Publisher:
             f.flush()
             os.fsync(f.fileno())
         return path
-
-
-def repair_frame_stream(path: str) -> tuple[int, Optional[bytes]]:
-    """Truncate a framed stream to its last COMPLETE frame (a SIGKILL can
-    tear the final write) and return ``(n_frames, last_frame_bytes)``.
-    The one frame decode the caller needs for chain continuity (the last
-    ballot's confirmation code) comes back without re-reading the file."""
-    if not os.path.exists(path):
-        return 0, None
-    n = 0
-    last: Optional[bytes] = None
-    good_end = 0
-    with open(path, "rb") as f:
-        while True:
-            hdr = f.read(4)
-            if len(hdr) < 4:
-                break
-            (size,) = struct.unpack(">I", hdr)
-            data = f.read(size)
-            if len(data) != size:
-                break
-            n += 1
-            last = data
-            good_end += 4 + size
-    actual = os.path.getsize(path)
-    if actual != good_end:
-        with open(path, "r+b") as f:
-            f.truncate(good_end)
-    return n, last
 
 
 class EncryptedBallotStream:
@@ -350,7 +267,11 @@ class Consumer:
         path = self._path(_MIX_FMT.format(k))
         frames = _read_frames(path)
         hm = pb.MixStageHeader()
-        hm.ParseFromString(next(frames))
+        try:
+            hm.ParseFromString(next(frames))
+        except StopIteration:
+            raise framing.TruncatedFrameError(
+                f"mix stage {k}: stream {path} has no header frame")
         proof = serialize.import_mix_proof(self.group, hm.proof)
         pads, datas = [], []
         for frame in frames:
@@ -360,8 +281,10 @@ class Consumer:
             pads.append(row_a)
             datas.append(row_b)
         if len(pads) != int(hm.n_rows):
-            raise IOError(f"mix stage {k}: {len(pads)} row frames != "
-                          f"header n_rows {int(hm.n_rows)}")
+            raise framing.FramingError(errors.named(
+                "publish.mix_row_mismatch",
+                f"mix stage {k}: {len(pads)} row frames != "
+                f"header n_rows {int(hm.n_rows)}"))
         return MixStage(int(hm.stage_index), int(hm.n_rows),
                         int(hm.width), serialize.import_u256(hm.input_hash),
                         pads, datas, proof)

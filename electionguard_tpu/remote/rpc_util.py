@@ -150,6 +150,9 @@ _DEADLINE_CLASS_OF = {
     "pullRows": "data",
     "pushTelemetry": "control",
     "getFleetStatus": "control",
+    "getRoot": "control",
+    "getInclusionProof": "control",
+    "getAuditState": "control",
 }
 
 

@@ -29,6 +29,7 @@ import os
 from dataclasses import dataclass
 
 from electionguard_tpu.ballot.plaintext import PlaintextBallot
+from electionguard_tpu.publish import framing
 
 JOURNAL_NAME = "admission_journal.wal"
 
@@ -76,17 +77,20 @@ class AdmissionJournal:
 
 def replay(path: str) -> list[JournalEntry]:
     """Journaled admissions in admission order; a torn trailing line
-    (crash mid-append, admission never ack'd) is ignored."""
+    (crash mid-append, admission never ack'd) is ignored — the shared
+    torn-tail policy of ``publish.framing.complete_lines``, the same
+    rule the framed record streams use."""
     if not os.path.exists(path):
         return []
     entries: list[JournalEntry] = []
     with open(path, "rb") as f:
         data = f.read()
-    lines = data.split(b"\n")
+    # the torn tail (bytes past the last newline: the fsync of that
+    # append never returned, so the client never saw the admission
+    # ack'd) is dropped here exactly like a torn trailing frame is
+    # dropped by repair_frame_stream
+    lines, _torn = framing.complete_lines(data)
     for i, raw in enumerate(lines):
-        if not raw:
-            continue
-        torn_tail = (i == len(lines) - 1 and not data.endswith(b"\n"))
         try:
             rec = json.loads(raw)
             if rec.get("drop"):
@@ -98,8 +102,6 @@ def replay(path: str) -> list[JournalEntry]:
                 continue
             ballot = PlaintextBallot.from_json(json.dumps(rec["ballot"]))
         except (ValueError, KeyError):
-            if torn_tail:
-                break   # mid-append crash; the admission never ack'd
             raise IOError(f"corrupt journal line {i} in {path}")
         entries.append(JournalEntry(ballot, bool(rec["spoil"])))
     return entries

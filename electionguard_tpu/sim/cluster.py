@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import random
+import shutil
 import threading
 from dataclasses import dataclass, field
 
@@ -47,7 +49,8 @@ from electionguard_tpu.mixnet.stage import rows_from_ballots
 from electionguard_tpu.publish.election_record import (DecryptionResult,
                                                        ElectionConfig,
                                                        ElectionRecord)
-from electionguard_tpu.publish.publisher import Consumer
+from electionguard_tpu.publish import framing, serialize
+from electionguard_tpu.publish.publisher import _BALLOTS, Consumer, Publisher
 from electionguard_tpu.remote.decrypting_remote import (
     DecryptionCoordinator, DecryptingTrusteeServer)
 from electionguard_tpu.remote.keyceremony_remote import (
@@ -102,6 +105,10 @@ class SimOutcome:
     # race detector reports (analysis/race.RaceReport) when the run had
     # the monitor attached; the race oracle turns unwaived ones red
     races: list = field(default_factory=list)
+    # live-verification convergence report (the "live-verify" plant):
+    # live-vs-batch verdict/accept-set/commitment comparison data the
+    # live_convergence oracle checks; None when the leg didn't run
+    live_report: object = None
 
 
 class RaceProbeBox:
@@ -192,6 +199,102 @@ class _MemStream:
 
     def flush(self) -> None:
         pass
+
+
+def _live_verify_leg(group, init, out: "SimOutcome", mix_dir: str,
+                     workdir: str, seed: int, sched) -> dict:
+    """Replay the finished election as a GROWING record directory and
+    audit it with the live verification plane (verify/live) under a
+    seed-derived torture schedule: torn tails land mid-frame, polls
+    interleave arbitrarily with the writer, and the verifier is
+    SIGKILL'd (the incarnation dropped on the floor, no drain) and
+    resumed from its on-disk checkpoint mid-stream.  Returns the
+    comparison data the ``live_convergence`` oracle checks against a
+    terminal single-pass fold over the same finished record: verdict,
+    error list, chunk-accept set, and commitment root/chain head must
+    all be bit-identical, and anything the batch pass rejects must be
+    rejected live at an equal-or-earlier chunk."""
+    from electionguard_tpu.verify.live import LiveVerifier
+
+    # stream 7 of the seed: draws here perturb no honest stream
+    rng = random.Random(seed * 8 + 7)
+    rec_dir = os.path.join(workdir, "live_record")
+    pub = Publisher(rec_dir)
+    pub.write_election_initialized(init)
+    for name in sorted(os.listdir(mix_dir)):
+        if name.startswith("mix_stage_"):
+            shutil.copy(os.path.join(mix_dir, name),
+                        os.path.join(rec_dir, name))
+
+    chunk = rng.choice((1, 2, 3))
+    live = LiveVerifier(rec_dir, group, chunk=chunk)
+    crashes = torn = 0
+    frames = [serialize.publish_encrypted_ballot(b).SerializeToString()
+              for b in out.recorded]
+    with open(os.path.join(rec_dir, _BALLOTS), "ab") as f:
+        def land(blob: bytes) -> None:
+            f.write(blob)
+            f.flush()
+
+        for fr in frames:
+            blob = len(fr).to_bytes(framing.HEADER_LEN, "big") + fr
+            if rng.random() < 0.3:
+                # torn tail: a partial frame lands and the tailer polls
+                # it — must classify "retry", never "corrupt" — then the
+                # remainder completes the frame
+                cut = rng.randrange(1, len(blob))
+                land(blob[:cut])
+                live.poll()
+                torn += 1
+                land(blob[cut:])
+            else:
+                land(blob)
+            if rng.random() < 0.6:
+                live.poll()
+            if rng.random() < 0.25:
+                crashes += 1
+                live = LiveVerifier(rec_dir, group, chunk=chunk)
+    pub.write_tally_result(out.tally_result)
+    pub.write_decryption_result(out.decryption_result)
+    if rng.random() < 0.5:   # one more kill after the stream closed
+        crashes += 1
+        live = LiveVerifier(rec_dir, group, chunk=chunk)
+    live_res = live.finalize()
+
+    # the terminal comparator: a fresh single-pass fold over the
+    # finished record at the SAME chunk size (chunk boundaries are a
+    # pure function of frame index, so this IS the batch pass)
+    batch = LiveVerifier(rec_dir, group, chunk=chunk,
+                         checkpoint_path=os.path.join(
+                             workdir, "live_batch_checkpoint.json"))
+    batch_res = batch.finalize()
+    live_accepts = [c.accepted for c in live.ledger.chunks]
+    batch_accepts = [c.accepted for c in batch.ledger.chunks]
+
+    def first_reject(accepts):
+        return next((i for i, a in enumerate(accepts) if not a), None)
+
+    sched.event("live-verify",
+                f"chunk={chunk} crashes={crashes} torn={torn} "
+                f"ok={live_res.ok} chunks={len(live_accepts)}")
+    return {
+        "chunk": chunk, "crashes": crashes, "torn": torn,
+        "n_frames": len(frames),
+        "live_ok": live_res.ok,
+        "live_checks": dict(live_res.checks),
+        "live_errors": list(live_res.errors),
+        "batch_ok": batch_res.ok,
+        "batch_checks": dict(batch_res.checks),
+        "batch_errors": list(batch_res.errors),
+        "live_accepts": live_accepts,
+        "batch_accepts": batch_accepts,
+        "live_first_reject": first_reject(live_accepts),
+        "batch_first_reject": first_reject(batch_accepts),
+        "live_root": live.ledger.root().hex(),
+        "batch_root": batch.ledger.root().hex(),
+        "live_head": live.ledger.head.hex(),
+        "batch_head": batch.ledger.head.hex(),
+    }
 
 
 def sim_manifest() -> Manifest:
@@ -453,5 +556,10 @@ def drive(cfg: SimConfig, sched, transport, plan, schedule, seed: int,
         mix_stages=Consumer(mix_dir, group).read_mix_stages())
     out.verify_result = Verifier(
         record, group, mix_input_fn=lambda: (pads, datas)).verify()
+
+    # ---- phase 5.5 (optional): live-verification convergence ---------
+    if "live-verify" in plant:
+        out.live_report = _live_verify_leg(group, init, out, mix_dir,
+                                           workdir, seed, sched)
     out.completed = True
     sched.event("workflow-complete", f"{len(out.recorded)} ballots")

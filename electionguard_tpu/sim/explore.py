@@ -57,6 +57,10 @@ class SimReport:
     races: list[dict] = field(default_factory=list)
     strategy: str = "random"
     race_events: int = 0
+    #: live-verification convergence summary (the "live-verify" plant):
+    #: chunk size drawn, crash/torn-tail counts the leg injected, and
+    #: the commitment root both passes must agree on
+    live: dict = field(default_factory=dict)
 
     def schedule_json(self) -> str:
         return schedule_mod.to_json(self.schedule)
@@ -153,6 +157,13 @@ def run_sim(seed: int,
     if monitor is not None:
         out.races = list(monitor.races)
     violations = oracle.check(out)
+    live = {}
+    if out.live_report is not None:
+        live = {k: out.live_report[k]
+                for k in ("chunk", "crashes", "torn", "n_frames",
+                          "live_ok", "live_root", "live_accepts")}
+        live["converged"] = not any(
+            v.startswith("live_convergence") for v in violations)
     return SimReport(seed=seed, ok=not violations, violations=violations,
                      trace_hash=sched.trace_hash(),
                      events=len(sched.trace), virtual_s=sched.now,
@@ -162,7 +173,8 @@ def run_sim(seed: int,
                      detections=list(out.detections),
                      races=[r.to_dict() for r in out.races],
                      strategy=strategy,
-                     race_events=monitor.events if monitor else 0)
+                     race_events=monitor.events if monitor else 0,
+                     live=live)
 
 
 def explore(seeds: Sequence[int],

@@ -23,6 +23,14 @@ the class.
   (``run_sim(race=True)``); waivers live in
   ``analysis/race_waivers.json`` (ships empty, every entry needs a
   note);
+* ``live_convergence`` — when the run carried the ``live-verify``
+  plant (cluster ``_live_verify_leg``): the live verifier's final
+  verdict, error list, chunk-accept set, and commitment root/chain
+  head — reached while the record grew, through torn tails and
+  SIGKILL/checkpoint-resume — are bit-identical to a terminal
+  single-pass fold over the finished record, agree with the
+  independent full verifier's verdict, and nothing the batch pass
+  rejects is first rejected live at a LATER chunk;
 * ``soundness``        — every in-protocol attack that actually fired
   (``outcome.fired``, the adversary plan's audit log) was DETECTED: an
   in-band rejection carrying one of the attack's expected named error
@@ -72,8 +80,46 @@ def check(outcome) -> list[str]:
     v.extend(_chain_contiguous(outcome))
     v.extend(_verifier_green(outcome))
     v.extend(_quorum_tally(outcome))
+    v.extend(_live_convergence(outcome))
     v.extend(_soundness(outcome, detections))
     v.extend(_races(outcome))
+    return v
+
+
+def _live_convergence(o) -> list[str]:
+    rep = getattr(o, "live_report", None)
+    if rep is None:
+        return []
+    v = []
+    if (rep["live_checks"] != rep["batch_checks"]
+            or rep["live_errors"] != rep["batch_errors"]):
+        v.append("live_convergence: live verdict diverged from the "
+                 "terminal fold at the same chunk size "
+                 f"(chunk={rep['chunk']} crashes={rep['crashes']} "
+                 f"torn={rep['torn']}): live "
+                 f"{sorted(k for k, ok in rep['live_checks'].items() if not ok)}"
+                 f"/{rep['live_errors']} vs batch "
+                 f"{sorted(k for k, ok in rep['batch_checks'].items() if not ok)}"
+                 f"/{rep['batch_errors']}")
+    if rep["live_accepts"] != rep["batch_accepts"]:
+        v.append(f"live_convergence: chunk-accept set diverged: live "
+                 f"{rep['live_accepts']} vs batch {rep['batch_accepts']}")
+    if (rep["live_root"] != rep["batch_root"]
+            or rep["live_head"] != rep["batch_head"]):
+        v.append("live_convergence: commitment diverged across "
+                 f"{rep['crashes']} crash-resume(s): root "
+                 f"{rep['live_root'][:16]} vs {rep['batch_root'][:16]}, "
+                 f"head {rep['live_head'][:16]} vs "
+                 f"{rep['batch_head'][:16]}")
+    vr = o.verify_result
+    if vr is not None and rep["live_ok"] != vr.ok:
+        v.append(f"live_convergence: live ok={rep['live_ok']} but the "
+                 f"independent verifier says ok={vr.ok}")
+    b_first, l_first = rep["batch_first_reject"], rep["live_first_reject"]
+    if b_first is not None and (l_first is None or l_first > b_first):
+        v.append(f"live_convergence: batch fold rejects chunk {b_first} "
+                 f"but live first rejected at {l_first} — detection "
+                 f"must be equal-or-earlier")
     return v
 
 

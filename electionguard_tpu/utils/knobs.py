@@ -89,6 +89,24 @@ KNOBS: tuple[Knob, ...] = (
     Knob("EGTPU_FLIGHT_TOP_N", "int", "10",
          "Rows in the flight report's top-self-time table "
          "(obs/analyze; tools/egreport -topN overrides)."),
+    Knob("EGTPU_LIVE_AUDIT_LAG_MAX", "int", "4096",
+         "Audit-lag SLO objective: frames published but not yet "
+         "live-verified before the audit_lag alert fires (obs/slo; "
+         "verify/live sets the live_audit_lag_frames gauge)."),
+    Knob("EGTPU_LIVE_CHECKPOINT", "path", None,
+         "Live-verifier checkpoint file (cursor + aggregates + "
+         "commitment ledger); defaults to live_checkpoint.json inside "
+         "the record dir (verify/live)."),
+    Knob("EGTPU_LIVE_CHUNK", "int", "512",
+         "Ballot frames per live-verification chunk — the commitment "
+         "granularity of the bulletin board (verify/live)."),
+    Knob("EGTPU_LIVE_MAX_FRAME", "int", "67108864",
+         "Sanity bound on one framed-record frame, bytes: a header "
+         "above it is a corrupt frame (red), not a torn tail "
+         "(verify/live; publish/framing default)."),
+    Knob("EGTPU_LIVE_POLL_S", "float", "0.25",
+         "Live-verifier tail poll period, seconds "
+         "(cli/run_live_verifier)."),
     Knob("EGTPU_LOG", "str", "INFO",
          "Root log level for every CLI (cli/common)."),
     Knob("EGTPU_MIX_CHUNK_ROWS", "int", "64",

@@ -1,0 +1,26 @@
+"""Live verification plane: audit the election WHILE it runs.
+
+``LiveVerifier`` tails the framed record streams and the admission
+journal, folds each landed chunk through the batch verification plane,
+and checkpoints a resumable cursor + commitment ledger;
+``CommitmentLedger`` is the hash-chain/Merkle structure over verified
+chunks; ``BulletinBoard`` serves it mid-election over gRPC.  See
+README "Live verification".
+"""
+
+from electionguard_tpu.verify.live.commitment import (ChunkCommit,
+                                                      CommitmentLedger,
+                                                      chunk_leaf,
+                                                      frames_digest)
+from electionguard_tpu.verify.live.verifier import (CHECKPOINT_NAME,
+                                                    DONE, FINALIZING,
+                                                    TAILING,
+                                                    LiveVerifier)
+from electionguard_tpu.verify.live.board import (BulletinBoard,
+                                                 BulletinBoardClient)
+
+__all__ = [
+    "ChunkCommit", "CommitmentLedger", "chunk_leaf", "frames_digest",
+    "LiveVerifier", "CHECKPOINT_NAME", "TAILING", "FINALIZING", "DONE",
+    "BulletinBoard", "BulletinBoardClient",
+]

@@ -1,0 +1,128 @@
+"""BulletinBoardService: the public audit surface of the live verifier.
+
+A tiny read-only gRPC service over a ``LiveVerifier``'s commitment
+ledger and audit state, served through ``rpc_util.generic_service`` so
+the whole remote-plane substrate (tracing interceptors, fault
+injection, metrics, deadline classes) rides along for free.  Observers:
+
+* ``getRoot`` — current Merkle root + hash-chain head (poll this; a
+  root that ever contradicts an earlier inclusion proof is evidence).
+* ``getInclusionProof(chunk_index)`` — log-sized membership proof for
+  one committed chunk, checkable with
+  ``CommitmentLedger.verify_proof`` against the served root.
+* ``getAuditState`` — the verifier's running verdict, frame/chunk
+  counters, and audit lag (frames published but not yet verified).
+
+The board serves *between* the driver's ``poll()`` calls — handlers
+only read ledger/result state, they never advance the verifier, so a
+slow auditor can't stall verification.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from electionguard_tpu.obs import REGISTRY
+from electionguard_tpu.publish import pb
+from electionguard_tpu.remote import rpc_util
+
+_SERVICE = "BulletinBoardService"
+
+
+class BulletinBoard:
+    """Serve one ``LiveVerifier``'s ledger on ``port`` (0 = ephemeral).
+
+    ``lock`` (optional) serializes handler reads against the driver's
+    ``poll()`` mutations; the single-threaded CLI driver passes one so
+    a getRoot never reads a ledger mid-append."""
+
+    def __init__(self, live, port: int = 0, lock=None):
+        self.live = live
+        self._lock = lock or threading.Lock()
+        self.server, self.port = rpc_util.make_server(port)
+        self.server.add_generic_rpc_handlers((rpc_util.generic_service(
+            _SERVICE,
+            {"getRoot": self._get_root,
+             "getInclusionProof": self._get_inclusion_proof,
+             "getAuditState": self._get_audit_state,
+             "getMetrics": self._get_metrics}),))
+        self.server.start()
+
+    # ---- handlers -----------------------------------------------------
+    def _get_root(self, request, context):
+        with self._lock:
+            led = self.live.ledger
+            return pb.msg("BulletinRootResponse")(
+                root=led.root(), chain_head=led.head,
+                n_chunks=len(led.chunks),
+                n_frames=self.live.verified_frames)
+
+    def _get_inclusion_proof(self, request, context):
+        with self._lock:
+            led = self.live.ledger
+            idx = int(request.chunk_index)
+            if not 0 <= idx < len(led.chunks):
+                return pb.msg("InclusionProofResponse")(
+                    error=f"no chunk {idx}: ledger has "
+                          f"{len(led.chunks)} chunk(s)")
+            c = led.chunks[idx]
+            path, right = led.prove(idx)
+            return pb.msg("InclusionProofResponse")(
+                leaf=c.leaf, start_frame=c.start_frame,
+                n_frames=c.n_frames, chunk_digest=c.chunk_digest,
+                accepted=c.accepted, path=path, right=right,
+                root=led.root())
+
+    def _get_audit_state(self, request, context):
+        with self._lock:
+            s = self.live.audit_state()
+        return pb.msg("AuditStateResponse")(
+            status=s["status"],
+            frames_published=s["frames_published"],
+            frames_verified=s["frames_verified"],
+            ballots_admitted=s["ballots_admitted"],
+            chunks_accepted=s["chunks_accepted"],
+            chunks_rejected=s["chunks_rejected"],
+            audit_lag_frames=s["audit_lag_frames"],
+            verdict_ok=s["verdict_ok"],
+            errors=s["errors"])
+
+    def _get_metrics(self, request, context):
+        return REGISTRY.to_proto()
+
+    def shutdown(self, grace: float = 1.0) -> None:
+        self.server.stop(grace=grace)
+
+
+class BulletinBoardClient:
+    """Observer-side stub (CLIs, tests, the e2e epilogue)."""
+
+    def __init__(self, url: str):
+        self._channel = rpc_util.make_channel(url)
+        self._stub = rpc_util.Stub(self._channel, _SERVICE)
+
+    def root(self, timeout: float = 30.0):
+        return self._stub.call("getRoot",
+                               pb.msg("BulletinRootRequest")(),
+                               timeout=timeout)
+
+    def inclusion_proof(self, chunk_index: int, timeout: float = 30.0):
+        resp = self._stub.call(
+            "getInclusionProof",
+            pb.msg("InclusionProofRequest")(chunk_index=chunk_index),
+            timeout=timeout)
+        if resp.error:
+            raise ValueError(resp.error)
+        return resp
+
+    def audit_state(self, timeout: float = 30.0):
+        return self._stub.call("getAuditState",
+                               pb.msg("AuditStateRequest")(),
+                               timeout=timeout)
+
+    def metrics(self, timeout: float = 30.0):
+        return self._stub.call("getMetrics", pb.msg("MetricsRequest")(),
+                               timeout=timeout)
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,163 @@
+"""Chunk commitment ledger: the bulletin board's cryptographic spine.
+
+The live verifier commits to every chunk it verifies, in stream order,
+with two structures over the same leaves:
+
+* a **hash chain** (``head``) — O(1) state, recomputed append-only, so
+  a checkpoint needs only the previous head to extend it.  Observers
+  polling ``getRoot`` can detect a rewritten past (any change to an
+  already-committed chunk changes every later head).
+* a **Merkle tree** (``root`` + ``prove``/``verify_proof``) — so an
+  auditor holding one chunk's bytes can check membership against the
+  published root with a log-sized proof, without the whole ledger.
+
+Leaf preimages bind everything that makes a chunk *that* chunk: its
+index, its frame span in the stream, the sha256 of its on-disk framed
+bytes, and whether the verifier accepted it.  Domain-separation tags
+(``live-leaf``/``live-node``/``live-head``) keep leaves, interior
+nodes, and chain links from colliding.
+
+Determinism is the whole point: the terminal batch pass rebuilds this
+ledger from the finished record and must land on bit-identical ``root``
+and ``head`` — that equality is the sim's convergence oracle.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass
+
+
+def _h(*parts: bytes) -> bytes:
+    d = hashlib.sha256()
+    for p in parts:
+        d.update(p)
+    return d.digest()
+
+
+def chunk_leaf(index: int, start_frame: int, n_frames: int,
+               chunk_digest: bytes, accepted: bool) -> bytes:
+    """The 32-byte commitment to one verified chunk."""
+    return _h(b"live-leaf", struct.pack(">QQQB", index, start_frame,
+                                        n_frames, 1 if accepted else 0),
+              chunk_digest)
+
+
+def frames_digest(frames: list[bytes]) -> bytes:
+    """sha256 over the chunk's framed on-disk bytes (header + payload
+    per frame) — byte-identical to hashing the file span itself."""
+    d = hashlib.sha256()
+    for fr in frames:
+        d.update(struct.pack(">I", len(fr)))
+        d.update(fr)
+    return d.digest()
+
+
+@dataclass
+class ChunkCommit:
+    """One ledger row (what ``getInclusionProof`` serves back)."""
+    index: int
+    start_frame: int
+    n_frames: int
+    chunk_digest: bytes
+    accepted: bool
+
+    @property
+    def leaf(self) -> bytes:
+        return chunk_leaf(self.index, self.start_frame, self.n_frames,
+                          self.chunk_digest, self.accepted)
+
+
+class CommitmentLedger:
+    """Append-only ledger of chunk commitments.
+
+    The Merkle root is recomputed from the leaf list on demand (chunk
+    counts are bounded by record size / chunk size — thousands, not
+    millions — so the O(n) rebuild is noise next to one chunk's proof
+    verification)."""
+
+    EMPTY_ROOT = _h(b"live-empty")
+
+    def __init__(self):
+        self.chunks: list[ChunkCommit] = []
+        self.head: bytes = _h(b"live-head")   # chain genesis
+
+    def append(self, start_frame: int, n_frames: int,
+               chunk_digest: bytes, accepted: bool) -> ChunkCommit:
+        c = ChunkCommit(len(self.chunks), start_frame, n_frames,
+                        chunk_digest, accepted)
+        self.chunks.append(c)
+        self.head = _h(b"live-head", self.head, c.leaf)
+        return c
+
+    # -- Merkle ---------------------------------------------------------
+    def root(self) -> bytes:
+        level = [c.leaf for c in self.chunks]
+        if not level:
+            return self.EMPTY_ROOT
+        while len(level) > 1:
+            nxt = []
+            for i in range(0, len(level) - 1, 2):
+                nxt.append(_h(b"live-node", level[i], level[i + 1]))
+            if len(level) % 2:
+                nxt.append(level[-1])   # odd node promotes unchanged
+            level = nxt
+        return level[0]
+
+    def prove(self, index: int) -> tuple[list[bytes], list[bool]]:
+        """Sibling path for leaf ``index``: ``(siblings, is_right)``
+        where ``is_right[i]`` says the sibling sits to the RIGHT of the
+        running hash at level ``i``."""
+        if not 0 <= index < len(self.chunks):
+            raise IndexError(f"no chunk {index} in ledger of "
+                             f"{len(self.chunks)}")
+        path: list[bytes] = []
+        right: list[bool] = []
+        level = [c.leaf for c in self.chunks]
+        pos = index
+        while len(level) > 1:
+            sib = pos ^ 1
+            if sib < len(level):
+                path.append(level[sib])
+                right.append(sib > pos)
+            nxt = []
+            for i in range(0, len(level) - 1, 2):
+                nxt.append(_h(b"live-node", level[i], level[i + 1]))
+            if len(level) % 2:
+                nxt.append(level[-1])
+            level = nxt
+            pos //= 2
+        return path, right
+
+    @staticmethod
+    def verify_proof(leaf: bytes, path: list[bytes], right: list[bool],
+                     root: bytes) -> bool:
+        h = leaf
+        for sib, r in zip(path, right):
+            h = _h(b"live-node", h, sib) if r else _h(b"live-node",
+                                                      sib, h)
+        return h == root
+
+    # -- checkpoint (de)hydration --------------------------------------
+    def to_state(self) -> dict:
+        return {"head": self.head.hex(),
+                "chunks": [{"start_frame": c.start_frame,
+                            "n_frames": c.n_frames,
+                            "digest": c.chunk_digest.hex(),
+                            "accepted": c.accepted}
+                           for c in self.chunks]}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "CommitmentLedger":
+        led = cls()
+        for row in state.get("chunks", []):
+            led.append(int(row["start_frame"]), int(row["n_frames"]),
+                       bytes.fromhex(row["digest"]),
+                       bool(row["accepted"]))
+        want = state.get("head")
+        if want is not None and led.head.hex() != want:
+            raise ValueError("commitment checkpoint head does not match "
+                             "its own chunk list (checkpoint tampered "
+                             "or mixed)")
+        return led

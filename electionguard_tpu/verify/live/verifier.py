@@ -1,0 +1,291 @@
+"""LiveVerifier: verify the election record WHILE it is being written.
+
+The terminal batch verifier (``verify/verifier.py``) is already a
+streaming fold: ``verify_ballots_partial`` over chunks into
+``_BallotAggregates``, then ``finalize`` for the record-level checks.
+This module runs exactly that fold, but *against a stream that is still
+growing* — a ``publish.framing.FramedTailer`` follows the framed
+encrypted-ballot stream, and every time ``EGTPU_LIVE_CHUNK`` frames
+have fully landed the chunk goes through the same V4/V5/V6 plane (RLC
+screens with naive fallback, ``EGTPU_VERIFY_BATCH``) the batch pass
+would use.  Each verified chunk is committed into a
+``CommitmentLedger`` (hash chain + Merkle root) that the bulletin
+board (``verify/live/board.py``) serves mid-election.
+
+**Convergence is the contract** (the sim's ``live_convergence``
+oracle): because chunk boundaries are a pure function of frame INDEX
+(chunk *i* is frames ``[i*chunk, (i+1)*chunk)``) — never of poll
+timing — and the fold itself is deterministic, the live pass's final
+verdict, error list, chunk-accept set, and commitment root are
+bit-identical to a terminal batch pass over the finished stream, no
+matter how the polls interleaved with the writer or how often the live
+verifier was SIGKILL'd and resumed.
+
+**Crash safety**: after every committed chunk the verifier writes an
+atomic checkpoint (tmp + fsync + rename) holding the stream cursor,
+the serialized aggregates/result, and the ledger.  A SIGKILL between
+"chunk verified" and "checkpoint written" just means the next
+incarnation re-verifies that chunk from disk — same bytes, same fold,
+same commitment.
+
+A torn tail at finalize time (writer died mid-append) is DROPPED, the
+same policy ``repair_frame_stream`` applies during crash recovery —
+the torn frame's admission was never acknowledged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from electionguard_tpu.obs import REGISTRY, span
+from electionguard_tpu.publish import framing, pb, serialize
+from electionguard_tpu.publish.election_record import ElectionRecord
+from electionguard_tpu.publish.publisher import _BALLOTS, Consumer
+from electionguard_tpu.serve.journal import JOURNAL_NAME
+from electionguard_tpu.utils import knobs
+from electionguard_tpu.verify.live.commitment import (CommitmentLedger,
+                                                      frames_digest)
+from electionguard_tpu.verify.verifier import (VerificationResult,
+                                               Verifier,
+                                               _BallotAggregates)
+
+CHECKPOINT_NAME = "live_checkpoint.json"
+
+#: audit_state() status values (mirrored into the bulletin-board proto)
+TAILING, FINALIZING, DONE = "TAILING", "FINALIZING", "DONE"
+
+
+def _agg_to_state(agg: _BallotAggregates) -> dict:
+    return {
+        "prods": {f"{c}\x1f{s}": [str(pa), str(pb)]
+                  for (c, s), (pa, pb) in agg.prods.items()},
+        "cast_count": agg.cast_count,
+        "total_count": agg.total_count,
+        "spoiled_ids": sorted(agg.spoiled_ids),
+        "prev_code": agg.prev_code.hex() if agg.prev_code else None,
+        "segments": [[seed.hex(), n, code.hex()]
+                     for seed, n, code in agg.segments],
+        "seen_ids": sorted(agg.seen_ids),
+        "dup_ids": sorted(agg.dup_ids),
+    }
+
+
+def _agg_from_state(state: dict) -> _BallotAggregates:
+    agg = _BallotAggregates()
+    for k, (pa, pb) in state["prods"].items():
+        c, s = k.split("\x1f", 1)
+        agg.prods[(c, s)] = (int(pa), int(pb))
+    agg.cast_count = int(state["cast_count"])
+    agg.total_count = int(state["total_count"])
+    agg.spoiled_ids = set(state["spoiled_ids"])
+    pc = state.get("prev_code")
+    agg.prev_code = bytes.fromhex(pc) if pc else None
+    agg.segments = [[bytes.fromhex(a), int(n), bytes.fromhex(b)]
+                    for a, n, b in state["segments"]]
+    agg.seen_ids = set(state["seen_ids"])
+    agg.dup_ids = set(state["dup_ids"])
+    return agg
+
+
+class LiveVerifier:
+    """Incremental verifier over a growing record directory.
+
+    Drive it with ``poll()`` while the election runs, then ``finalize()``
+    once the producing workflow is done (tally/decryption artifacts
+    landed, ballot stream closed).  ``audit_state()`` / the ledger are
+    what the bulletin board serves between polls."""
+
+    def __init__(self, record_dir: str, group,
+                 chunk: Optional[int] = None,
+                 checkpoint_path: Optional[str] = None,
+                 max_frame: Optional[int] = None,
+                 mesh=None):
+        self.dir = record_dir
+        self.group = group
+        self.chunk = chunk if chunk is not None else \
+            knobs.get_int("EGTPU_LIVE_CHUNK")
+        self.checkpoint_path = checkpoint_path or \
+            knobs.get_str("EGTPU_LIVE_CHECKPOINT") or \
+            os.path.join(record_dir, CHECKPOINT_NAME)
+        max_frame = max_frame if max_frame is not None else \
+            knobs.get_int("EGTPU_LIVE_MAX_FRAME")
+
+        self._consumer = Consumer(record_dir, group)
+        record = ElectionRecord(self._consumer.read_election_initialized())
+        # shard manifests flip V6 into segment mode — must be decided
+        # before the first chunk, like the batch feeders do
+        record.shard_manifests = self._consumer.read_shard_manifests()
+        self._verifier = Verifier(record, group, chunk_size=self.chunk,
+                                  mesh=mesh)
+
+        self.res = VerificationResult()
+        self.agg = _BallotAggregates()
+        self.ledger = CommitmentLedger()
+        self.status = TAILING
+        self._pending: list[bytes] = []   # landed frames < one chunk
+        self._tailer = framing.FramedTailer(
+            os.path.join(record_dir, _BALLOTS), max_frame=max_frame)
+        # cursor of the last COMMITTED chunk boundary (what resume uses;
+        # the tailer may be further ahead, holding _pending)
+        self.verified_offset = 0
+        self.verified_frames = 0
+
+        self._chunks_counter = REGISTRY.counter(
+            "live_chunks_verified_total")
+        self._lag_gauge = REGISTRY.gauge("live_audit_lag_frames")
+        self._restore_checkpoint()
+
+    # -- checkpoint -----------------------------------------------------
+    def _restore_checkpoint(self) -> None:
+        path = self.checkpoint_path
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            state = json.load(f)
+        self.verified_offset = int(state["verified_offset"])
+        self.verified_frames = int(state["verified_frames"])
+        self.res = VerificationResult(
+            checks=dict(state["res"]["checks"]),
+            errors=list(state["res"]["errors"]))
+        self.agg = _agg_from_state(state["agg"])
+        self.ledger = CommitmentLedger.from_state(state["ledger"])
+        self.status = state.get("status", TAILING)
+        # resume the tail exactly at the committed boundary: frames the
+        # dead incarnation had polled but not committed re-read from disk
+        self._tailer.offset = self.verified_offset
+        self._tailer.frames = self.verified_frames
+        self._pending = []
+
+    def _write_checkpoint(self) -> None:
+        state = {
+            "version": 1,
+            "verified_offset": self.verified_offset,
+            "verified_frames": self.verified_frames,
+            "res": {"checks": self.res.checks, "errors": self.res.errors},
+            "agg": _agg_to_state(self.agg),
+            "ledger": self.ledger.to_state(),
+            "status": self.status,
+        }
+        tmp = self.checkpoint_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.checkpoint_path)
+
+    # -- the incremental fold -------------------------------------------
+    def _verify_chunk(self, frames: list[bytes]) -> bool:
+        """One chunk through the batch plane; returns accepted (no new
+        errors) and commits it into the ledger + checkpoint."""
+        start_frame = self.verified_frames
+        with span("verify.live.chunk",
+                  {"start_frame": start_frame, "n_frames": len(frames)}):
+            ballots = []
+            for fr in frames:
+                m = pb.EncryptedBallot()
+                m.ParseFromString(fr)
+                ballots.append(serialize.import_encrypted_ballot(
+                    self.group, m))
+            errors_before = len(self.res.errors)
+            self._verifier.verify_ballots_partial(ballots, self.res,
+                                                  self.agg)
+            accepted = len(self.res.errors) == errors_before
+        self.verified_frames += len(frames)
+        self.verified_offset += sum(framing.HEADER_LEN + len(fr)
+                                    for fr in frames)
+        self.ledger.append(start_frame, len(frames),
+                           frames_digest(frames), accepted)
+        self._chunks_counter.inc()
+        self._write_checkpoint()
+        return accepted
+
+    def poll(self) -> int:
+        """Ingest newly landed frames; verify + commit every chunk that
+        completed.  Returns the number of chunks committed this poll."""
+        self._pending.extend(self._tailer.poll())
+        done = 0
+        while len(self._pending) >= self.chunk:
+            chunk, self._pending = (self._pending[:self.chunk],
+                                    self._pending[self.chunk:])
+            self._verify_chunk(chunk)
+            done += 1
+        self._lag_gauge.set(self.audit_lag_frames())
+        return done
+
+    def finalize(self) -> VerificationResult:
+        """Stream is complete: drain the tail (the final partial chunk
+        is its own commitment; torn trailing bytes are dropped), load
+        the terminal artifacts, and run the record-level checks."""
+        self.status = FINALIZING
+        self.poll()
+        if self._pending:
+            self._verify_chunk(self._pending)
+            self._pending = []
+        c = self._consumer
+        record = self._verifier.record
+        if c.has_tally_result():
+            record.tally_result = c.read_tally_result()
+        if c.has_decryption_result():
+            record.decryption_result = c.read_decryption_result()
+        record.spoiled_ballot_tallies = list(
+            c.iterate_spoiled_ballot_tallies())
+        record.shard_manifests = c.read_shard_manifests()
+        if c.has_mix_stages():
+            record.mix_stages = c.read_mix_stages()
+
+        def mix_input_fn():
+            from electionguard_tpu.mixnet.verify_mix import \
+                rows_from_ballots
+            return rows_from_ballots(c.iterate_encrypted_ballots())
+
+        self._verifier.mix_input_fn = mix_input_fn
+        with span("verify.live.finalize",
+                  {"n_frames": self.verified_frames,
+                   "n_chunks": len(self.ledger.chunks)}):
+            res = self._verifier.finalize(self.res, self.agg)
+        self.status = DONE
+        self._lag_gauge.set(self.audit_lag_frames())
+        self._write_checkpoint()
+        return res
+
+    # -- audit surface --------------------------------------------------
+    def frames_published(self) -> int:
+        """Complete frames on disk right now (committed + pending)."""
+        return self._tailer.frames
+
+    def audit_lag_frames(self) -> int:
+        return self.frames_published() - self.verified_frames
+
+    def ballots_admitted(self) -> int:
+        """Admissions currently journaled (complete lines only, drops
+        tombstoned out) — fsync'd-but-unpublished entries show up here
+        as audit LAG, never as an error."""
+        path = os.path.join(self.dir, JOURNAL_NAME)
+        if not os.path.exists(path):
+            return 0
+        with open(path, "rb") as f:
+            lines, _torn = framing.complete_lines(f.read())
+        n = 0
+        for raw in lines:
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue   # audit counter only; replay() owns rejection
+            n += -1 if rec.get("drop") else 1
+        return max(0, n)
+
+    def audit_state(self) -> dict:
+        chunks = self.ledger.chunks
+        return {
+            "status": self.status,
+            "frames_published": self.frames_published(),
+            "frames_verified": self.verified_frames,
+            "ballots_admitted": self.ballots_admitted(),
+            "chunks_accepted": sum(c.accepted for c in chunks),
+            "chunks_rejected": sum(not c.accepted for c in chunks),
+            "audit_lag_frames": self.audit_lag_frames(),
+            "verdict_ok": self.res.ok,
+            "errors": list(self.res.errors),
+        }

@@ -350,10 +350,22 @@ def main(argv=None) -> int:
                          "commits its first received key share, then "
                          "restarts from its resume file; the ceremony "
                          "must still complete (fault-injection harness)")
+    ap.add_argument("-liveVerify", dest="live_verify", action="store_true",
+                    help="launch the live verifier (verify/live) right "
+                         "after the key ceremony: it tails the record's "
+                         "ballot stream while phases 2-4 write it, serves "
+                         "a BulletinBoardService, and must end with <5%% "
+                         "of the verification work left when the "
+                         "decryption result lands")
     args = ap.parse_args(argv)
     if args.mix > 0 and args.mix_servers > 0:
         log.error("-mix and -mixServers are mutually exclusive (same "
                   "artifact, different topology)")
+        return 1
+    if args.live_verify and args.fabric_workers > 0:
+        log.error("-liveVerify tails the ballot stream as it is written; "
+                  "-fabricWorkers materializes it only at the final shard "
+                  "merge, so there is nothing to tail mid-election")
         return 1
     if args.chaos_fabric and args.fabric_workers < 2:
         log.error("-chaosKillEncryptionWorker needs -fabricWorkers >= 2 "
@@ -496,6 +508,38 @@ def main(argv=None) -> int:
                      "restart", args.chaos_guardian)
         log.info("[1] key ceremony took %.1fs", clock.now() - t0)
 
+        # ---- phase 1.5 (optional): live verifier tails the record ------------
+        # launched BEFORE any ballot exists so the whole stream is
+        # verified as it lands; it self-terminates once the decryption
+        # result is published and the stream goes quiet (gated in 5.5)
+        lv_cmd = None
+        lv_audit = os.path.join(out, "live_audit.json")
+        if args.live_verify:
+            from electionguard_tpu.publish import pb
+            lv_port = find_free_port()
+            lv_cmd = RunCommand.python_module(
+                "live-verifier", "electionguard_tpu.cli.run_live_verifier",
+                ["-in", record_dir, "-port", str(lv_port),
+                 "-chunk", str(max(1, args.nballots // 16)),
+                 "-audit", lv_audit, "-timeout", "900"] + group_flags,
+                cmd_out)
+            procs.append(lv_cmd)
+            lv_stub = Stub(make_plain_channel(f"localhost:{lv_port}"),
+                           "BulletinBoardService")
+            deadline = clock.now() + 60
+            while True:
+                try:
+                    lv_stub.call("getRoot",
+                                 pb.msg("BulletinRootRequest")(),
+                                 timeout=2.0)
+                    break
+                except Exception:  # noqa: BLE001 — still binding
+                    if clock.now() > deadline or lv_cmd.poll() is not None:
+                        return phase_fail("live-verify", [lv_cmd])
+                    clock.sleep(0.25)
+            log.info("[1.5] live verifier tailing %s (bulletin board on "
+                     "port %d)", record_dir, lv_port)
+
         # ---- phase 2: fake ballots + batch encryption ------------------------
         t0 = clock.now()
         phases.begin("phase.encrypt")
@@ -529,6 +573,18 @@ def main(argv=None) -> int:
         if not wait_all([acc], timeout=300):
             return phase_fail("accumulate", [acc])
         log.info("[3] tally accumulation took %.1fs", clock.now() - t0)
+        if lv_cmd is not None:
+            # mid-election probe: the bulletin board must already be
+            # serving a commitment over the landed ballots (the root it
+            # serves here is later pinned by the inclusion proofs)
+            st = lv_stub.call("getAuditState",
+                              pb.msg("AuditStateRequest")(), timeout=30.0)
+            rt = lv_stub.call("getRoot", pb.msg("BulletinRootRequest")(),
+                              timeout=30.0)
+            log.info("[3] live audit mid-election: %s, %d/%d frames "
+                     "verified (lag %d), root=%s", st.status,
+                     st.frames_verified, st.frames_published,
+                     st.audit_lag_frames, rt.root.hex()[:16])
 
         # ---- phase 3.5: mixnet (optional) -------------------------------------
         if args.mix > 0:
@@ -638,6 +694,35 @@ def main(argv=None) -> int:
         if code != 0:
             return phase_fail("verify", [ver])
         log.info("[5] verification took %.1fs", clock.now() - t0)
+
+        # ---- phase 5.5 (optional): live verifier convergence gate ------------
+        # the live verifier saw the decryption result land; it drains its
+        # residual tail, finalizes, and exits with the verifier's verdict
+        # contract.  Acceptance: green, and <5% of the stream was still
+        # unverified at the moment the election closed.
+        if lv_cmd is not None:
+            t0 = clock.now()
+            phases.begin("phase.live-verify")
+            code = lv_cmd.wait_for(timeout=300)
+            lv_cmd.show()
+            if code != 0:
+                return phase_fail("live-verify", [lv_cmd])
+            with open(lv_audit) as f:
+                audit = json.load(f)
+            if not audit["verdict_ok"]:
+                log.error("live verifier ended red: %s", audit["errors"])
+                return phase_fail("live-verify", [lv_cmd])
+            if audit["residual_fraction"] >= 0.05:
+                log.error("live verifier left %.1f%% of the stream "
+                          "unverified when the election closed (gate is "
+                          "<5%%)", 100 * audit["residual_fraction"])
+                return phase_fail("live-verify", [lv_cmd])
+            log.info("[5.5] live verification converged: root=%s chunks=%d "
+                     "residual=%.2f%% (%d frames, drained in %.2fs)",
+                     audit["root"][:16], audit["n_chunks"],
+                     100 * audit["residual_fraction"],
+                     audit["residual_frames_at_close"],
+                     audit["residual_verify_s"])
 
         phases.end()
 

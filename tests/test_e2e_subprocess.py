@@ -120,6 +120,33 @@ def test_five_phase_workflow_federated_mix(tmp_path):
     assert stage_of == {"mix-0": 0, "mix-1": 1}
 
 
+def test_five_phase_workflow_live_verify(tmp_path):
+    """-liveVerify: the live verifier (verify/live) launches right after
+    the key ceremony, tails the framed ballot stream while phases 2-4
+    write it, serves a BulletinBoardService the driver probes
+    mid-election, then drains and finalizes when the decryption result
+    lands.  Acceptance: the audit artifact is green with <5% of the
+    stream unverified at close, and the live verdict matches the batch
+    phase-5 verifier that runs in the same workflow."""
+    import json
+
+    proc = _run_workflow(tmp_path, "tiny", nballots=8, timeout=600,
+                         extra_flags=["-liveVerify"])
+    out = proc.stdout + proc.stderr
+    assert "live verifier tailing" in out
+    assert "live audit mid-election" in out
+    assert "[5.5] live verification converged" in out
+    with open(os.path.join(str(tmp_path), "live_audit.json")) as f:
+        audit = json.load(f)
+    assert audit["verdict_ok"] and audit["status"] == "DONE"
+    assert audit["residual_fraction"] < 0.05
+    assert audit["frames_verified"] == audit["frames_published"] == 8
+    assert audit["chunks_rejected"] == 0 and audit["n_chunks"] >= 8
+    assert len(audit["root"]) == 64   # hex sha256 commitment root
+    # both verifiers (live + batch phase 5) dumped a green summary
+    assert out.count("PASS V6.ballot_chaining") == 2
+
+
 def test_five_phase_workflow_federated_mix_chaos_kill(tmp_path):
     """Subprocess SIGKILL drill: mix-server-0 hard-exits (os._exit, no
     drain) right after its first shuffle commits.  The coordinator's

@@ -35,6 +35,11 @@ def test_walk_covers_new_packages_and_obs_modules():
     # the Byzantine adversary plane (the corpus and the named-error
     # registry its soundness oracle matches on)
     assert {"sim/adversary.py", "utils/errors.py"} <= rels
+    # the live verification plane (streaming verifier + bulletin board)
+    # and the shared frame codec it tails
+    assert {"verify/live/__init__.py", "verify/live/verifier.py",
+            "verify/live/commitment.py", "verify/live/board.py",
+            "publish/framing.py"} <= rels
 
 
 def test_no_bare_print_in_library_code():

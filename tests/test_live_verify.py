@@ -1,0 +1,320 @@
+"""Live verification plane: tailing edge cases + live/batch convergence.
+
+Pins the contracts of ``verify/live``:
+
+* a torn stream tail is "not yet", never an error — the tailer retries
+  and converges once the writer completes the frame;
+* a journaled-but-unpublished admission (fsync'd WAL entry whose ballot
+  has not reached the record stream) is audit LAG, never red;
+* SIGKILL anywhere — after a checkpoint, or between "chunk verified"
+  and "checkpoint written" — resumes to the SAME final verdict, error
+  list, chunk-accept set, and commitment root as an uncrashed run;
+* live and terminal batch verification agree bit-for-bit, on green
+  records and on tampered ones (both red, same offender);
+* the commitment ledger's inclusion proofs verify against the root the
+  bulletin board serves, over real gRPC.
+"""
+
+import json
+import os
+import shutil
+import struct
+
+import pytest
+
+from electionguard_tpu.publish import serialize
+from electionguard_tpu.publish.election_record import ElectionRecord
+from electionguard_tpu.publish.publisher import Consumer, Publisher
+from electionguard_tpu.utils import errors
+from electionguard_tpu.verify.live import (BulletinBoard,
+                                           BulletinBoardClient,
+                                           CommitmentLedger, LiveVerifier)
+from electionguard_tpu.verify.verifier import Verifier
+
+CHUNK = 4   # 20 ballots -> 5 chunks: boundaries exercise the ledger
+
+
+def _frames(election):
+    return [serialize.publish_encrypted_ballot(b).SerializeToString()
+            for b in election["encrypted"]]
+
+
+def _init_dir(election, tmp_path, name="record"):
+    out = str(tmp_path / name)
+    Publisher(out).write_election_initialized(election["init"])
+    return out
+
+
+def _append_frames(record_dir, frames, torn=b""):
+    """Append complete frames (+ optionally torn trailing bytes) to the
+    ballot stream, like the serving plane's incremental flushes."""
+    path = os.path.join(record_dir, "encrypted_ballots.pb")
+    with open(path, "ab") as f:
+        for fr in frames:
+            f.write(struct.pack(">I", len(fr)) + fr)
+        if torn:
+            f.write(torn)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _write_terminal(election, record_dir):
+    pub = Publisher(record_dir)
+    pub.write_tally_result(election["tally_result"])
+    pub.write_decryption_result(election["decryption_result"])
+
+
+def _batch_verify(election, record_dir):
+    """The terminal batch pass at the live chunk size (identical chunk
+    boundaries make even the error ORDER comparable)."""
+    g = election["group"]
+    consumer = Consumer(record_dir, g)
+    record = ElectionRecord(consumer.read_election_initialized())
+    record.tally_result = consumer.read_tally_result()
+    record.decryption_result = consumer.read_decryption_result()
+    record.encrypted_ballots = consumer.iterate_encrypted_ballots()
+    return Verifier(record, g, chunk_size=CHUNK).verify()
+
+
+def _oneshot_live(election, record_dir, tmp_path, name):
+    """A fresh LiveVerifier over the finished record (the batch-side
+    ledger rebuild the convergence oracle compares roots against)."""
+    live = LiveVerifier(record_dir, election["group"], chunk=CHUNK,
+                        checkpoint_path=str(tmp_path / name))
+    res = live.finalize()
+    return live, res
+
+
+def test_torn_tail_then_completion(election, tmp_path):
+    record_dir = _init_dir(election, tmp_path)
+    frames = _frames(election)
+    live = LiveVerifier(record_dir, election["group"], chunk=CHUNK)
+
+    # first flush lands 6 complete frames plus a torn half-frame
+    torn = struct.pack(">I", len(frames[6])) + frames[6][:5]
+    _append_frames(record_dir, frames[:6], torn=torn)
+    live.poll()
+    assert live.verified_frames == 4          # one full chunk committed
+    assert live.frames_published() == 6       # torn frame NOT counted
+    assert live.audit_state()["verdict_ok"]
+
+    # the writer completes the torn frame and the rest of the stream
+    path = os.path.join(record_dir, "encrypted_ballots.pb")
+    with open(path, "ab") as f:
+        f.write(frames[6][5:])
+    _append_frames(record_dir, frames[7:])
+    live.poll()
+    assert live.verified_frames == 20
+    _write_terminal(election, record_dir)
+    res = live.finalize()
+    assert res.ok, res.summary()
+    assert len(live.ledger.chunks) == 5
+    assert all(c.accepted for c in live.ledger.chunks)
+
+    # bit-identical to the terminal batch pass and its ledger rebuild
+    batch = _batch_verify(election, record_dir)
+    assert (res.checks, res.errors) == (batch.checks, batch.errors)
+    ref, ref_res = _oneshot_live(election, record_dir, tmp_path, "ref.json")
+    assert ref_res.ok
+    assert live.ledger.root() == ref.ledger.root()
+    assert live.ledger.head == ref.ledger.head
+
+
+def test_journal_gap_is_lag_not_error(election, tmp_path):
+    """Admissions fsync'd into the WAL but not yet published (the crash
+    window the serving plane replays) must show as audit lag only."""
+    from electionguard_tpu.serve import journal as wal
+    record_dir = _init_dir(election, tmp_path)
+    frames = _frames(election)
+    _append_frames(record_dir, frames[:4])
+
+    j = wal.AdmissionJournal(os.path.join(record_dir, wal.JOURNAL_NAME))
+    for b in election["ballots"][:6]:
+        j.append(b, False)
+    j.append_drop(election["ballots"][5].ballot_id)
+    # torn trailing WAL line: mid-append crash, never ack'd
+    with open(j.path, "ab") as f:
+        f.write(b'{"id": "torn')
+    j.close()
+
+    live = LiveVerifier(record_dir, election["group"], chunk=CHUNK)
+    live.poll()
+    s = live.audit_state()
+    assert s["ballots_admitted"] == 5         # 6 admitted - 1 dropped
+    assert s["frames_verified"] == 4
+    assert s["verdict_ok"] and not s["errors"]
+    assert s["status"] == "TAILING"
+
+
+def test_sigkill_resume_converges(election, tmp_path):
+    """Kill the live verifier at a checkpoint AND in the window between
+    'chunk verified' and 'checkpoint written': both resumes end
+    bit-identical to an uncrashed run."""
+    record_dir = _init_dir(election, tmp_path)
+    frames = _frames(election)
+    ckpt = os.path.join(record_dir, "live_checkpoint.json")
+
+    live = LiveVerifier(record_dir, election["group"], chunk=CHUNK)
+    _append_frames(record_dir, frames[:9])
+    live.poll()                               # commits chunks 0, 1
+    assert live.verified_frames == 8
+    ckpt_after_2 = ckpt + ".saved"
+    shutil.copy(ckpt, ckpt_after_2)
+
+    _append_frames(record_dir, frames[9:])
+    live.poll()                               # commits chunks 2, 3, 4
+    del live                                  # SIGKILL incarnation 1
+
+    # crash case A: died right after a checkpoint — resume from it
+    _write_terminal(election, record_dir)
+    live2 = LiveVerifier(record_dir, election["group"], chunk=CHUNK)
+    assert live2.verified_frames == 20        # restored, not re-verified
+    res2 = live2.finalize()
+    assert res2.ok, res2.summary()
+
+    # crash case B: the checkpoint for chunks 2-4 was never written —
+    # the stale checkpoint resumes at frame 8 and re-verifies from disk
+    shutil.copy(ckpt_after_2, ckpt)
+    live3 = LiveVerifier(record_dir, election["group"], chunk=CHUNK)
+    assert live3.verified_frames == 8
+    res3 = live3.finalize()
+    assert res3.ok
+    assert (res3.checks, res3.errors) == (res2.checks, res2.errors)
+    assert live3.ledger.root() == live2.ledger.root()
+    assert live3.ledger.head == live2.ledger.head
+    assert [c.accepted for c in live3.ledger.chunks] == \
+        [c.accepted for c in live2.ledger.chunks]
+
+    # and both equal the terminal batch pass
+    batch = _batch_verify(election, record_dir)
+    assert (res2.checks, res2.errors) == (batch.checks, batch.errors)
+
+
+def test_tampered_record_live_equals_batch(election, tmp_path):
+    """Swap two mid-stream frames (breaks the V6 code chain): live and
+    batch must BOTH go red, naming the same offender ballots, and the
+    live pass must flag it at the chunk containing the tamper."""
+    record_dir = _init_dir(election, tmp_path)
+    frames = _frames(election)
+    frames[10], frames[11] = frames[11], frames[10]
+    _append_frames(record_dir, frames)
+    _write_terminal(election, record_dir)
+
+    live, res = _oneshot_live(election, record_dir, tmp_path, "live.json")
+    batch = _batch_verify(election, record_dir)
+    assert not res.ok and not batch.ok
+    assert (res.checks, res.errors) == (batch.checks, batch.errors)
+    assert any("V6" in e for e in res.errors)
+
+    # the accept-set localizes the tamper: chunk 2 (frames 8-11) red,
+    # chunk 3 (frames 12-15) red (its first seed points at the swap),
+    # everything else green
+    accepted = [c.accepted for c in live.ledger.chunks]
+    assert accepted == [True, True, False, False, True]
+
+
+def test_bulletin_board_roundtrip(election, tmp_path):
+    record_dir = _init_dir(election, tmp_path)
+    _append_frames(record_dir, _frames(election))
+    _write_terminal(election, record_dir)
+    live, res = _oneshot_live(election, record_dir, tmp_path, "live.json")
+    assert res.ok
+
+    board = BulletinBoard(live, port=0)
+    try:
+        client = BulletinBoardClient(f"localhost:{board.port}")
+        root = client.root()
+        assert root.root == live.ledger.root()
+        assert root.chain_head == live.ledger.head
+        assert root.n_chunks == 5 and root.n_frames == 20
+        for idx in range(root.n_chunks):
+            proof = client.inclusion_proof(idx)
+            assert CommitmentLedger.verify_proof(
+                proof.leaf, list(proof.path), list(proof.right),
+                proof.root)
+            assert proof.accepted
+        with pytest.raises(ValueError, match="no chunk 99"):
+            client.inclusion_proof(99)
+        s = client.audit_state()
+        assert s.status == "DONE" and s.verdict_ok
+        assert s.frames_verified == 20 and s.audit_lag_frames == 0
+        m = client.metrics()
+        assert m.counters["live_chunks_verified_total"] >= 5
+        client.close()
+    finally:
+        board.shutdown()
+
+
+def test_checkpoint_is_json_and_survives_reload(election, tmp_path):
+    """The checkpoint must round-trip every aggregate the finalize pass
+    needs (V7 products, chain tail, spoiled/dup bookkeeping)."""
+    record_dir = _init_dir(election, tmp_path)
+    _append_frames(record_dir, _frames(election))
+    live = LiveVerifier(record_dir, election["group"], chunk=CHUNK)
+    live.poll()
+    with open(live.checkpoint_path) as f:
+        state = json.load(f)
+    assert state["verified_frames"] == 20
+    assert state["agg"]["prev_code"]
+    assert state["agg"]["prods"]
+
+    live2 = LiveVerifier(record_dir, election["group"], chunk=CHUNK)
+    assert live2.agg.prods == live.agg.prods
+    assert live2.agg.prev_code == live.agg.prev_code
+    assert live2.ledger.head == live.ledger.head
+
+
+def test_corrupt_frame_is_red_not_retry(election, tmp_path):
+    """A header over the sanity bound is a corrupt stream: the tailer
+    raises the NAMED error immediately instead of waiting forever."""
+    from electionguard_tpu.publish import framing
+    record_dir = _init_dir(election, tmp_path)
+    frames = _frames(election)
+    _append_frames(record_dir, frames[:4])
+    path = os.path.join(record_dir, "encrypted_ballots.pb")
+    with open(path, "ab") as f:   # insane length header + some bytes
+        f.write(struct.pack(">I", 1 << 30) + b"garbage")
+
+    live = LiveVerifier(record_dir, election["group"], chunk=CHUNK)
+    with pytest.raises(framing.CorruptFrameError) as ei:
+        live.poll()
+        live.poll()
+    assert "publish.corrupt_frame" in errors.classes_in(str(ei.value))
+
+
+def test_consumer_named_frame_errors(election, tmp_path):
+    """Satellite: Consumer's frame readers fail with the named classes
+    (oracle-attributable), not bare struct/ValueError."""
+    from electionguard_tpu.publish import framing
+    record_dir = _init_dir(election, tmp_path)
+    frames = _frames(election)
+    _append_frames(record_dir, frames[:2],
+                   torn=struct.pack(">I", 999) + b"short")
+    consumer = Consumer(record_dir, election["group"])
+    with pytest.raises(framing.TruncatedFrameError) as ei:
+        list(consumer.iterate_encrypted_ballots())
+    assert "publish.truncated_frame" in errors.classes_in(str(ei.value))
+    # TruncatedFrameError still IS an IOError: legacy recovery paths
+    # (and run_verifier's unreadable-record exit) keep working
+    assert isinstance(ei.value, IOError)
+
+
+def test_mix_stage_row_mismatch_named(election, tmp_path):
+    from electionguard_tpu.mixnet.stage import rows_from_ballots, run_stage
+    record_dir = _init_dir(election, tmp_path)
+    g = election["group"]
+    init = election["init"]
+    pads, datas = rows_from_ballots(election["encrypted"])
+    stage = run_stage(g, init.joint_public_key.value,
+                      init.extended_base_hash, 0, pads, datas, seed=b"t")
+    pub = Publisher(record_dir)
+    path = pub.write_mix_stage(g, stage)
+    # drop the final row frame: header n_rows now disagrees
+    from electionguard_tpu.publish.framing import read_frames
+    all_frames = list(read_frames(path))
+    with open(path, "wb") as f:
+        for fr in all_frames[:-1]:
+            f.write(struct.pack(">I", len(fr)) + fr)
+    with pytest.raises(IOError) as ei:
+        Consumer(record_dir, g).read_mix_stage(0)
+    assert "publish.mix_row_mismatch" in errors.classes_in(str(ei.value))

@@ -558,3 +558,34 @@ def test_slo_availability_burn_fast_and_slow_windows():
     assert len(burns) == 1 and burns[0].subject == "data"
     assert burns[0].attrs["fast_burn"] > 2.0
     assert burns[0].attrs["slow_burn"] > 2.0
+
+
+def test_slo_audit_lag_edge_triggered_and_knob_defaulted():
+    """The live-verification audit-lag objective: fires once when the
+    ``live_audit_lag_frames`` gauge passes the limit, clears and
+    re-arms when the verifier catches back up.  ``objective: null``
+    resolves the EGTPU_LIVE_AUDIT_LAG_MAX knob."""
+    from electionguard_tpu.obs import slo as slo_mod
+    eng = slo_mod.SLOEngine(slo_mod.load_config(
+        json.dumps({"audit_lag_frames": {"objective": 100}})))
+
+    def snap(lag):
+        return {"gauges": {"live_audit_lag_frames": lag}}
+
+    assert eng.evaluate(0.0, snap(50), []) == []
+    fired = eng.evaluate(1.0, snap(500), [])
+    assert [a.kind for a in fired] == ["audit_lag"]
+    assert fired[0].attrs == {"lag_frames": 500, "limit": 100}
+    # still lagging: edge-triggered, no re-fire
+    assert eng.evaluate(2.0, snap(600), []) == []
+    assert eng.health(2.0)[0] == "red"
+    # caught up: clears; a later excursion fires again
+    assert eng.evaluate(3.0, snap(0), []) == []
+    assert eng.health(3.0)[0] == "green"
+    assert len(eng.evaluate(4.0, snap(101), [])) == 1
+    # default objective comes from the registered knob
+    from electionguard_tpu.utils import knobs
+    dflt = slo_mod.SLOEngine(slo_mod.load_config(None))
+    lim = knobs.get_int("EGTPU_LIVE_AUDIT_LAG_MAX")
+    assert dflt.evaluate(0.0, snap(lim), []) == []
+    assert len(dflt.evaluate(1.0, snap(lim + 1), [])) == 1

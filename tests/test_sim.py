@@ -107,6 +107,71 @@ def test_oracle_catches_wedged_workflow():
     assert _classes(r) == {"liveness"}
 
 
+# ------------------------------------------------------- live verification
+
+def test_live_verify_plant_converges_green():
+    """The live-verify leg replays the finished record as a growing
+    stream through torn tails and SIGKILL/checkpoint resumes; on a
+    clean run every oracle (including live_convergence) stays green and
+    the report carries the agreed commitment root."""
+    r = run_sim(3, schedule=[], plant=("live-verify",))
+    assert r.ok, r.violations
+    assert r.live["converged"] and r.live["live_ok"]
+    assert len(r.live["live_root"]) == 64
+    assert all(r.live["live_accepts"])
+    # seed 3's stream 7 draws actually exercise the torture paths
+    assert r.live["crashes"] >= 1 and r.live["torn"] >= 1
+
+
+def test_live_verify_catches_tamper_at_equal_or_earlier_chunk():
+    """A tampered ballot turns the run red through the usual oracles,
+    while the live pass REJECTS the tampered chunk mid-stream — and the
+    live_convergence oracle holds: same verdict, same accept set, same
+    root as the terminal fold, detection no later than batch."""
+    r = run_sim(3, schedule=[], plant=("live-verify", "tamper-ballot"))
+    assert "verifier_green" in _classes(r)
+    assert "live_convergence" not in _classes(r)
+    assert r.live["converged"] and not r.live["live_ok"]
+    assert False in r.live["live_accepts"]
+
+
+def test_live_convergence_oracle_fires_on_divergence():
+    """The oracle itself must be able to trip: a rigged report with a
+    flipped accept bit / different root is a violation (anything less
+    and the sweep's bit-identical claim is theater)."""
+    from electionguard_tpu.sim import oracle
+    from electionguard_tpu.sim.cluster import SimOutcome
+
+    r = run_sim(3, schedule=[], plant=("live-verify",))
+    rep = dict(r.live)
+    out = SimOutcome(completed=True)
+    base = {
+        "chunk": rep["chunk"], "crashes": 0, "torn": 0,
+        "n_frames": rep["n_frames"],
+        "live_ok": True, "batch_ok": True,
+        "live_checks": {"V4": True}, "batch_checks": {"V4": True},
+        "live_errors": [], "batch_errors": [],
+        "live_accepts": [True, True], "batch_accepts": [True, True],
+        "live_first_reject": None, "batch_first_reject": None,
+        "live_root": rep["live_root"], "batch_root": rep["live_root"],
+        "live_head": "00", "batch_head": "00",
+    }
+    out.live_report = dict(base, live_accepts=[True, False],
+                           live_first_reject=1)
+    flipped = [v for v in oracle._live_convergence(out)]
+    assert any("chunk-accept set diverged" in v for v in flipped)
+    out.live_report = dict(base, batch_root="ab" * 32)
+    assert any("commitment diverged" in v
+               for v in oracle._live_convergence(out))
+    out.live_report = dict(base, batch_first_reject=0,
+                           live_first_reject=1,
+                           batch_accepts=base["live_accepts"])
+    assert any("equal-or-earlier" in v
+               for v in oracle._live_convergence(out))
+    out.live_report = dict(base)
+    assert oracle._live_convergence(out) == []
+
+
 # ------------------------------------------------------------------ shrinking
 
 def test_shrinker_minimizes_planted_lost_ballot():

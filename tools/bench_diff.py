@@ -53,6 +53,7 @@ METRICS: dict[str, tuple[bool, float]] = {
     "verify_batch_per_s": (True, 0.20),  # RLC/MSM verify (ballots/s/chip)
     "mixnet_rows_per_s": (True, 0.20),
     "mixfed_stages_per_s": (True, 0.20),
+    "live_chunks_per_s": (True, 0.20),   # streaming verifier tail rate
     "obs_spans_per_s": (True, 0.25),
     "setup_s": (False, 0.50),            # dominated by compile cache
 }

@@ -55,6 +55,11 @@ os.environ.setdefault(
     "JAX_COMPILATION_CACHE_DIR",
     os.path.join(tempfile.gettempdir(), "egtpu-jax-cache"))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
+# the watchdog bounds REAL time between yields; on an oversubscribed
+# sweep box (N workers per core) an honest CPU-starved task can blow
+# the 60s default — the virtual-time liveness horizon still catches
+# true wedges (race_matrix.py raises it the same way)
+os.environ.setdefault("EGTPU_SIM_WATCHDOG_S", "300")
 
 
 def _config(fast: bool):
@@ -63,19 +68,32 @@ def _config(fast: bool):
 
 
 def _sweep(start: int, count: int, fast: bool,
-           shrink_budget: int | None, adversaries: bool = False) -> dict:
+           shrink_budget: int | None, adversaries: bool = False,
+           live: bool = False) -> dict:
     """Run seeds [start, start+count) in THIS process; shrink failures."""
     from electionguard_tpu.sim import adversary
     from electionguard_tpu.sim.explore import run_sim
     from electionguard_tpu.sim.shrink import shrink
 
     cfg = _config(fast)
+    plant = ("live-verify",) if live else ()
     ok = 0
     failures = []
     attacks: dict[str, dict] = {}
     fired_total = 0
+    live_stats = {"runs": 0, "converged": 0, "crashes": 0, "torn": 0,
+                  "chunks": 0, "rejected_chunks": 0}
     for seed in range(start, start + count):
-        r = run_sim(seed, config=cfg, adversaries=adversaries)
+        r = run_sim(seed, config=cfg, adversaries=adversaries,
+                    plant=plant)
+        if r.live:
+            live_stats["runs"] += 1
+            live_stats["converged"] += bool(r.live["converged"])
+            live_stats["crashes"] += r.live["crashes"]
+            live_stats["torn"] += r.live["torn"]
+            live_stats["chunks"] += len(r.live["live_accepts"])
+            live_stats["rejected_chunks"] += sum(
+                not a for a in r.live["live_accepts"])
         if adversaries:
             # per-attack detection histogram: an instance counts as
             # detected exactly when the soundness oracle raised no
@@ -102,7 +120,7 @@ def _sweep(start: int, count: int, fast: bool,
             "trace_hash": r.trace_hash,
         }
         if r.schedule:
-            res = shrink(seed, r.schedule, config=cfg,
+            res = shrink(seed, r.schedule, config=cfg, plant=plant,
                          budget=shrink_budget)
             entry["shrunk_schedule"] = [asdict(e) for e in res.schedule]
             entry["shrunk_violations"] = res.violations
@@ -111,12 +129,12 @@ def _sweep(start: int, count: int, fast: bool,
         failures.append(entry)
         print(f"FAIL {r.summary()}", file=sys.stderr)
     return {"ok": ok, "failures": failures, "attacks": attacks,
-            "fired_total": fired_total}
+            "fired_total": fired_total, "live": live_stats}
 
 
 def _sweep_procs(start: int, count: int, procs: int, fast: bool,
                  shrink_budget: int | None,
-                 adversaries: bool = False) -> dict:
+                 adversaries: bool = False, live: bool = False) -> dict:
     """Shard the range over worker subprocesses, merge their chunks."""
     per = (count + procs - 1) // procs
     jobs = []
@@ -134,10 +152,14 @@ def _sweep_procs(start: int, count: int, procs: int, fast: bool,
             cmd.append("--fast")
         if adversaries:
             cmd.append("--adversaries")
+        if live:
+            cmd.append("--live")
         if shrink_budget is not None:
             cmd += ["--shrink-budget", str(shrink_budget)]
         jobs.append((subprocess.Popen(cmd), out))
-    merged = {"ok": 0, "failures": [], "attacks": {}, "fired_total": 0}
+    merged = {"ok": 0, "failures": [], "attacks": {}, "fired_total": 0,
+              "live": {"runs": 0, "converged": 0, "crashes": 0,
+                       "torn": 0, "chunks": 0, "rejected_chunks": 0}}
     rc = 0
     for proc, out in jobs:
         rc |= proc.wait()
@@ -146,6 +168,8 @@ def _sweep_procs(start: int, count: int, procs: int, fast: bool,
             merged["ok"] += chunk["ok"]
             merged["failures"].extend(chunk["failures"])
             merged["fired_total"] += chunk.get("fired_total", 0)
+            for k, n_k in chunk.get("live", {}).items():
+                merged["live"][k] += n_k
             for name, a in chunk.get("attacks", {}).items():
                 m = merged["attacks"].setdefault(
                     name, {"fired": 0, "detected": 0, "via": {}})
@@ -191,6 +215,15 @@ def main(argv=None) -> int:
                     help="Byzantine sweep: compose each seed's fault "
                          "schedule with drawn in-protocol attacks and "
                          "check the soundness oracle")
+    ap.add_argument("--live", action="store_true",
+                    help="live-verification sweep: every seed replays "
+                         "its finished record through the incremental "
+                         "verifier (verify/live) under seed-derived "
+                         "torn tails + SIGKILL/checkpoint resumes; the "
+                         "live_convergence oracle requires the verdict, "
+                         "chunk-accept set, and commitment root to be "
+                         "bit-identical to the terminal fold (composes "
+                         "with --adversaries)")
     ap.add_argument("--shrink-budget", type=int, default=None,
                     help="probe-run cap per failing-schedule shrink")
     ap.add_argument("--json", nargs="?", const="auto", default=None,
@@ -210,7 +243,8 @@ def main(argv=None) -> int:
                                    else "EGTPU_SIM_SEEDS")
     if args.json == "auto":
         args.json = os.path.join(
-            REPO_ROOT, "SIM_BYZ_RESULTS.json" if args.adversaries
+            REPO_ROOT, "SIM_LIVE_RESULTS.json" if args.live
+            else "SIM_BYZ_RESULTS.json" if args.adversaries
             else "SIM_RESULTS.json")
 
     if args.replay is not None:
@@ -219,17 +253,17 @@ def main(argv=None) -> int:
     t0 = time.time()
     if args.chunk_worker:
         chunk = _sweep(args.start, args.seeds, args.fast,
-                       args.shrink_budget, args.adversaries)
+                       args.shrink_budget, args.adversaries, args.live)
         with open(args.chunk_worker, "w") as f:
             json.dump(chunk, f)
         return 0
     if args.procs > 1:
         merged = _sweep_procs(args.start, args.seeds, args.procs,
                               args.fast, args.shrink_budget,
-                              args.adversaries)
+                              args.adversaries, args.live)
     else:
         merged = _sweep(args.start, args.seeds, args.fast,
-                        args.shrink_budget, args.adversaries)
+                        args.shrink_budget, args.adversaries, args.live)
     wall = time.time() - t0
 
     result = {
@@ -247,11 +281,19 @@ def main(argv=None) -> int:
     print(f"{merged['ok']}/{args.seeds} seeds green, "
           f"{len(merged['failures'])} failures, {wall:.1f}s "
           f"({result['schedules_per_s']} schedules/s)")
+    if args.live:
+        ls = merged["live"]
+        result.update({"mode": ("live+adversaries" if args.adversaries
+                                else "live"), "live": ls})
+        print(f"  live: {ls['converged']}/{ls['runs']} runs converged "
+              f"bit-identically through {ls['crashes']} crash-resumes "
+              f"and {ls['torn']} torn tails ({ls['chunks']} chunks, "
+              f"{ls['rejected_chunks']} rejected)")
     if args.adversaries:
         undetected = sum(a["fired"] - a["detected"]
                          for a in merged["attacks"].values())
         result.update({
-            "mode": "adversaries",
+            "mode": "live+adversaries" if args.live else "adversaries",
             "attacks": merged["attacks"],
             "fired_total": merged["fired_total"],
             "undetected_total": undetected,
