@@ -625,6 +625,18 @@ def run_workload(nballots: int, n_chips: int) -> None:
         RESULT["live_error"] = f"{type(e).__name__}: {e}"
     flush_partial()
 
+    # ---- validate phase: RLC screen rate + serve admission overhead -----
+    # the ingestion gate's two numbers: production-group elements/s
+    # through the batched subgroup screen, and the p99 delta the gate
+    # adds to a real serve admission (the <10% ISSUE 17 contract) —
+    # best-effort like the planes above
+    try:
+        _bench_validate()
+    except Exception as e:  # noqa: BLE001 — diagnostics
+        note(f"validate phase failed: {type(e).__name__}: {e}")
+        RESULT["validate_error"] = f"{type(e).__name__}: {e}"
+    flush_partial()
+
     # ---- bignum phase: per-backend primitive rates (cios/ntt/pallas) ----
     # the roofline's raw numbers — mulmod/powmod/fixed rows through the
     # shared core.bignum_bench helper, labeled requested-vs-effective.
@@ -743,6 +755,89 @@ def _bench_live(nballots: int = 64, chunk: int = 8) -> None:
              f"(lag p99 {p99} frames), residual finalize {t_resid:.2f}s")
     finally:
         shutil.rmtree(out, ignore_errors=True)
+
+
+def _bench_validate(n_elems: int = 512, nsingles: int = 32) -> None:
+    """Ingestion-gate cost (ISSUE 17): (a) production-group elements/s
+    through the RLC subgroup screen — the number the batched-vs-
+    per-element argument rests on — and (b) the gate's share of a real
+    serve admission round trip, p99 with EGTPU_VALIDATE on vs off over
+    the same in-process server (tiny group, like mixfed/fabric: this
+    measures the PLANE's <10% admission contract, not modexp)."""
+    import shutil
+    import tempfile
+
+    from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+    from electionguard_tpu.core.group import production_group, tiny_group
+    from electionguard_tpu.crypto import validate
+    from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+    from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+    from electionguard_tpu.publish.election_record import ElectionConfig
+    from electionguard_tpu.serve.service import (EncryptionClient,
+                                                 EncryptionService)
+    from electionguard_tpu.workflow.e2e import sample_manifest
+
+    # -- (a) RLC screening rate, production group, one full chunk ------
+    g = production_group()
+    elems = [(f"el[{i}]", pow(g.g, i + 2, g.p)) for i in range(n_elems)]
+    validate.gate_elements(g, elems, "bench")        # warm
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        validate.gate_elements(g, elems, "bench")
+    dt = time.perf_counter() - t0
+    rlc_per_s = reps * n_elems / max(dt, 1e-9)
+
+    # -- (b) serve-admission p99, gate on vs off -----------------------
+    tg = tiny_group()
+    manifest = sample_manifest(1, 2)
+    trustees = [KeyCeremonyTrustee(tg, "guardian-0", 1, 1)]
+    init = key_ceremony_exchange(trustees, tg).make_election_initialized(
+        ElectionConfig(manifest, 1, 1), {"created_by": "bench"})
+    ballots = list(RandomBallotProvider(manifest, 2 * nsingles + 2,
+                                        seed=53).ballots())
+
+    # ONE server + client for both modes: the per-admission gate sits
+    # on the client's response path and reads EGTPU_VALIDATE live, so
+    # flipping the knob between loops isolates the gate from server
+    # lifecycle noise (compile warm-up would otherwise dominate
+    # whichever mode ran first)
+    out = tempfile.mkdtemp(prefix="bench_validate_")
+    svc = EncryptionService(init, tg, port=0, out_dir=out,
+                            max_batch=8, max_wait_ms=5)
+    client = EncryptionClient(f"localhost:{svc.port}", tg)
+
+    def p99_singles(bs, mode):
+        with _env_flag("EGTPU_VALIDATE", mode):
+            lat = []
+            for b in bs:
+                t0 = time.perf_counter()
+                assert client.encrypt(b) is not None
+                lat.append((time.perf_counter() - t0) * 1e3)
+            lat.sort()
+            return lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+
+    try:
+        for b in ballots[:2]:                        # warm channel + jit
+            client.encrypt(b)
+        p99_off = p99_singles(ballots[2:nsingles + 2], "off")
+        p99_on = p99_singles(ballots[nsingles + 2:], "on")
+    finally:
+        client.close()
+        svc.shutdown()
+        shutil.rmtree(out, ignore_errors=True)
+    overhead = (p99_on - p99_off) / max(p99_off, 1e-9) * 100
+    RESULT.update(
+        validate_rlc_per_s=round(rlc_per_s, 1),
+        validate_serve_p99_off_ms=round(p99_off, 2),
+        validate_serve_p99_on_ms=round(p99_on, 2),
+        validate_serve_overhead_pct=round(overhead, 1),
+    )
+    RESULT["phases_done"] = RESULT.get("phases_done", "") + " validate"
+    note(f"validate: RLC screen {rlc_per_s:.0f} elems/s "
+         f"({n_elems}-element production-group chunks); serve admission "
+         f"p99 {p99_off:.1f}ms off -> {p99_on:.1f}ms on "
+         f"({overhead:+.1f}%)")
 
 
 def _bench_race() -> None:

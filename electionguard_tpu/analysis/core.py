@@ -148,6 +148,7 @@ def register(name: str, rules: Optional[tuple[str, ...]] = None,
 def load_default_passes() -> None:
     """Import every built-in pass module (idempotent: registry keyed)."""
     from electionguard_tpu.analysis import (env_knobs,  # noqa: F401
+                                            ingestion_validation,
                                             jit_hygiene, lock_discipline,
                                             no_bare_print, rpc_contract,
                                             secret_taint, trace_coverage,

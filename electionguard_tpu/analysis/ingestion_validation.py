@@ -1,0 +1,108 @@
+"""ingestion-validation: every proto→group-element conversion runs
+behind the crypto/validate gate.
+
+The serialize importers (``import_p``, ``import_ciphertext``, …) turn
+wire bytes into ``ElementModP``/``ElementModQ`` with only a width/range
+check — no subgroup membership, no identity/small-order screening.
+That is fine for the terminal verifier (it re-proves everything) and
+for the publisher (reading back its own artifacts), but any OTHER call
+site is an ingestion boundary where an adversarial peer's forged
+parameters enter arithmetic, and must sit behind
+``crypto/validate.gate_*`` (ISSUE 17; the Moscow break, arxiv
+1908.09170, was exactly unvalidated parameters).
+
+Two findings:
+
+* an importer call in a file that is NOT a registered boundary and not
+  exempt — a new conversion site snuck in outside the gate's reach;
+* an importer call in a registered boundary file that contains NO gate
+  call — the boundary lost its gate.
+
+The baseline for this rule must stay EMPTY: a new conversion site is
+either a verifier/publisher path (add it to the exemptions WITH review)
+or a trust boundary (wire the gate and register it in BOUNDARIES).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from electionguard_tpu.analysis import astutil, core
+
+RULE = "ingestion-validation"
+
+#: serialize functions that construct group elements from wire messages
+IMPORTERS = frozenset({
+    "import_p", "import_q", "import_ciphertext", "import_generic_proof",
+    "import_disjunctive_proof", "import_constant_proof",
+    "import_hashed_ciphertext", "import_schnorr", "import_guardian_record",
+    "import_election_initialized", "import_encrypted_ballot",
+    "import_encrypted_tally", "import_tally_result",
+    "import_plaintext_tally", "import_decryption_result",
+    "import_mix_proof", "import_mix_row", "_imp_p_int", "_imp_q_int",
+})
+
+#: the gate's entry points (crypto/validate.py)
+GATE_CALLS = frozenset({"gate_elements", "gate_wire_p", "gate_wire_q",
+                        "gate_fingerprint"})
+
+#: registered ingestion boundaries: package-relative file -> boundary
+#: label the file's gate calls are tagged with
+BOUNDARIES = {
+    "remote/keyceremony_remote.py": "keyceremony",
+    "remote/decrypting_remote.py": "decrypt",
+    "mixfed/server.py": "mixfed",
+    "mixfed/coordinator.py": "mixfed",
+    "fabric/router.py": "fabric",
+    "serve/service.py": "serve",
+    "verify/live/verifier.py": "live",
+}
+
+#: subtrees that re-verify (or produced) what they deserialize:
+#: the terminal verifier proves every element's membership itself, the
+#: publisher round-trips its own artifacts, the gate is the gate
+EXEMPT_DIRS = ("publish", "verify", "sim", "testing", "analysis")
+EXEMPT_FILES = ("crypto/validate.py",)
+
+
+def _importer_calls(f: core.SourceFile) -> Iterator[int]:
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.Call) \
+                and astutil.call_name(node) in IMPORTERS:
+            yield node.lineno
+
+
+def _has_gate_call(f: core.SourceFile) -> bool:
+    return any(isinstance(n, ast.Call)
+               and astutil.call_name(n) in GATE_CALLS
+               for n in ast.walk(f.tree))
+
+
+@core.register(RULE, doc="proto→group-element conversion sites must "
+                         "flow through the crypto/validate ingestion "
+                         "gate (registered-boundary allowlist)")
+def run(project: core.Project) -> Iterator[core.Finding]:
+    for f in project.files():
+        rel = "/".join(project.package_rel_parts(f))
+        boundary = BOUNDARIES.get(rel)
+        if boundary is None:
+            parts = project.package_rel_parts(f)
+            if rel in EXEMPT_FILES or (parts and parts[0] in EXEMPT_DIRS):
+                continue
+            for line in _importer_calls(f):
+                yield core.Finding(
+                    RULE, f.rel, line,
+                    "proto→group-element conversion outside a registered "
+                    "ingestion boundary: wire crypto/validate.gate_* "
+                    "here and register the file in ingestion_validation."
+                    "BOUNDARIES (or exempt it as a verifier path)")
+            continue
+        if _has_gate_call(f):
+            continue
+        for line in _importer_calls(f):
+            yield core.Finding(
+                RULE, f.rel, line,
+                f"registered ingestion boundary '{boundary}' has no "
+                f"crypto/validate.gate_* call left in the file — the "
+                f"conversion on this line is ungated")

@@ -35,6 +35,7 @@ from electionguard_tpu.core.dlog import DLog
 from electionguard_tpu.core.group import (ElementModP, ElementModQ,
                                           GroupContext)
 from electionguard_tpu.core.group_jax import jax_ops
+from electionguard_tpu.crypto import validate
 from electionguard_tpu.crypto.cp_batch import batch_cp_verify
 from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
 from electionguard_tpu.decrypt.interface import DecryptingTrusteeIF
@@ -192,6 +193,17 @@ class Decryption:
                 raise TrusteeFailure(t.id, f"directDecrypt: {res.error}")
             if len(res) != n:
                 raise TrusteeFailure(t.id, "returned wrong batch size")
+            # ingestion gate at share receipt (covers in-process
+            # trustees too; remote proxies additionally pre-screen the
+            # wire) — a defective share demotes the trustee with the
+            # gate's named class instead of corrupting the combine
+            try:
+                validate.gate_elements(
+                    g, [(f"{t.id} share[{j}]", d.partial_decryption.value)
+                        for j, d in enumerate(res)],
+                    "decrypt")
+            except validate.GateError as e:
+                raise TrusteeFailure(t.id, str(e))
             k0 = self.init.guardian(t.id).coefficient_commitments[0].value
             for pad, d in zip(pads, res):
                 cp_x.append(k0)
@@ -215,6 +227,17 @@ class Decryption:
                 if len(res) != n:
                     raise TrusteeFailure(
                         t.id, f"returned wrong batch size for {m}")
+                try:
+                    validate.gate_elements(
+                        g, [(f"{t.id} comp[{j}].{nm} for {m}", v)
+                            for j, c in enumerate(res)
+                            for nm, v in (
+                                ("share", c.partial_decryption.value),
+                                ("recovery",
+                                 c.recovered_public_key_share.value))],
+                        "decrypt")
+                except validate.GateError as e:
+                    raise TrusteeFailure(t.id, str(e))
                 expected_recovery = commitment_product(
                     g, m_rec.coefficient_commitments, t.x_coordinate)
                 for pad, c in zip(pads, res):

@@ -132,13 +132,17 @@ def verify_manifest_signature(group: GroupContext,
     sig = manifest.signature
     if sig is None:
         return False
+    from electionguard_tpu.crypto import validate as vgate
     try:
         K = ElementModP(manifest.public_key, group)
         c = ElementModQ(sig.challenge, group)
         u = ElementModQ(sig.response, group)
-    except ValueError:
-        return False
-    if not K.is_valid_residue():
+        # subgroup membership through the one ingestion gate
+        # (crypto/validate): named class, sim-visible detection
+        vgate.gate_elements(
+            group, [(f"shard {manifest.shard_id} manifest key",
+                     K.value)], "fabric")
+    except (ValueError, vgate.GateError):
         return False
     # h' = g^u · K^(-c); K has order q, so K^(-c) = K^(q-c)
     h = group.mult_p(group.g_pow_p(u),

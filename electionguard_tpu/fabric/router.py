@@ -35,6 +35,7 @@ import grpc
 
 from electionguard_tpu import obs
 from electionguard_tpu.core.group import GroupContext
+from electionguard_tpu.crypto import validate
 from electionguard_tpu.obs import REGISTRY
 from electionguard_tpu.publish import pb
 from electionguard_tpu.remote import rpc_util
@@ -150,10 +151,24 @@ class EncryptionRouter:
         constants = rpc_util.group_constants_msg(self.group)
         with self._lock:
             err = rpc_util.check_group_fingerprint(
-                self.group, request.group_fingerprint)
+                self.group, request.group_fingerprint,
+                boundary="fabric")
             if err:
                 return Resp(error=err, constants=constants)
             wid = request.worker_id
+            # ingestion gate on the manifest signing key (when the
+            # worker sends one): a key outside the subgroup must die at
+            # registration, not at merge-time signature verification
+            if request.manifest_public_key:
+                try:
+                    validate.gate_elements(
+                        self.group,
+                        [(f"{wid} manifest key",
+                          int.from_bytes(bytes(request.manifest_public_key),
+                                         "big"))],
+                        "fabric")
+                except validate.GateError as e:
+                    return Resp(error=str(e), constants=constants)
             nonce = bytes(request.registration_nonce)
             for s in self.shards:
                 if s.worker_id != wid:

@@ -70,12 +70,21 @@ class PublicKeys:
             return Result.Err("no coefficient commitments")
         if len(self.coefficient_commitments) != len(self.coefficient_proofs):
             return Result.Err("commitment/proof count mismatch")
+        # subgroup membership runs through the one ingestion gate
+        # (crypto/validate) — named classes, batched screen
+        from electionguard_tpu.crypto import validate as vgate
+        try:
+            vgate.gate_elements(
+                self.coefficient_commitments[0].group,
+                [(f"{self.guardian_id} commitment[{j}]", k.value)
+                 for j, k in enumerate(self.coefficient_commitments)],
+                "keyceremony")
+        except vgate.GateError as e:
+            return Result.Err(str(e))
         for j, (k, pr) in enumerate(zip(self.coefficient_commitments,
                                         self.coefficient_proofs)):
             if pr.public_key != k:
                 return Result.Err(f"proof {j} is not for commitment {j}")
-            if not k.is_valid_residue():
-                return Result.Err(f"commitment {j} not in subgroup")
             if not pr.is_valid():
                 return Result.Err(f"Schnorr proof {j} invalid for "
                                   f"{self.guardian_id}")

@@ -32,6 +32,7 @@ from typing import Optional
 import grpc
 
 from electionguard_tpu.core.group import GroupContext
+from electionguard_tpu.crypto import validate
 from electionguard_tpu.mixnet.proof import rows_digest
 from electionguard_tpu.mixnet.stage import MixStage
 from electionguard_tpu.mixnet.verify_mix import verify_stage
@@ -134,7 +135,8 @@ class MixCoordinator:
         with self._lock:
             sid = request.server_id
             err = rpc_util.check_group_fingerprint(
-                self.group, request.group_fingerprint)
+                self.group, request.group_fingerprint,
+                boundary="mixfed")
             if err:
                 return pb.RegisterMixServerResponse(
                     error=err,
@@ -261,6 +263,20 @@ class MixCoordinator:
                 raise _StageFailed(
                     f"pullRows: server returned {len(out_pads)} of {n} "
                     f"rows then went empty", check="transfer")
+            # ingestion gate on the pulled output rows: a defective
+            # element dies HERE with its named class, before the digest
+            # check and before verify-before-forward touches it
+            try:
+                validate.gate_wire_p(
+                    self.group,
+                    [(f"out row {len(out_pads) + i} ct[{j}].{fld}",
+                      bytes(getattr(c, fld).value))
+                     for i, rm in enumerate(got.rows)
+                     for j, c in enumerate(rm.ciphertexts)
+                     for fld in ("pad", "data")],
+                    "mixfed", allow_identity=True)
+            except validate.GateError as e:
+                raise _StageFailed(str(e), check="transfer")
             for rm in got.rows:
                 row_a, row_b = serialize.import_mix_row(self.group, rm)
                 out_pads.append(row_a)

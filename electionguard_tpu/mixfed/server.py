@@ -35,6 +35,7 @@ import threading
 from typing import Optional
 
 from electionguard_tpu.core.group import GroupContext
+from electionguard_tpu.crypto import validate
 from electionguard_tpu.mixnet.proof import rows_digest
 from electionguard_tpu.mixnet.shuffle import Shuffler
 from electionguard_tpu.mixnet.stage import run_stage
@@ -145,7 +146,8 @@ class MixServerServer:
         with self._lock:
             k = int(request.stage_index)
             err = rpc_util.check_group_fingerprint(
-                self.group, request.group_fingerprint)
+                self.group, request.group_fingerprint,
+                boundary="mixfed")
             if err:
                 return pb.MixStageReady(stage_index=k, error=err)
             if self.held_stage is not None and self.held_stage != k:
@@ -173,6 +175,20 @@ class MixServerServer:
                     ok=False, error=f"server {self.server_id} holds stage "
                                     f"{self.held_stage}, not "
                                     f"{int(request.stage_index)}")
+            # ingestion gate: every ciphertext element of the pushed
+            # chunk is screened (range + subgroup, RLC-batched) before
+            # it can enter this stage's re-encryption arithmetic
+            try:
+                validate.gate_wire_p(
+                    self.group,
+                    [(f"row {int(request.chunk_start) + i} ct[{j}].{fld}",
+                      bytes(getattr(c, fld).value))
+                     for i, rm in enumerate(request.rows)
+                     for j, c in enumerate(rm.ciphertexts)
+                     for fld in ("pad", "data")],
+                    "mixfed", allow_identity=True)
+            except validate.GateError as e:
+                return pb.msg("BoolResponse")(ok=False, error=str(e))
             pads, datas = [], []
             for rm in request.rows:
                 row_a, row_b = serialize.import_mix_row(self.group, rm)

@@ -28,6 +28,7 @@ from typing import Optional, Sequence, Union
 import grpc
 
 from electionguard_tpu.core.group import ElementModP, ElementModQ, GroupContext
+from electionguard_tpu.crypto import validate
 from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
 from electionguard_tpu.decrypt.interface import (
     CompensatedDecryptionAndProof, DecryptingTrusteeIF,
@@ -81,6 +82,17 @@ class RemoteDecryptingTrusteeProxy(DecryptingTrusteeIF):
                 f"directDecrypt rpc to {self._id}: {e.code()}")
         if resp.error:
             return Result.Err(resp.error)
+        # ingestion gate on the shares BEFORE they touch the combine:
+        # an identity / small-order / non-subgroup share is a named
+        # rejection here, never an arithmetic artifact in the tally
+        try:
+            validate.gate_wire_p(
+                self.group,
+                [(f"{self._id} share[{j}]", bytes(r.partial_decryption.value))
+                 for j, r in enumerate(resp.results)],
+                "decrypt")
+        except validate.GateError as e:
+            return Result.Err(str(e))
         return [DirectDecryptionAndProof(
             serialize.import_p(self.group, r.partial_decryption),
             serialize.import_generic_proof(self.group, r.proof))
@@ -101,6 +113,17 @@ class RemoteDecryptingTrusteeProxy(DecryptingTrusteeIF):
                 f"compensatedDecrypt rpc to {self._id}: {e.code()}")
         if resp.error:
             return Result.Err(resp.error)
+        try:
+            validate.gate_wire_p(
+                self.group,
+                [(f"{self._id} comp[{j}].{fld}",
+                  bytes(getattr(r, fld).value))
+                 for j, r in enumerate(resp.results)
+                 for fld in ("partial_decryption",
+                             "recovered_public_key_share")],
+                "decrypt")
+        except validate.GateError as e:
+            return Result.Err(str(e))
         return [CompensatedDecryptionAndProof(
             serialize.import_p(self.group, r.partial_decryption),
             serialize.import_generic_proof(self.group, r.proof),
@@ -145,13 +168,20 @@ class DecryptionCoordinator:
             # fingerprint first: a cross-group trustee must get the
             # negotiation error (+ constants), not a decode failure
             err = rpc_util.check_group_fingerprint(
-                self.group, request.group_fingerprint)
+                self.group, request.group_fingerprint,
+                boundary="decrypt")
             if err:
                 return Resp(
                     error=err,
                     constants=rpc_util.group_constants_msg(self.group))
             try:
+                validate.gate_wire_p(
+                    self.group,
+                    [(f"{gid} public key", bytes(request.public_key.value))],
+                    "decrypt")
                 pubkey = serialize.import_p(self.group, request.public_key)
+            except validate.GateError as e:
+                return Resp(error=str(e))
             except ValueError as e:
                 return Resp(error=f"bad public key: {e}")
             for p in self.proxies:

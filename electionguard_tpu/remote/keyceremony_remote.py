@@ -28,6 +28,7 @@ from typing import Optional, Union
 import grpc
 
 from electionguard_tpu.core.group import GroupContext
+from electionguard_tpu.crypto import validate
 from electionguard_tpu.keyceremony.exchange import (KeyCeremonyResults,
                                                     key_ceremony_exchange)
 from electionguard_tpu.keyceremony.interface import (KeyCeremonyTrusteeIF,
@@ -83,6 +84,24 @@ class RemoteTrusteeProxy(KeyCeremonyTrusteeIF):
             return resp
         if resp.error:
             return Result.Err(resp.error)
+        # ingestion gate BEFORE construction: a non-canonical or
+        # non-subgroup commitment dies here with its named class, not
+        # as an anonymous decode error deeper in
+        gid = resp.guardian_id or self._id
+        try:
+            validate.gate_wire_p(
+                self.group,
+                [(f"{gid} commitment[{j}]", bytes(k.value))
+                 for j, k in enumerate(resp.coefficient_commitments)],
+                "keyceremony")
+            validate.gate_wire_q(
+                self.group,
+                [(f"{gid} proof[{j}].{fld}", bytes(getattr(pr, fld).value))
+                 for j, pr in enumerate(resp.coefficient_proofs)
+                 for fld in ("challenge", "response")],
+                "keyceremony")
+        except validate.GateError as e:
+            return Result.Err(str(e))
         commitments = tuple(serialize.import_p(self.group, k)
                             for k in resp.coefficient_commitments)
         return PublicKeys(
@@ -196,7 +215,8 @@ class KeyCeremonyCoordinator:
             # negotiation error (+ constants), never a duplicate/replay
             # answer (same ordering as the decryption coordinator)
             err = rpc_util.check_group_fingerprint(
-                self.group, request.group_fingerprint)
+                self.group, request.group_fingerprint,
+                boundary="keyceremony")
             if err:
                 return Resp(
                     error=err,
@@ -461,6 +481,12 @@ class KeyCeremonyTrusteeServer:
     def _receive_public_keys(self, request, context):
         Resp = pb.msg("BoolResponse")
         try:
+            validate.gate_wire_p(
+                self.group,
+                [(f"{request.guardian_id} commitment[{j}]",
+                  bytes(k.value))
+                 for j, k in enumerate(request.coefficient_commitments)],
+                "keyceremony")
             commitments = tuple(serialize.import_p(self.group, k)
                                 for k in request.coefficient_commitments)
             keys = PublicKeys(
@@ -469,6 +495,8 @@ class KeyCeremonyTrusteeServer:
                 tuple(serialize.import_schnorr(self.group, p, k)
                       for p, k in zip(request.coefficient_proofs,
                                       commitments)))
+        except validate.GateError as e:
+            return Resp(ok=False, error=str(e))
         except ValueError as e:
             return Resp(ok=False, error=f"malformed keys: {e}")
         trustee = self._delegate()

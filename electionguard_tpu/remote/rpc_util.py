@@ -345,12 +345,17 @@ def group_constants_msg(group):
         name=group.spec.name)
 
 
-def check_group_fingerprint(group, fingerprint) -> str:
-    """Coordinator-side handshake check; "" if ok, else the in-band error."""
-    if fingerprint and bytes(fingerprint) != group.fingerprint():
-        return (f"group constants mismatch: coordinator runs group "
-                f"'{group.spec.name}'; start this trustee with the same "
-                f"-group")
+def check_group_fingerprint(group, fingerprint,
+                            boundary: str = "registration") -> str:
+    """Coordinator-side handshake check; "" if ok, else the in-band
+    error — routed through the ingestion gate so a wrong-group peer is
+    rejected with the named ``validate.group_mismatch`` class and the
+    sim's detection log sees it."""
+    from electionguard_tpu.crypto import validate as vgate
+    err = vgate.gate_fingerprint(group, bytes(fingerprint or b""), boundary)
+    if err:
+        return (f"{err}; coordinator runs group '{group.spec.name}' — "
+                f"start this peer with the same -group")
     return ""
 
 

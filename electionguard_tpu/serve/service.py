@@ -28,6 +28,7 @@ import grpc
 from electionguard_tpu import obs
 from electionguard_tpu.ballot.plaintext import PlaintextBallot
 from electionguard_tpu.core.group import ElementModQ, GroupContext
+from electionguard_tpu.crypto import validate
 from electionguard_tpu.encrypt.encryptor import BatchEncryptor
 from electionguard_tpu.publish import pb, serialize
 from electionguard_tpu.publish.election_record import ElectionInitialized
@@ -78,6 +79,18 @@ class EncryptionService:
         self.init = init
         self.group = group if group is not None else \
             init.joint_public_key.group
+        # ingestion gate at serve admission: the joint key and every
+        # guardian commitment are screened ONCE at startup — a smuggled
+        # non-subgroup key never reaches the encryptor, and the per-
+        # ballot admission path pays nothing (plaintext requests carry
+        # no group elements)
+        validate.gate_elements(
+            self.group,
+            [("joint public key", init.joint_public_key.value)]
+            + [(f"{gr.guardian_id} commitment[{j}]", k.value)
+               for gr in init.guardians
+               for j, k in enumerate(gr.coefficient_commitments)],
+            "serve")
         # fabric shard mode: this worker owns ONE shard of the fleet's
         # ballot-code chain, anchored at ``chain_seed`` instead of the
         # single-worker anchor; ``skip_ballot_ids`` are admissions the
@@ -440,6 +453,7 @@ class EncryptionClient:
         self.last_shard_id = resp.shard_id
         if resp.error:
             raise ValueError(resp.error)
+        self._gate_ballot(resp.encrypted_ballot)
         return serialize.import_encrypted_ballot(self.group,
                                                  resp.encrypted_ballot)
 
@@ -459,9 +473,23 @@ class EncryptionClient:
             if r.error:
                 out.append((None, r.error))
             else:
+                self._gate_ballot(r.encrypted_ballot)
                 out.append((serialize.import_encrypted_ballot(
                     self.group, r.encrypted_ballot), None))
         return out
+
+    def _gate_ballot(self, bm) -> None:
+        """Ingestion gate on a returned encrypted ballot: every
+        ciphertext element is screened (range + RLC subgroup) before
+        the ballot object is built.  Raises crypto.validate.GateError
+        with its named class on a defective element."""
+        validate.gate_wire_p(
+            self.group,
+            [(f"{bm.ballot_id} {c.contest_id}/{s.selection_id}.{fld}",
+              bytes(getattr(s.ciphertext, fld).value))
+             for c in bm.contests for s in c.selections
+             for fld in ("pad", "data")],
+            "serve")
 
     def metrics(self, timeout: float = 30.0):
         return self._stub.call("getMetrics", pb.msg("MetricsRequest")(),

@@ -325,6 +325,121 @@ def _mut_stale_nonce(req) -> bool:
     return True
 
 
+# ---------------------------------------------- parameter-level mutators
+#
+# These forge *group elements themselves* rather than protocol state:
+# wrong-subgroup keys, small-order ciphertexts, identity shares,
+# non-canonical wire values, out-of-range proof responses.  Every one
+# is a deterministic function of the honest message and the sim's group
+# constants, and every one must die at the ingestion gate
+# (crypto/validate.py) with its named [validate.*] class — the terminal
+# verifier never gets to see the poisoned value.
+
+_SIM_GROUP = None
+
+
+def _sim_group():
+    """The sim cluster's group (tiny_group), imported lazily so this
+    module keeps its leaf-import contract for processes that only
+    mount the rpc_util seam."""
+    global _SIM_GROUP
+    if _SIM_GROUP is None:
+        from electionguard_tpu.core.group import tiny_group
+        _SIM_GROUP = tiny_group()
+    return _SIM_GROUP
+
+
+def _negate_commitment(resp, idx: int) -> bool:
+    """Replace coefficient commitment ``idx`` with its negation p−v:
+    still canonical and non-identity, but (−v)^q = −1 for odd q, so it
+    is provably outside the order-q subgroup."""
+    if resp.error or not resp.coefficient_commitments:
+        return False
+    g = _sim_group()
+    cm = resp.coefficient_commitments[idx]
+    v = int.from_bytes(cm.value, "big")
+    if not 1 < v < g.p - 1:
+        return False
+    cm.value = (g.p - v).to_bytes(g.spec.p_bytes, "big")
+    return True
+
+
+def _mut_param_nonsubgroup_key(resp) -> bool:
+    """Trustee answers sendPublicKeys with a first commitment outside
+    the subgroup: the keyceremony gate's RLC screen goes red
+    (validate.nonsubgroup)."""
+    return _negate_commitment(resp, 0)
+
+
+def _mut_param_smuggled_commitment(resp) -> bool:
+    """Same forgery buried in the LAST commitment of an otherwise-valid
+    key set: the red batch's bisection must name exactly this element
+    (validate.nonsubgroup)."""
+    if resp.error or len(resp.coefficient_commitments) < 2:
+        return False
+    return _negate_commitment(resp, len(resp.coefficient_commitments) - 1)
+
+
+def _mut_param_small_order_ct(resp) -> bool:
+    """Serving plane returns a ballot whose first pad is p−1: canonical
+    and non-identity but of order 2 — only the small-order check at the
+    client's ingestion gate sees it (validate.small_order)."""
+    if resp.error or not resp.HasField("encrypted_ballot"):
+        return False
+    eb = resp.encrypted_ballot
+    if not eb.contests or not eb.contests[0].selections:
+        return False
+    g = _sim_group()
+    ct = eb.contests[0].selections[0].ciphertext
+    ct.pad.value = (g.p - 1).to_bytes(g.spec.p_bytes, "big")
+    return True
+
+
+def _mut_param_identity_share(resp) -> bool:
+    """Decrypting trustee returns the identity as a partial-decryption
+    share — a do-nothing share that would silently corrupt the tally if
+    combined (validate.identity at the decrypt gate)."""
+    if resp.error or not resp.results:
+        return False
+    g = _sim_group()
+    resp.results[0].partial_decryption.value = (1).to_bytes(
+        g.spec.p_bytes, "big")
+    return True
+
+
+def _mut_param_wrong_group(req) -> bool:
+    """Trustee registers under different group constants: the
+    fingerprint comparison at registration must refuse it
+    (validate.group_mismatch)."""
+    if not req.group_fingerprint:
+        return False
+    req.group_fingerprint = _flip(req.group_fingerprint)
+    return True
+
+
+def _mut_param_noncanonical(resp) -> bool:
+    """First commitment set to x = p: parses at wire width but is not a
+    canonical residue — dies in the range check before any arithmetic
+    (validate.range)."""
+    if resp.error or not resp.coefficient_commitments:
+        return False
+    g = _sim_group()
+    resp.coefficient_commitments[0].value = g.p.to_bytes(
+        g.spec.p_bytes, "big")
+    return True
+
+
+def _mut_param_oor_response(resp) -> bool:
+    """First coefficient proof's response set to q — a Z_q field
+    smuggled out of range (validate.response_range)."""
+    if resp.error or not resp.coefficient_proofs:
+        return False
+    g = _sim_group()
+    resp.coefficient_proofs[0].response.value = g.q.to_bytes(
+        g.spec.q_bytes, "big")
+    return True
+
+
 def _mut_noop(resp) -> bool:
     """Planted no-op 'attack' (test-only, not in the corpus): fires but
     changes nothing, so NO defense can detect it — the guaranteed
@@ -460,6 +575,80 @@ ATTACKS: tuple[Attack, ...] = (
         rules=(("registerTrustee", "forge_dup",
                 _mut_stale_nonce, False),),
     ),
+    # ---- parameter-level family (ISSUE 17): forged group elements.
+    # Not in the Byzantine corpus — drawn by
+    # schedule.generate_param_schedule via param_corpus(), so the
+    # existing adversary sweeps keep their seed-for-seed schedules.
+    Attack(
+        "param_nonsubgroup_key",
+        "trustee's first coefficient commitment replaced by p-v — a "
+        "canonical non-subgroup key",
+        expect=("validate.nonsubgroup",),
+        targets=_GUARDIANS,
+        rules=(("sendPublicKeys", "mutate_response",
+                _mut_param_nonsubgroup_key, False),),
+        in_corpus=False,
+    ),
+    Attack(
+        "param_smuggled_commitment",
+        "non-subgroup element buried in the LAST commitment of an "
+        "otherwise-valid key set (bisection attribution drill)",
+        expect=("validate.nonsubgroup",),
+        targets=_GUARDIANS,
+        rules=(("sendPublicKeys", "mutate_response",
+                _mut_param_smuggled_commitment, False),),
+        in_corpus=False,
+    ),
+    Attack(
+        "param_small_order_ciphertext",
+        "serving plane answers with a ballot whose pad is the order-2 "
+        "element p-1",
+        expect=("validate.small_order",),
+        targets=("serve",),
+        rules=(("encryptBallot", "mutate_response",
+                _mut_param_small_order_ct, False),),
+        nth_range=(1, 2),
+        in_corpus=False,
+    ),
+    Attack(
+        "param_identity_share",
+        "decrypting trustee returns the identity element as its "
+        "partial-decryption share",
+        expect=("validate.identity",),
+        targets=("dec-0", "dec-1"),
+        rules=(("directDecrypt", "mutate_response",
+                _mut_param_identity_share, False),),
+        in_corpus=False,
+    ),
+    Attack(
+        "param_wrong_group_trustee",
+        "trustee registers with a different group-constants "
+        "fingerprint than the coordinator's",
+        expect=("validate.group_mismatch",),
+        targets=_GUARDIANS,
+        rules=(("registerTrustee", "mutate_request",
+                _mut_param_wrong_group, False),),
+        in_corpus=False,
+    ),
+    Attack(
+        "param_noncanonical_element",
+        "trustee's first commitment set to x = p — right wire width, "
+        "non-canonical value",
+        expect=("validate.range",),
+        targets=_GUARDIANS,
+        rules=(("sendPublicKeys", "mutate_response",
+                _mut_param_noncanonical, False),),
+        in_corpus=False,
+    ),
+    Attack(
+        "param_out_of_range_response",
+        "trustee's first coefficient proof carries a response >= q",
+        expect=("validate.response_range",),
+        targets=_GUARDIANS,
+        rules=(("sendPublicKeys", "mutate_response",
+                _mut_param_oor_response, False),),
+        in_corpus=False,
+    ),
     Attack(
         "adv_noop",
         "planted undetectable no-op (test-only): proves the soundness "
@@ -480,6 +669,13 @@ REGISTRY: dict[str, Attack] = {a.name: a for a in ATTACKS}
 
 def corpus() -> tuple[Attack, ...]:
     return tuple(a for a in ATTACKS if a.in_corpus)
+
+
+def param_corpus() -> tuple[Attack, ...]:
+    """The parameter-level family (forged group elements), drawn by
+    ``schedule.generate_param_schedule``.  Kept out of :func:`corpus`
+    so the Byzantine sweeps' seed-for-seed schedules are unchanged."""
+    return tuple(a for a in ATTACKS if a.name.startswith("param_"))
 
 
 def expected_for(attack_name: str) -> set[str]:

@@ -420,10 +420,14 @@ def drive(cfg: SimConfig, sched, transport, plan, schedule, seed: int,
                             # committed and recorded — that IS the ack
                             out.acked[b.ballot_id] = None
                             break
-                        if "[serve.invalid_ballot]" in str(e):
-                            # an adversary mangled this submission and
-                            # admission refused it in-band; the honest
-                            # voter resubmits the real ballot
+                        if ("[serve.invalid_ballot]" in str(e)
+                                or "[validate." in str(e)):
+                            # an adversary mangled this submission (or
+                            # forged the returned ciphertext, which the
+                            # client's ingestion gate refused); the
+                            # honest voter resubmits the real ballot —
+                            # a committed first admission answers the
+                            # retry with the duplicate path above
                             continue
                         raise
                     except grpc.RpcError:

@@ -85,7 +85,8 @@ def run_sim(seed: int,
             config: Optional[cluster.SimConfig] = None,
             adversaries: bool = False,
             race: bool = False,
-            strategy: Optional[str] = None) -> SimReport:
+            strategy: Optional[str] = None,
+            param_adversaries: bool = False) -> SimReport:
     """One deterministic run of the full virtual-cluster workflow."""
     cfg = config or cluster.SimConfig()
     if schedule is None:
@@ -93,6 +94,12 @@ def run_sim(seed: int,
         if adversaries:
             schedule = schedule + schedule_mod.generate_adversary_schedule(
                 _stream(seed, 5))
+        if param_adversaries:
+            # string-seeded stream: independent of the numbered honest
+            # streams, so composing param attacks never perturbs the
+            # fault / Byzantine / scheduler draws of the same seed
+            schedule = schedule + schedule_mod.generate_param_schedule(
+                random.Random(f"param:{seed}"))
     race = race or knobs.get_flag("EGTPU_RACE")
     strategy = strategy or knobs.get_str("EGTPU_SIM_STRATEGY")
     # PCT draws (priorities + change points) live on their own stream
@@ -182,11 +189,13 @@ def explore(seeds: Sequence[int],
             plant: Sequence[str] = (),
             adversaries: bool = False,
             race: bool = False,
-            strategy: Optional[str] = None) -> list[SimReport]:
+            strategy: Optional[str] = None,
+            param_adversaries: bool = False) -> list[SimReport]:
     """Run every seed; returns all reports (callers filter failures)."""
     return [run_sim(s, config=config, plant=plant,
                     adversaries=adversaries, race=race,
-                    strategy=strategy) for s in seeds]
+                    strategy=strategy, param_adversaries=param_adversaries)
+            for s in seeds]
 
 
 def default_seeds() -> list[int]:

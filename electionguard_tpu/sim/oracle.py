@@ -159,10 +159,22 @@ def _detections(o) -> set[str]:
 
 
 def _soundness(o, detections: set[str]) -> list[str]:
+    fired = list(getattr(o, "fired", ()))
+    # calls whose message was rejected because of a CO-MOUNTED attack:
+    # when two attacks land on the same (method, call, node) message,
+    # the defense that fires first — in practice the ingestion gate,
+    # which screens before Schnorr/nonce/share checks run — kills the
+    # whole message, so the other attack's expected class can never
+    # appear.  A detected co-mount IS containment of that message; the
+    # masked attack is moot, not undetected.
+    killed = {(m, n, node) for a, m, n, node in fired
+              if adversary.expected_for(a) & detections}
     v = []
-    for attack, method, n, node in getattr(o, "fired", ()):
+    for attack, method, n, node in fired:
         expect = adversary.expected_for(attack)
         if expect & detections:
+            continue
+        if (method, n, node) in killed:
             continue
         where = f" on {node}" if node else ""
         v.append(f"soundness: attack {attack} fired{where} "

@@ -162,6 +162,31 @@ def generate_adversary_schedule(rng) -> list[FaultEvent]:
     return events
 
 
+def generate_param_schedule(rng) -> list[FaultEvent]:
+    """Draw 1–2 parameter-level attacks (forged group elements, ISSUE
+    17) from ``rng``.  Same event shape as the Byzantine schedule —
+    kind="adversary" — so :func:`to_adversary_plan`, the shrinker and
+    the JSON round-trip all work unchanged; only the corpus differs."""
+    corpus = adversary.param_corpus()
+    events: list[FaultEvent] = []
+    seen = set()
+    for _ in range(rng.randint(1, 2)):
+        atk = corpus[rng.randrange(len(corpus))]
+        node = atk.targets[rng.randrange(len(atk.targets))]
+        nth = rng.randint(*atk.nth_range)
+        # dedup on the RPC CALL, not the attack name: two attacks
+        # mutating the same (method, node, nth) message would mask each
+        # other — the gate rejects on the first failing check, so the
+        # second attack fires without its expected class ever appearing
+        key = (atk.rules[0][0], node, nth)
+        if key in seen:
+            continue
+        seen.add(key)
+        events.append(FaultEvent("adversary", method=atk.name, nth=nth,
+                                 a=node))
+    return events
+
+
 def to_adversary_plan(events: list[FaultEvent]):
     """The adversary slice of a schedule as an
     :class:`~electionguard_tpu.sim.adversary.AdversaryPlan` (empty plan

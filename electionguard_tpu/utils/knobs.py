@@ -203,6 +203,9 @@ KNOBS: tuple[Knob, ...] = (
     Knob("EGTPU_SIM_HORIZON", "float", "600.0",
          "Virtual-time horizon for one deterministic simulation run, "
          "seconds; exceeding it is a liveness violation (sim/cluster)."),
+    Knob("EGTPU_SIM_PARAM_SEEDS", "int", "200",
+         "Seed count of the default parameter-adversary sweep "
+         "(tools/sim_matrix --param-adversaries)."),
     Knob("EGTPU_SIM_PCT_DEPTH", "int", "3",
          "PCT bug depth d under EGTPU_SIM_STRATEGY=pct: d-1 priority "
          "change points are drawn per run (sim/explore; "
@@ -234,6 +237,11 @@ KNOBS: tuple[Knob, ...] = (
     Knob("EGTPU_TILE", "int", "4096",
          "Row cap per device dispatch; bounds compile count AND peak "
          "memory (core/group_jax)."),
+    Knob("EGTPU_VALIDATE", "str", "on",
+         "Ingestion validation gate mode: on = RLC-batched subgroup "
+         "screen + range/identity/small-order checks at every trust "
+         "boundary, strict = exact per-element residue test, off = "
+         "no-op (terminal verifier still re-checks) (crypto/validate)."),
     Knob("EGTPU_VERIFY_BATCH", "flag", None,
          "Random-linear-combination batch verification: encryptors "
          "attach commitment hints to proofs and verifiers collapse "

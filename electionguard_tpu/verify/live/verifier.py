@@ -39,6 +39,7 @@ import json
 import os
 from typing import Optional
 
+from electionguard_tpu.crypto import validate
 from electionguard_tpu.obs import REGISTRY, span
 from electionguard_tpu.publish import framing, pb, serialize
 from electionguard_tpu.publish.election_record import ElectionRecord
@@ -182,13 +183,30 @@ class LiveVerifier:
         start_frame = self.verified_frames
         with span("verify.live.chunk",
                   {"start_frame": start_frame, "n_frames": len(frames)}):
+            errors_before = len(self.res.errors)
             ballots = []
             for fr in frames:
                 m = pb.EncryptedBallot()
                 m.ParseFromString(fr)
+                # ingestion gate per ballot: a defective element makes
+                # this chunk red with a named [validate.*] error and the
+                # ballot never enters the fold — the rejection is part
+                # of the deterministic fold state (checkpointed via
+                # res.errors), so resume/replay converge bit-for-bit
+                try:
+                    validate.gate_wire_p(
+                        self.group,
+                        [(f"{m.ballot_id} {c.contest_id}/"
+                          f"{s.selection_id}.{fld}",
+                          bytes(getattr(s.ciphertext, fld).value))
+                         for c in m.contests for s in c.selections
+                         for fld in ("pad", "data")],
+                        "live")
+                except validate.GateError as e:
+                    self.res.errors.append(str(e))
+                    continue
                 ballots.append(serialize.import_encrypted_ballot(
                     self.group, m))
-            errors_before = len(self.res.errors)
             self._verifier.verify_ballots_partial(ballots, self.res,
                                                   self.agg)
             accepted = len(self.res.errors) == errors_before

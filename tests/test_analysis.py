@@ -22,9 +22,9 @@ import pytest
 from electionguard_tpu.analysis import core
 from electionguard_tpu.utils import knobs as knobs_mod
 
-ALL_PASSES = {"env-knob-registry", "jit-hygiene", "lock-discipline",
-              "no-bare-print", "rpc-contract", "secret-taint",
-              "trace-coverage", "wall-clock-discipline"}
+ALL_PASSES = {"env-knob-registry", "ingestion-validation", "jit-hygiene",
+              "lock-discipline", "no-bare-print", "rpc-contract",
+              "secret-taint", "trace-coverage", "wall-clock-discipline"}
 
 
 # ---------------------------------------------------------------------------
@@ -501,3 +501,67 @@ def test_baseline_rejects_noteless_and_no_baseline_rules(tmp_path):
           "note": "tempting, but no"}]))
     with pytest.raises(ValueError, match="may not be baselined"):
         core.load_baseline(p)
+
+
+# ---------------------------------------------------------------------------
+# ingestion-validation
+# ---------------------------------------------------------------------------
+
+def test_ingestion_validation_fires_outside_boundary(tmp_path):
+    # a brand-new conversion site in a non-exempt, non-boundary file
+    project = _project(tmp_path, {"decrypt/new_path.py": """\
+        from electionguard_tpu.publish import serialize
+
+        def receive(group, msg):
+            share = serialize.import_p(group, msg.partial_decryption)
+            return share
+    """})
+    report = _run(project, ["ingestion-validation"])
+    assert _lines(report, "ingestion-validation") == [4]
+    assert "outside a registered ingestion boundary" \
+        in report.findings[0].message
+
+
+def test_ingestion_validation_boundary_lost_its_gate(tmp_path):
+    # a registered boundary file whose gate call was deleted
+    project = _project(tmp_path, {"mixfed/server.py": """\
+        from electionguard_tpu.publish import serialize
+
+        def push(group, request):
+            return [serialize.import_mix_row(group, r)
+                    for r in request.rows]
+    """})
+    report = _run(project, ["ingestion-validation"])
+    assert _lines(report, "ingestion-validation") == [4]
+    assert "has no crypto/validate.gate_" in report.findings[0].message
+
+
+def test_ingestion_validation_gated_and_exempt_paths_clean(tmp_path):
+    project = _project(tmp_path, {
+        # registered boundary WITH its gate: clean
+        "mixfed/server.py": """\
+            from electionguard_tpu.crypto import validate
+            from electionguard_tpu.publish import serialize
+
+            def push(group, request):
+                validate.gate_wire_p(group, [], "mixfed")
+                return [serialize.import_mix_row(group, r)
+                        for r in request.rows]
+        """,
+        # the terminal verifier re-proves membership itself: exempt
+        "verify/verifier.py": """\
+            from electionguard_tpu.publish import serialize
+
+            def check(group, m):
+                return serialize.import_encrypted_ballot(group, m)
+        """,
+        # the publisher round-trips its own artifacts: exempt
+        "publish/publisher.py": """\
+            from electionguard_tpu.publish import serialize
+
+            def read_back(group, m):
+                return serialize.import_p(group, m)
+        """,
+    })
+    report = _run(project, ["ingestion-validation"])
+    assert _lines(report, "ingestion-validation") == []

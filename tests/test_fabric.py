@@ -155,27 +155,31 @@ def test_router_health_is_fleet_aggregate(fleet):
 
 def test_registration_nonce_is_idempotent(tgroup):
     router = EncryptionRouter(tgroup, health_interval=30.0)
+    # registration gates the manifest key, so the placeholders must be
+    # genuine subgroup elements (g^2, g^3)
+    k1 = pow(tgroup.g, 2, tgroup.p).to_bytes(tgroup.spec.p_bytes, "big")
+    k2 = pow(tgroup.g, 3, tgroup.p).to_bytes(tgroup.spec.p_bytes, "big")
     try:
         nonce = os.urandom(16)
-        r1 = _register(router.url, tgroup, "wx", "localhost:1", b"\x01",
+        r1 = _register(router.url, tgroup, "wx", "localhost:1", k1,
                        nonce)
         # lost-response retry: same (worker, nonce, url) replays the
         # SAME shard assignment instead of minting a second shard
-        r2 = _register(router.url, tgroup, "wx", "localhost:1", b"\x01",
+        r2 = _register(router.url, tgroup, "wx", "localhost:1", k1,
                        nonce)
         assert not r1.error and not r2.error
         assert r1.shard_id == r2.shard_id
         # same id, same nonce, DIFFERENT url: refused (two live workers
         # can't share an identity)
-        r3 = _register(router.url, tgroup, "wx", "localhost:2", b"\x01",
+        r3 = _register(router.url, tgroup, "wx", "localhost:2", k1,
                        nonce)
         assert "already registered" in r3.error
         # fresh nonce: a relaunched worker reclaims its shard
-        r4 = _register(router.url, tgroup, "wx", "localhost:2", b"\x01",
+        r4 = _register(router.url, tgroup, "wx", "localhost:2", k1,
                        os.urandom(16))
         assert not r4.error and r4.shard_id == r1.shard_id
         # a different worker gets the next shard
-        r5 = _register(router.url, tgroup, "wy", "localhost:3", b"\x02",
+        r5 = _register(router.url, tgroup, "wy", "localhost:3", k2,
                        os.urandom(16))
         assert r5.shard_id == r1.shard_id + 1
     finally:

@@ -1,0 +1,177 @@
+"""Parameter-level adversary family (ISSUE 17): every forged-element
+attack planted alone must be rejected AT ITS INGESTION BOUNDARY with
+the right ``[validate.*]`` class, runs replay bit-for-bit with attacks
+mounted, and the pinned mixed sweep (faults + Byzantine + param) stays
+green under the soundness oracle.
+
+Mirror of test_sim_adversary.py for the forged-parameter dimension;
+``tools/sim_matrix.py --param-adversaries`` runs the wide sweep and
+records SIM_PARAM_RESULTS.json.
+"""
+
+import random
+
+import pytest
+
+from electionguard_tpu.sim import adversary
+from electionguard_tpu.sim.explore import explore, run_sim
+from electionguard_tpu.sim.schedule import (FaultEvent,
+                                            generate_param_schedule)
+
+
+def _adv(name: str, node: str = "", nth: int = 1) -> FaultEvent:
+    return FaultEvent("adversary", method=name, nth=nth, a=node)
+
+
+def _classes(report):
+    return {v.split(":", 1)[0] for v in report.violations}
+
+
+def _detected(report):
+    return {cls for cls, _detail in report.detections}
+
+
+# ------------------------------------------------------------- registry
+
+def test_param_corpus_invariants():
+    """Seven forged-parameter attacks, every one expecting a named
+    validate.* class, none leaking into the Byzantine corpus (they
+    compose via --param-adversaries, never dilute the PR 16 sweep)."""
+    corpus = adversary.param_corpus()
+    assert len(corpus) == 7
+    byz = {a.name for a in adversary.corpus()}
+    for atk in corpus:
+        assert atk.name.startswith("param_")
+        assert atk.name not in byz
+        assert atk.expect
+        assert all(c.startswith("validate.") for c in atk.expect), (
+            f"{atk.name} expects a non-gate class: {atk.expect}")
+        assert adversary.build(atk.name, atk.targets[0], atk.nth_range[0])
+
+
+def test_param_schedule_generation_is_deterministic():
+    s1 = generate_param_schedule(random.Random("param:7"))
+    s2 = generate_param_schedule(random.Random("param:7"))
+    assert s1 == s2 and s1
+    assert all(e.kind == "adversary" for e in s1)
+    assert all(e.method.startswith("param_") for e in s1)
+
+
+def test_param_schedule_never_comounts_one_rpc_call():
+    """Two attacks mutating the same (method, node, nth) message mask
+    each other — the gate rejects on the first failing check, so the
+    second attack would fire green-undetected.  The generator must
+    never emit that collision."""
+    by_rule = {a.name: a.rules[0][0] for a in adversary.param_corpus()}
+    for seed in range(300):
+        events = generate_param_schedule(random.Random(f"param:{seed}"))
+        calls = [(by_rule[e.method], e.a, e.nth) for e in events]
+        assert len(calls) == len(set(calls)), (
+            f"seed {seed}: attacks co-mounted on one RPC call: {events}")
+
+
+# ----------------------------------------------- planted attacks (one each)
+# (attack, node, nth, boundary label, expected class): the rejection
+# must carry the class AND the boundary tag of the ingestion point the
+# forged element entered through — proving it died AT the boundary,
+# not downstream in arithmetic or the terminal verifier.
+
+PLANTS = [
+    ("param_nonsubgroup_key", "guardian-0", 1,
+     "keyceremony", "validate.nonsubgroup"),
+    ("param_smuggled_commitment", "guardian-1", 1,
+     "keyceremony", "validate.nonsubgroup"),
+    ("param_small_order_ciphertext", "serve", 1,
+     "serve", "validate.small_order"),
+    ("param_identity_share", "dec-0", 1,
+     "decrypt", "validate.identity"),
+    ("param_wrong_group_trustee", "guardian-2", 1,
+     "keyceremony", "validate.group_mismatch"),
+    ("param_noncanonical_element", "guardian-1", 1,
+     "keyceremony", "validate.range"),
+    ("param_out_of_range_response", "guardian-2", 1,
+     "keyceremony", "validate.response_range"),
+]
+
+
+def test_plants_cover_the_whole_param_corpus():
+    assert ({p[0] for p in PLANTS}
+            == {a.name for a in adversary.param_corpus()})
+
+
+@pytest.mark.parametrize("name,node,nth,boundary,cls", PLANTS,
+                         ids=[p[0] for p in PLANTS])
+def test_planted_param_attack_rejected_at_its_boundary(
+        name, node, nth, boundary, cls):
+    r = run_sim(3, schedule=[_adv(name, node, nth)])
+    assert r.fired, f"{name} never fired — stale (node, nth) plant"
+    assert all(f[0] == name for f in r.fired)
+    hits = [d for c, d in r.detections if c == cls]
+    assert hits, (f"{name} fired but {cls} not in "
+                  f"{sorted(_detected(r))}")
+    assert any(d.startswith(f"{boundary}:") for d in hits), (
+        f"{name} rejected with {cls} but not at boundary "
+        f"'{boundary}': {hits}")
+    assert r.ok, r.summary()
+    assert "soundness" not in _classes(r)
+
+
+def test_small_order_ciphertext_second_admission():
+    """nth_range=(1, 2): the SECOND encryptBallot admission is also a
+    live mount point (regression guard for the nth plumbing)."""
+    r = run_sim(3, schedule=[_adv("param_small_order_ciphertext",
+                                  "serve", 2)])
+    assert r.fired
+    assert "validate.small_order" in _detected(r)
+    assert r.ok, r.summary()
+
+
+# ------------------------------------------------------------- replay
+
+def test_param_run_replays_bit_for_bit():
+    """The param stream is string-seeded and deterministic: same seed,
+    same forged elements, same trace, same rejections."""
+    a = run_sim(5, param_adversaries=True)
+    b = run_sim(5, param_adversaries=True)
+    assert a.trace_hash == b.trace_hash
+    assert a.fired == b.fired
+    assert a.schedule == b.schedule
+    assert a.detections == b.detections
+
+
+def test_param_stream_does_not_perturb_honest_streams():
+    """Mounting param attacks must not change which faults (stream 1)
+    or Byzantine attacks (stream 5) the same seed draws."""
+    byz = run_sim(9, adversaries=True)
+    both = run_sim(9, adversaries=True, param_adversaries=True)
+    non_param = [e for e in both.schedule
+                 if not (e.kind == "adversary"
+                         and e.method.startswith("param_"))]
+    assert non_param == byz.schedule
+
+
+# ------------------------------------------------------------- the sweep
+
+def test_pinned_mixed_param_sweep_is_green():
+    """Tier-1 param sweep: 20 pinned seeds, each composing the honest
+    fault schedule with Byzantine (stream 5) AND param (string stream)
+    attacks.  Zero soundness violations — every forged element either
+    rejected in-band or sound-aborts the run."""
+    reports = explore(range(20), adversaries=True, param_adversaries=True)
+    bad = [r.summary() for r in reports if not r.ok]
+    assert not bad, f"param sweep failures: {bad}"
+    assert all("soundness" not in _classes(r) for r in reports)
+    names = {f[0] for r in reports for f in r.fired
+             if f[0].startswith("param_")}
+    assert len(names) >= 4, f"sweep only exercised {sorted(names)}"
+
+
+@pytest.mark.slow
+def test_wide_param_sweep_is_green():
+    """The wide param sweep (seeds 20..219); sim_matrix
+    --param-adversaries goes wider and records SIM_PARAM_RESULTS.json."""
+    reports = explore(range(20, 220), adversaries=True,
+                      param_adversaries=True)
+    bad = [r.summary() for r in reports if not r.ok]
+    assert not bad, f"param sweep failures: {bad}"
+    assert all("soundness" not in _classes(r) for r in reports)

@@ -1,0 +1,253 @@
+"""Edge-case suite for the universal ingestion gate (crypto/validate).
+
+Covers the exact boundary values the gate's named classes exist for
+(x = 0, 1, p−1, p, 2p−1, the all-ones Montgomery word), RLC batching
+across the chunk cap, bisection attribution naming exactly the planted
+offenders, the Jacobi quadratic-character screen (even numbers of
+order-2 twists must NOT cancel), mode switching, and host-vs-device
+path agreement on the tiny group plus a production-group RLC run.
+"""
+
+import pytest
+
+from electionguard_tpu.core.group import production_group, tiny_group
+from electionguard_tpu.crypto import validate
+from electionguard_tpu.crypto.validate import GateError
+
+
+@pytest.fixture(scope="module")
+def tg():
+    return tiny_group()
+
+
+def _sub(g, k):
+    """A genuine order-q subgroup member g^k."""
+    return pow(g.g, k, g.p)
+
+
+def _cofactor_qr(g, h):
+    """h^(2q): order divides r/2 (odd), a square — passes the Jacobi
+    screen, fails subgroup membership.  The element the RLC + bisection
+    path exists for."""
+    w = pow(h, 2 * g.q, g.p)
+    assert w != 1 and pow(w, g.q, g.p) != 1
+    assert validate._jacobi(w, g.p) == 1
+    return w
+
+
+def _cls(excinfo):
+    return excinfo.value.cls
+
+
+# ---------------------------------------------------------------------------
+# the named per-element classes, one boundary value each
+# ---------------------------------------------------------------------------
+
+def test_zero_rejected_as_range(tg):
+    with pytest.raises(GateError) as e:
+        validate.gate_elements(tg, [("x", 0)], "test")
+    assert _cls(e) == "validate.range"
+    assert "[validate.range] test:" in str(e.value)
+
+
+def test_identity_rejected_and_allowed(tg):
+    with pytest.raises(GateError) as e:
+        validate.gate_elements(tg, [("x", 1)], "test")
+    assert _cls(e) == "validate.identity"
+    # mix padding rows are legitimate (1, 1) ciphertexts
+    validate.gate_elements(tg, [("pad", 1)], "test", allow_identity=True)
+
+
+def test_order_two_element_rejected_as_small_order(tg):
+    with pytest.raises(GateError) as e:
+        validate.gate_elements(tg, [("x", tg.p - 1)], "test")
+    assert _cls(e) == "validate.small_order"
+
+
+def test_p_rejected_as_range(tg):
+    with pytest.raises(GateError) as e:
+        validate.gate_elements(tg, [("x", tg.p)], "test")
+    assert _cls(e) == "validate.range"
+
+
+def test_noncanonical_2p_minus_1_on_the_wire(tg):
+    # 2p−1 ≡ p−1 mod p but is NOT the canonical encoding: the wire gate
+    # must kill it as a range defect, never silently reduce it
+    wide = (2 * tg.p - 1).to_bytes(tg.spec.p_bytes, "big")
+    with pytest.raises(GateError) as e:
+        validate.gate_wire_p(tg, [("x", wide)], "test")
+    assert _cls(e) == "validate.range"
+
+
+def test_all_ones_montgomery_word_rejected(tg):
+    # the R−1 edge: an all-ones wire word (R−1 for the Montgomery radix
+    # R = 2^(8·p_bytes)) is ≥ p and must die in the range check — a
+    # reduction-happy import path would wrap it into a live element
+    with pytest.raises(GateError) as e:
+        validate.gate_wire_p(tg, [("x", b"\xff" * tg.spec.p_bytes)], "test")
+    assert _cls(e) == "validate.range"
+
+
+def test_genuine_nonresidue_rejected(tg):
+    # p−v for subgroup v: (−v)^q = −1, and with p ≡ 3 (mod 4) the
+    # Jacobi screen sees it deterministically
+    with pytest.raises(GateError) as e:
+        validate.gate_elements(tg, [("x", tg.p - _sub(tg, 7))], "test")
+    assert _cls(e) == "validate.nonsubgroup"
+
+
+def test_even_number_of_order_two_twists_does_not_cancel(tg):
+    # TWO twisted elements cancel inside the RLC accumulator
+    # ((−1)^(odd+odd) = 1) — the per-element Jacobi screen must reject
+    # each one anyway (the seed-5 param-adversary regression)
+    items = [("a", tg.p - _sub(tg, 3)), ("b", tg.p - _sub(tg, 5))]
+    with pytest.raises(GateError) as e:
+        validate.gate_elements(tg, items, "test")
+    assert _cls(e) == "validate.nonsubgroup"
+    assert "a " in str(e.value)         # first offender named first
+
+
+def test_wire_q_range(tg):
+    validate.gate_wire_q(tg, [("r", (tg.q - 1).to_bytes(
+        tg.spec.q_bytes, "big")), ("z", b"\x00")], "test")
+    with pytest.raises(GateError) as e:
+        validate.gate_wire_q(tg, [("r", tg.q.to_bytes(
+            tg.spec.q_bytes, "big"))], "test")
+    assert _cls(e) == "validate.response_range"
+
+
+def test_fingerprint_mismatch_named(tg):
+    assert validate.gate_fingerprint(tg, tg.fingerprint(), "test") == ""
+    assert validate.gate_fingerprint(tg, b"", "test") == ""
+    err = validate.gate_fingerprint(tg, b"\x00" * 32, "test")
+    assert "[validate.group_mismatch]" in err
+    assert "group constants mismatch" in err
+
+
+# ---------------------------------------------------------------------------
+# batching + bisection attribution
+# ---------------------------------------------------------------------------
+
+def test_batch_of_one(tg):
+    validate.gate_elements(tg, [("ok", _sub(tg, 11))], "test")
+    w = _cofactor_qr(tg, 3)
+    with pytest.raises(GateError) as e:
+        validate.gate_elements(tg, [("bad", w)], "test")
+    assert _cls(e) == "validate.nonsubgroup"
+    assert "bad" in str(e.value)
+
+
+def test_bisection_names_exactly_the_planted_offenders(tg):
+    items = [(f"el[{i}]", _sub(tg, i + 2)) for i in range(64)]
+    items[7] = ("el[7]", _cofactor_qr(tg, 3))
+    items[42] = ("el[42]", _cofactor_qr(tg, 5))
+    with pytest.raises(GateError) as e:
+        validate.gate_elements(tg, items, "test")
+    msg = str(e.value)
+    assert _cls(e) == "validate.nonsubgroup"
+    assert "el[7]" in msg and "el[42]" in msg
+    # vouched-for neighbours are NOT named
+    assert "el[6]" not in msg and "el[8]" not in msg and "el[41]" not in msg
+
+
+def test_batch_over_chunk_cap(tg):
+    # > CHUNK elements: the offender lands in the SECOND chunk and the
+    # first chunk's screen must stay green
+    n = validate.CHUNK + 8
+    items = [(f"el[{i}]", _sub(tg, i + 2)) for i in range(n)]
+    bad = validate.CHUNK + 3
+    items[bad] = (f"el[{bad}]", _cofactor_qr(tg, 7))
+    with pytest.raises(GateError) as e:
+        validate.gate_elements(tg, items, "test")
+    assert f"el[{bad}]" in str(e.value)
+    # all-good batch of the same size passes
+    validate.gate_elements(
+        tg, [(f"el[{i}]", _sub(tg, i + 2)) for i in range(n)], "test")
+
+
+# ---------------------------------------------------------------------------
+# modes
+# ---------------------------------------------------------------------------
+
+def test_strict_mode_exact_per_element(tg, monkeypatch):
+    monkeypatch.setenv("EGTPU_VALIDATE", "strict")
+    w = _cofactor_qr(tg, 3)
+    with pytest.raises(GateError) as e:
+        validate.gate_elements(
+            tg, [("good", _sub(tg, 4)), ("bad", w)], "test")
+    assert _cls(e) == "validate.nonsubgroup"
+    assert "bad" in str(e.value)
+    validate.gate_elements(tg, [("good", _sub(tg, 4))], "test")
+
+
+def test_off_mode_reverts_to_importer_posture(tg, monkeypatch):
+    monkeypatch.setenv("EGTPU_VALIDATE", "off")
+    # forged elements sail through the gate...
+    validate.gate_elements(tg, [("bad", tg.p - _sub(tg, 3))], "test")
+    assert validate.gate_fingerprint(tg, b"\x00" * 32, "test") == ""
+    # ...but a non-canonical wire value still dies in the constructor
+    # (the pre-gate posture), just without the named class
+    with pytest.raises(ValueError):
+        validate.gate_wire_p(
+            tg, [("x", tg.p.to_bytes(tg.spec.p_bytes, "big"))], "test")
+    monkeypatch.setenv("EGTPU_VALIDATE", "bogus")
+    assert validate.mode() == "on"      # unknown values fail closed
+
+
+# ---------------------------------------------------------------------------
+# host path vs device RLC path, tiny + production
+# ---------------------------------------------------------------------------
+
+def test_tiny_host_and_device_paths_agree(tg):
+    from electionguard_tpu.core.group_jax import JaxGroupOps
+    ops = JaxGroupOps(tg, backend="cios")
+    items = [(f"el[{i}]", _sub(tg, i + 2)) for i in range(16)]
+    validate.gate_elements(tg, items, "test")                  # host
+    validate.gate_elements(tg, items, "test", ops=ops)         # device
+    items[5] = ("el[5]", _cofactor_qr(tg, 3))
+    for use_ops in (None, ops):
+        with pytest.raises(GateError) as e:
+            validate.gate_elements(tg, items, "test", ops=use_ops)
+        assert _cls(e) == "validate.nonsubgroup"
+        assert "el[5]" in str(e.value)
+
+
+def test_production_group_rlc_path():
+    g = production_group()
+    items = [(f"el[{i}]", _sub(g, i + 2)) for i in range(6)]
+    validate.gate_elements(g, items, "test")
+    items[3] = ("el[3]", _cofactor_qr(g, 3))
+    with pytest.raises(GateError) as e:
+        validate.gate_elements(g, items, "test")
+    assert _cls(e) == "validate.nonsubgroup"
+    assert "el[3]" in str(e.value)
+    assert "el[2]" not in str(e.value)
+
+
+# ---------------------------------------------------------------------------
+# error-object contract + observability
+# ---------------------------------------------------------------------------
+
+def test_gate_error_carries_class_and_boundary(tg):
+    with pytest.raises(GateError) as e:
+        validate.gate_elements(tg, [("x", 0)], "serve")
+    assert e.value.cls == "validate.range"
+    assert e.value.boundary == "serve"
+    assert isinstance(e.value, ValueError)      # in-band ValueError paths
+
+
+def test_rejections_bump_counter_and_reject_log(tg):
+    from electionguard_tpu import obs
+    from electionguard_tpu.utils import errors
+    seen = []
+    cb = lambda cls, detail: seen.append(cls)  # noqa: E731
+    errors.listen(cb)
+    try:
+        before = obs.REGISTRY.counter("validate_rejects_total").value
+        with pytest.raises(GateError):
+            validate.gate_elements(tg, [("x", 0)], "test")
+        assert obs.REGISTRY.counter(
+            "validate_rejects_total").value == before + 1
+        assert "validate.range" in seen
+    finally:
+        errors.unlisten(cb)

@@ -54,6 +54,7 @@ METRICS: dict[str, tuple[bool, float]] = {
     "mixnet_rows_per_s": (True, 0.20),
     "mixfed_stages_per_s": (True, 0.20),
     "live_chunks_per_s": (True, 0.20),   # streaming verifier tail rate
+    "validate_rlc_per_s": (True, 0.20),  # ingestion-gate subgroup screen
     "obs_spans_per_s": (True, 0.25),
     "setup_s": (False, 0.50),            # dominated by compile cache
 }

@@ -69,7 +69,7 @@ def _config(fast: bool):
 
 def _sweep(start: int, count: int, fast: bool,
            shrink_budget: int | None, adversaries: bool = False,
-           live: bool = False) -> dict:
+           live: bool = False, param: bool = False) -> dict:
     """Run seeds [start, start+count) in THIS process; shrink failures."""
     from electionguard_tpu.sim import adversary
     from electionguard_tpu.sim.explore import run_sim
@@ -85,7 +85,7 @@ def _sweep(start: int, count: int, fast: bool,
                   "chunks": 0, "rejected_chunks": 0}
     for seed in range(start, start + count):
         r = run_sim(seed, config=cfg, adversaries=adversaries,
-                    plant=plant)
+                    plant=plant, param_adversaries=param)
         if r.live:
             live_stats["runs"] += 1
             live_stats["converged"] += bool(r.live["converged"])
@@ -94,7 +94,7 @@ def _sweep(start: int, count: int, fast: bool,
             live_stats["chunks"] += len(r.live["live_accepts"])
             live_stats["rejected_chunks"] += sum(
                 not a for a in r.live["live_accepts"])
-        if adversaries:
+        if adversaries or param:
             # per-attack detection histogram: an instance counts as
             # detected exactly when the soundness oracle raised no
             # violation for it (the oracle also sees abort texts and
@@ -134,7 +134,8 @@ def _sweep(start: int, count: int, fast: bool,
 
 def _sweep_procs(start: int, count: int, procs: int, fast: bool,
                  shrink_budget: int | None,
-                 adversaries: bool = False, live: bool = False) -> dict:
+                 adversaries: bool = False, live: bool = False,
+                 param: bool = False) -> dict:
     """Shard the range over worker subprocesses, merge their chunks."""
     per = (count + procs - 1) // procs
     jobs = []
@@ -154,6 +155,8 @@ def _sweep_procs(start: int, count: int, procs: int, fast: bool,
             cmd.append("--adversaries")
         if live:
             cmd.append("--live")
+        if param:
+            cmd.append("--param-adversaries")
         if shrink_budget is not None:
             cmd += ["--shrink-budget", str(shrink_budget)]
         jobs.append((subprocess.Popen(cmd), out))
@@ -215,6 +218,16 @@ def main(argv=None) -> int:
                     help="Byzantine sweep: compose each seed's fault "
                          "schedule with drawn in-protocol attacks and "
                          "check the soundness oracle")
+    ap.add_argument("--param-adversaries", action="store_true",
+                    help="parameter-level sweep: every seed draws 1-2 "
+                         "forged-group-element attacks (param_* family: "
+                         "non-subgroup keys, small-order ciphertexts, "
+                         "identity shares, non-canonical wire values) "
+                         "from their own seed stream; the soundness "
+                         "oracle requires the ingestion gate to reject "
+                         "each at its boundary with the named "
+                         "[validate.*] class (composes with "
+                         "--adversaries and --live)")
     ap.add_argument("--live", action="store_true",
                     help="live-verification sweep: every seed replays "
                          "its finished record through the incremental "
@@ -238,12 +251,14 @@ def main(argv=None) -> int:
                     help=argparse.SUPPRESS)   # internal: emit one chunk
     args = ap.parse_args(argv)
     if args.seeds is None:
-        args.seeds = knobs.get_int("EGTPU_SIM_ADV_SEEDS"
-                                   if args.adversaries
-                                   else "EGTPU_SIM_SEEDS")
+        args.seeds = knobs.get_int(
+            "EGTPU_SIM_PARAM_SEEDS" if args.param_adversaries
+            else "EGTPU_SIM_ADV_SEEDS" if args.adversaries
+            else "EGTPU_SIM_SEEDS")
     if args.json == "auto":
         args.json = os.path.join(
-            REPO_ROOT, "SIM_LIVE_RESULTS.json" if args.live
+            REPO_ROOT, "SIM_PARAM_RESULTS.json" if args.param_adversaries
+            else "SIM_LIVE_RESULTS.json" if args.live
             else "SIM_BYZ_RESULTS.json" if args.adversaries
             else "SIM_RESULTS.json")
 
@@ -253,17 +268,20 @@ def main(argv=None) -> int:
     t0 = time.time()
     if args.chunk_worker:
         chunk = _sweep(args.start, args.seeds, args.fast,
-                       args.shrink_budget, args.adversaries, args.live)
+                       args.shrink_budget, args.adversaries, args.live,
+                       args.param_adversaries)
         with open(args.chunk_worker, "w") as f:
             json.dump(chunk, f)
         return 0
     if args.procs > 1:
         merged = _sweep_procs(args.start, args.seeds, args.procs,
                               args.fast, args.shrink_budget,
-                              args.adversaries, args.live)
+                              args.adversaries, args.live,
+                              args.param_adversaries)
     else:
         merged = _sweep(args.start, args.seeds, args.fast,
-                        args.shrink_budget, args.adversaries, args.live)
+                        args.shrink_budget, args.adversaries, args.live,
+                        args.param_adversaries)
     wall = time.time() - t0
 
     result = {
@@ -289,11 +307,14 @@ def main(argv=None) -> int:
               f"bit-identically through {ls['crashes']} crash-resumes "
               f"and {ls['torn']} torn tails ({ls['chunks']} chunks, "
               f"{ls['rejected_chunks']} rejected)")
-    if args.adversaries:
+    if args.adversaries or args.param_adversaries:
         undetected = sum(a["fired"] - a["detected"]
                          for a in merged["attacks"].values())
+        mode = "+".join(m for m, on in (
+            ("live", args.live), ("adversaries", args.adversaries),
+            ("param-adversaries", args.param_adversaries)) if on)
         result.update({
-            "mode": "live+adversaries" if args.live else "adversaries",
+            "mode": mode,
             "attacks": merged["attacks"],
             "fired_total": merged["fired_total"],
             "undetected_total": undetected,
