@@ -659,6 +659,18 @@ def run_workload(nballots: int, n_chips: int) -> None:
         RESULT["race_error"] = f"{type(e).__name__}: {e}"
     flush_partial()
 
+    # ---- capacity phase: predicted-vs-actual model error ----------------
+    # replays the capacity model (obs/capacity) against two measured
+    # configurations — the SCALE.json fabric scaling point and a traced
+    # tiny e2e election — so model drift gates through bench_diff like
+    # any perf regression.  Best-effort like the planes above.
+    try:
+        _bench_capacity()
+    except Exception as e:  # noqa: BLE001 — diagnostics
+        note(f"capacity phase failed: {type(e).__name__}: {e}")
+        RESULT["capacity_error"] = f"{type(e).__name__}: {e}"
+    flush_partial()
+
     import jax
     if jax.devices()[0].platform != "cpu":
         # the NTT-vs-CIOS shootout only means something on the chip; on
@@ -667,6 +679,36 @@ def run_workload(nballots: int, n_chips: int) -> None:
             _microbench(g)
         except Exception as e:  # noqa: BLE001 — diagnostics
             note(f"microbench skipped: {type(e).__name__}: {e}")
+
+
+def _bench_capacity() -> None:
+    """Capacity-model drift gate: re-validate the analytic pipeline
+    model against measured configurations (obs/capacity.validate) and
+    record the worst prediction error.  ``capacity_model_err_pct``
+    carries a bench_diff band, so a code change that shifts the cost
+    structure out from under the model fails the perf gate instead of
+    silently rotting CAPACITY.md.  Also re-answers the headline chips
+    question per fitted backend from the current artifacts."""
+    from electionguard_tpu.obs import capacity
+    from electionguard_tpu.utils import knobs
+
+    v = capacity.validate()
+    checked = [c for c in v["configs"] if "err_pct" in c]
+    RESULT.update(
+        capacity_model_err_pct=v["max_err_pct"],
+        capacity_validation_pass=v["pass"],
+        capacity_configs_checked=len(checked),
+    )
+    model = capacity.fit()
+    ballots = knobs.get_int("EGTPU_CAPACITY_BALLOTS")
+    deadline = knobs.get_float("EGTPU_CAPACITY_DEADLINE_S")
+    RESULT["capacity_chips_for_deadline"] = {
+        backend: capacity.chips_for_deadline(model, ballots, deadline,
+                                             backend)["chips"]
+        for backend in sorted(model.powmod_per_s)}
+    RESULT["phases_done"] = RESULT.get("phases_done", "") + " capacity"
+    note(f"capacity model err {v['max_err_pct']}% over {len(checked)} "
+         f"measured config(s) ({'PASS' if v['pass'] else 'FAIL'})")
 
 
 def _bench_live(nballots: int = 64, chunk: int = 8) -> None:

@@ -36,7 +36,7 @@ import grpc
 from electionguard_tpu import obs
 from electionguard_tpu.core.group import GroupContext
 from electionguard_tpu.crypto import validate
-from electionguard_tpu.obs import REGISTRY
+from electionguard_tpu.obs import REGISTRY, election_labels
 from electionguard_tpu.publish import pb
 from electionguard_tpu.remote import rpc_util
 from electionguard_tpu.utils import clock, knobs
@@ -116,14 +116,16 @@ class EncryptionRouter:
         self._fwd_policy = rpc_util.RetryPolicy(
             attempts=1, base_wait=0.1, max_wait=0.1,
             connect_window=self._health_timeout, budget=0.0)
-        self._c_requeues = REGISTRY.counter("fabric_requeues_total")
-        self._c_evictions = REGISTRY.counter("fabric_evictions_total")
+        _el = election_labels()   # per-tenant series on a shared fleet
+        self._c_requeues = REGISTRY.counter("fabric_requeues_total", _el)
+        self._c_evictions = REGISTRY.counter("fabric_evictions_total",
+                                             _el)
         self._c_readmissions = REGISTRY.counter(
-            "fabric_readmissions_total")
+            "fabric_readmissions_total", _el)
         self._c_saturated = REGISTRY.counter(
-            "fabric_rejects_saturated_total")
+            "fabric_rejects_saturated_total", _el)
         self._c_no_shards = REGISTRY.counter(
-            "fabric_rejects_no_live_shards_total")
+            "fabric_rejects_no_live_shards_total", _el)
         self.server, self.port = rpc_util.make_server(
             port, max_workers=max_workers)
         self.url = f"localhost:{self.port}"

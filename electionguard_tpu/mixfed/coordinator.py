@@ -36,7 +36,8 @@ from electionguard_tpu.crypto import validate
 from electionguard_tpu.mixnet.proof import rows_digest
 from electionguard_tpu.mixnet.stage import MixStage
 from electionguard_tpu.mixnet.verify_mix import verify_stage
-from electionguard_tpu.obs import REGISTRY, set_phase, span
+from electionguard_tpu.obs import (REGISTRY, election_labels,
+                                   set_phase, span)
 from electionguard_tpu.publish import pb, serialize
 from electionguard_tpu.publish.publisher import Consumer, Publisher
 from electionguard_tpu.remote import rpc_util
@@ -349,7 +350,8 @@ class MixCoordinator:
                     srv.fail_cause = (errors.named(f"mix.{cls}", detail)
                                       if cls else detail)
                     srv.close()
-                    REGISTRY.counter("mixfed_stage_requeues_total").inc()
+                    REGISTRY.counter("mixfed_stage_requeues_total",
+                                     election_labels()).inc()
                     if self._next_server() is None:
                         msg = (f"stage {k} failed on server {srv.id} "
                                f"({detail}) and no spare server remains")
@@ -374,8 +376,10 @@ class MixCoordinator:
                     srv.failed = True
                     srv.fail_cause = errors.named(f"mix.{short}", msg)
                     srv.close()
-                    REGISTRY.counter("mixfed_bad_proofs_total").inc()
-                    REGISTRY.counter("mixfed_stage_requeues_total").inc()
+                    REGISTRY.counter("mixfed_bad_proofs_total",
+                                     election_labels()).inc()
+                    REGISTRY.counter("mixfed_stage_requeues_total",
+                                     election_labels()).inc()
                     if self._next_server() is None:
                         raise MixFedError(errors.named(
                             f"mix.{short}",

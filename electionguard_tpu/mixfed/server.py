@@ -39,7 +39,8 @@ from electionguard_tpu.crypto import validate
 from electionguard_tpu.mixnet.proof import rows_digest
 from electionguard_tpu.mixnet.shuffle import Shuffler
 from electionguard_tpu.mixnet.stage import run_stage
-from electionguard_tpu.obs import REGISTRY, set_phase, span
+from electionguard_tpu.obs import (REGISTRY, election_labels,
+                                   set_phase, span)
 from electionguard_tpu.publish import pb, serialize
 from electionguard_tpu.remote import rpc_util
 from electionguard_tpu.utils import clock, knobs
@@ -196,7 +197,8 @@ class MixServerServer:
                 datas.append(row_b)
             # idempotent by chunk_start: a retried chunk overwrites itself
             self._chunks[int(request.chunk_start)] = (pads, datas)
-            REGISTRY.counter("mixfed_rows_pushed_total").inc(len(pads))
+            REGISTRY.counter("mixfed_rows_pushed_total",
+                             election_labels()).inc(len(pads))
             return pb.msg("BoolResponse")(ok=True)
 
     @staticmethod
@@ -269,7 +271,8 @@ class MixServerServer:
                 header=serialize.publish_mix_header(self.group, stage),
                 output_hash=out_hash)
             self._result_input_hash = want or got
-            REGISTRY.counter("mixfed_stages_total").inc()
+            REGISTRY.counter("mixfed_stages_total",
+                             election_labels()).inc()
             return self._result
 
     def _pull_rows(self, request, context):
@@ -285,7 +288,8 @@ class MixServerServer:
             rows = [serialize.publish_mix_row(
                 self.group, self._out_pads[i], self._out_datas[i])
                 for i in range(start, end)]
-            REGISTRY.counter("mixfed_rows_pulled_total").inc(len(rows))
+            REGISTRY.counter("mixfed_rows_pulled_total",
+                             election_labels()).inc(len(rows))
             return pb.MixRowChunk(stage_index=k, chunk_start=start,
                                   rows=rows)
 

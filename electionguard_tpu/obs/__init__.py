@@ -22,7 +22,8 @@ Env vars (all off by default; see README "Observability"):
 from __future__ import annotations
 
 from electionguard_tpu.obs.registry import (REGISTRY,  # noqa: F401
-                                            MetricsRegistry, expose,
+                                            MetricsRegistry,
+                                            election_labels, expose,
                                             merged_snapshot,
                                             merged_to_proto,
                                             prometheus_text_all)

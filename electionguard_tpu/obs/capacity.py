@@ -1,0 +1,750 @@
+"""Capacity planning: empirical cost models over the obs-plane artifacts.
+
+The obs plane records and attributes wall-clock (obs/trace, obs/analyze);
+this module makes those recordings *predictive*.  Three layers:
+
+* **fitters** — ingest the artifacts the repo already produces and turn
+  them into per-term cost estimates with uncertainty bands:
+
+  - ``BENCH_BIGNUM.json`` → per-backend modexp rooflines (variable-base
+    ladder rows/s normalized to the full 256-bit exponent, fixed-base
+    rows/s as measured at 256 bits);
+  - ``SCALE.json`` → tiny-group streaming per-ballot host costs (the
+    repeated 100k-ballot rows are the uncertainty samples), the fabric
+    worker-scaling curve (fit to Amdahl's law: rate(w) = w·r1 /
+    (1 + σ·(w−1)), σ the serial fraction), and the production-group
+    measured verify anchor;
+  - a trace forest (``obs/analyze.RunAnalysis``) → per-phase ×
+    per-category self-time shares, incl. the rpc overhead share;
+  - a collector/serving metrics snapshot → mean batch occupancy from the
+    ``batch_occupancy`` histogram.
+
+  Every ``Estimate`` carries ``rel_band``: the relative sample std when
+  repeated samples exist, else the prior band bench_diff already uses
+  for that metric class.
+
+* **an analytic pipeline model** — ``predict`` composes per-phase costs
+  (serve-encrypt → K mix stages → compensated decrypt → RLC batch
+  verify / live-verify residual) into end-to-end wall-clock as a
+  function of a ``Plan`` (ballots, workers, chips, mix stages, backend,
+  batch knobs), names the bottleneck phase, and reports the worker-
+  scaling knee (the worker count where Amdahl efficiency crosses 50%).
+  ``chips_for_deadline`` inverts it: the smallest chip count whose
+  predicted wall-clock meets a deadline, with optimistic/pessimistic
+  bounds from the band.
+
+* **validation** — the model must reproduce *measured* configurations:
+  ``validate_fabric`` holds out the last point of the SCALE.json fabric
+  curve and predicts it from the rest; ``validate_e2e`` runs a traced
+  tiny-group election end-to-end (a real flight-report trace), fits
+  per-phase linear costs on two calibration sizes, and predicts a third,
+  larger measured run.  ``validate`` aggregates both and fails when any
+  error exceeds the tolerance (``EGTPU_CAPACITY_TOL``).
+
+Modexp-row counts per ballot come from the fused-program op mix pinned
+in ``TPU_RESULTS.md`` (2 selections + 1 placeholder): ~18 full-ladder
+rows/ballot for naive verify, ~4 with the RLC batch screen, ~12
+fixed-base rows for encryption, ~8 variable rows per mix stage (width-2
+re-encryption + Chaum-Pedersen), ~0.5 rows/ballot amortized compensated
+decrypt (tally selections + ~10% spoiled).  ``tools/egplan.py`` renders
+the tracked ``CAPACITY.md``/``CAPACITY.json`` from here; ``bench.py``'s
+``capacity`` phase re-validates per bench run and emits
+``capacity_model_err_pct`` so model drift gates like any perf
+regression.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from electionguard_tpu.utils import clock
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+#: full-ladder exponent width the rooflines are normalized to
+LADDER_BITS = 256
+
+#: full-ladder modexp rows per ballot per phase (TPU_RESULTS.md op mix
+#: at 2 selections + 1 placeholder; encrypt rows are fixed-base)
+ROWS_PER_BALLOT = {
+    "encrypt": 12.0,
+    "mix_stage": 8.0,
+    "decrypt": 0.5,
+    "verify": 18.0,
+    "verify_batch": 4.0,
+}
+
+#: the live-verify residual contract: ≤5% of record verify left at close
+LIVE_RESIDUAL_FRACTION = 0.05
+
+#: prior relative band when a term has a single sample (the bench_diff
+#: noise band for the powmod metric class)
+PRIOR_REL_BAND = 0.15
+
+
+# ---------------------------------------------------------------------------
+# estimates with uncertainty bands
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Estimate:
+    """A fitted scalar with a relative 1-sigma band and sample count."""
+
+    mean: float
+    rel_band: float = PRIOR_REL_BAND
+    n: int = 1
+
+    @property
+    def lo(self) -> float:
+        return self.mean * (1.0 - self.rel_band)
+
+    @property
+    def hi(self) -> float:
+        return self.mean * (1.0 + self.rel_band)
+
+    def scaled(self, factor: float) -> "Estimate":
+        return Estimate(self.mean * factor, self.rel_band, self.n)
+
+    def to_json(self) -> dict:
+        return {"mean": self.mean, "rel_band": round(self.rel_band, 4),
+                "n": self.n}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Estimate":
+        return cls(float(d["mean"]), float(d.get("rel_band",
+                                                 PRIOR_REL_BAND)),
+                   int(d.get("n", 1)))
+
+    @classmethod
+    def from_samples(cls, samples: list[float],
+                     prior: float = PRIOR_REL_BAND) -> "Estimate":
+        vals = [float(v) for v in samples if v is not None]
+        if not vals:
+            raise ValueError("no samples")
+        mean = sum(vals) / len(vals)
+        if len(vals) < 2 or mean == 0:
+            return cls(mean, prior, len(vals))
+        var = sum((v - mean) ** 2 for v in vals) / (len(vals) - 1)
+        return cls(mean, math.sqrt(var) / abs(mean), len(vals))
+
+
+# ---------------------------------------------------------------------------
+# the fitted cost model
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CostModel:
+    """Per-term device/host/rpc costs fitted from measured artifacts."""
+
+    platform: str = "unknown"
+    #: backend -> variable-base full-ladder modexp rows/s (one chip)
+    powmod_per_s: dict = field(default_factory=dict)
+    #: backend -> fixed-base 256-bit rows/s (one chip)
+    fixed_per_s: dict = field(default_factory=dict)
+    #: tiny-group streaming host path, per-ballot seconds per phase
+    stream_per_ballot_s: dict = field(default_factory=dict)
+    #: production-group measured verify anchor (ballots/s/chip)
+    prod_verify_per_s_per_chip: Optional[Estimate] = None
+    #: serving service time per ballot at 1 fabric worker (admission +
+    #: device emulation + merge), from the fabric curve's first point
+    rpc_per_ballot_s: Optional[Estimate] = None
+    #: Amdahl serial fraction of the fabric worker-scaling curve
+    serial_fraction: Estimate = field(
+        default_factory=lambda: Estimate(0.15, PRIOR_REL_BAND, 0))
+    #: mean batch occupancy from serving histograms (0..1]
+    occupancy: Estimate = field(
+        default_factory=lambda: Estimate(0.85, PRIOR_REL_BAND, 0))
+    #: per-phase × per-category self-time profile from a trace forest
+    phase_profile: dict = field(default_factory=dict)
+    sources: dict = field(default_factory=dict)
+    warnings: list = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "platform": self.platform,
+            "powmod_per_s": {k: v.to_json()
+                             for k, v in self.powmod_per_s.items()},
+            "fixed_per_s": {k: v.to_json()
+                            for k, v in self.fixed_per_s.items()},
+            "stream_per_ballot_s": {
+                k: v.to_json()
+                for k, v in self.stream_per_ballot_s.items()},
+            "prod_verify_per_s_per_chip": (
+                self.prod_verify_per_s_per_chip.to_json()
+                if self.prod_verify_per_s_per_chip else None),
+            "rpc_per_ballot_s": (self.rpc_per_ballot_s.to_json()
+                                 if self.rpc_per_ballot_s else None),
+            "serial_fraction": self.serial_fraction.to_json(),
+            "occupancy": self.occupancy.to_json(),
+            "phase_profile": self.phase_profile,
+            "rows_per_ballot": dict(ROWS_PER_BALLOT),
+            "sources": self.sources,
+            "warnings": list(self.warnings),
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "CostModel":
+        m = cls(platform=d.get("platform", "unknown"))
+        m.powmod_per_s = {k: Estimate.from_json(v)
+                          for k, v in d.get("powmod_per_s", {}).items()}
+        m.fixed_per_s = {k: Estimate.from_json(v)
+                         for k, v in d.get("fixed_per_s", {}).items()}
+        m.stream_per_ballot_s = {
+            k: Estimate.from_json(v)
+            for k, v in d.get("stream_per_ballot_s", {}).items()}
+        if d.get("prod_verify_per_s_per_chip"):
+            m.prod_verify_per_s_per_chip = Estimate.from_json(
+                d["prod_verify_per_s_per_chip"])
+        if d.get("rpc_per_ballot_s"):
+            m.rpc_per_ballot_s = Estimate.from_json(d["rpc_per_ballot_s"])
+        if d.get("serial_fraction"):
+            m.serial_fraction = Estimate.from_json(d["serial_fraction"])
+        if d.get("occupancy"):
+            m.occupancy = Estimate.from_json(d["occupancy"])
+        m.phase_profile = d.get("phase_profile", {})
+        m.sources = d.get("sources", {})
+        m.warnings = list(d.get("warnings", []))
+        return m
+
+
+# ---------------------------------------------------------------------------
+# fitters
+# ---------------------------------------------------------------------------
+
+def fit_bignum(doc: dict, model: CostModel) -> None:
+    """Per-backend modexp rooflines from a ``BENCH_BIGNUM.json`` doc.
+
+    ``per_s`` rows are rows/s at the row's own ``exp_bits``; variable-
+    base (``powmod``) rates are normalized to the full 256-bit ladder
+    (ladder cost is linear in exponent bits), ``fixed`` rows are already
+    measured at 256 bits.  Repeated rows of the same (backend, op,
+    batch, exp_bits) config are uncertainty samples.
+    """
+    model.platform = doc.get("platform", model.platform)
+    groups: dict = {}
+    for r in doc.get("rows", []):
+        op = r.get("op")
+        if op not in ("powmod", "fixed") or not r.get("per_s"):
+            continue
+        key = (r.get("backend"), op, r.get("batch"), r.get("exp_bits"))
+        groups.setdefault(key, []).append(float(r["per_s"]))
+    best: dict = {}
+    for (backend, op, _batch, exp_bits), samples in groups.items():
+        est = Estimate.from_samples(samples)
+        if op == "powmod":
+            est = Estimate(est.mean * float(exp_bits or LADDER_BITS)
+                           / LADDER_BITS, est.rel_band, est.n)
+        prev = best.get((backend, op))
+        if prev is None or est.mean > prev.mean:
+            best[(backend, op)] = est
+    for (backend, op), est in best.items():
+        (model.powmod_per_s if op == "powmod"
+         else model.fixed_per_s)[backend] = est
+
+
+def fit_scale(rows: list, model: CostModel) -> None:
+    """Streaming per-ballot host costs, the fabric worker-scaling fit,
+    and the production-group verify anchor from ``SCALE.json``."""
+    stream_samples: dict = {}
+    for r in rows:
+        phase = r.get("phase")
+        if phase == "stream" and r.get("nballots"):
+            n = float(r["nballots"])
+            for name, key in (("encrypt", "encrypt_s"),
+                              ("tally", "tally_s"),
+                              ("verify", "verify_s")):
+                if r.get(key):
+                    stream_samples.setdefault(name, []).append(
+                        float(r[key]) / n)
+        elif phase == "prod" and r.get("verify_per_s_per_chip"):
+            model.prod_verify_per_s_per_chip = Estimate(
+                float(r["verify_per_s_per_chip"]))
+        elif phase == "fabric" and r.get("curve"):
+            _fit_fabric_curve(r["curve"], model)
+    for name, samples in stream_samples.items():
+        model.stream_per_ballot_s[name] = Estimate.from_samples(samples)
+
+
+def _fit_fabric_curve(curve: list, model: CostModel,
+                      holdout_last: bool = False) -> Optional[dict]:
+    """Least-squares Amdahl fit of ``rate(w) = w·r1 / (1 + σ·(w−1))``
+    over the fabric curve.  With ``holdout_last`` the final point is
+    excluded from the fit and returned as a prediction row (the
+    validation config)."""
+    pts = [(int(p["workers"]), float(p["ballots_per_s"]))
+           for p in curve if p.get("workers") and p.get("ballots_per_s")]
+    pts.sort()
+    if not pts or pts[0][0] != 1:
+        model.warnings.append("fabric curve lacks a 1-worker point; "
+                              "worker-scaling fit skipped")
+        return None
+    fit_pts = pts[:-1] if (holdout_last and len(pts) > 2) else pts
+    r1 = fit_pts[0][1]
+    # each point w>1 gives an exact σ_w = (w·r1/rate − 1)/(w−1);
+    # the fit is their mean, the band their spread
+    sigmas = [((w * r1 / rate) - 1.0) / (w - 1)
+              for w, rate in fit_pts if w > 1 and rate > 0]
+    if sigmas:
+        model.serial_fraction = Estimate.from_samples(
+            [max(s, 0.0) for s in sigmas])
+    model.rpc_per_ballot_s = Estimate(1.0 / r1)
+    if holdout_last and len(pts) > 2:
+        w, measured = pts[-1]
+        predicted = (w * r1) / (1.0 + model.serial_fraction.mean * (w - 1))
+        return {"workers": w, "measured_ballots_per_s": measured,
+                "predicted_ballots_per_s": round(predicted, 2),
+                "err_pct": round(abs(predicted - measured)
+                                 / measured * 100.0, 2)}
+    return None
+
+
+def fit_trace(analysis, model: CostModel) -> None:
+    """Per-phase × per-category self-time shares from a trace forest
+    (an ``obs/analyze.RunAnalysis``)."""
+    profile: dict = {}
+    for (phase, _proc, category), us in analysis.buckets.items():
+        p = profile.setdefault(phase, {})
+        p[category] = p.get(category, 0) + int(us)
+    model.phase_profile = profile
+    if analysis.warnings:
+        model.warnings.extend(f"trace: {w}" for w in analysis.warnings[:5])
+
+
+def fit_collector(snapshot: dict, model: CostModel) -> None:
+    """Mean batch occupancy from the serving ``batch_occupancy``
+    histogram(s) in a registry/collector metrics snapshot."""
+    total, count = 0.0, 0
+    for flat, h in snapshot.get("histograms", {}).items():
+        if flat.split("{", 1)[0] == "batch_occupancy" and h.get("count"):
+            total += float(h.get("sum", 0.0))
+            count += int(h["count"])
+    if count:
+        model.occupancy = Estimate(min(total / count, 1.0),
+                                   PRIOR_REL_BAND, count)
+
+
+def fit(repo_root: Optional[str] = None,
+        bignum_path: Optional[str] = None,
+        scale_path: Optional[str] = None,
+        trace_dir: Optional[str] = None,
+        snapshot: Optional[dict] = None) -> CostModel:
+    """Fit a ``CostModel`` from whatever artifacts exist; every missing
+    input degrades to a warning plus that term's default, never a
+    raise."""
+    root = repo_root or REPO_ROOT
+    model = CostModel()
+    bignum_path = bignum_path or os.path.join(root, "BENCH_BIGNUM.json")
+    scale_path = scale_path or os.path.join(root, "SCALE.json")
+    try:
+        with open(bignum_path) as f:
+            fit_bignum(json.load(f), model)
+        model.sources["bignum"] = bignum_path
+    except (OSError, ValueError) as e:
+        model.warnings.append(f"no bignum rooflines ({e})")
+    try:
+        with open(scale_path) as f:
+            fit_scale(json.load(f), model)
+        model.sources["scale"] = scale_path
+    except (OSError, ValueError) as e:
+        model.warnings.append(f"no scale curves ({e})")
+    if trace_dir:
+        try:
+            from electionguard_tpu.obs import analyze
+            fit_trace(analyze.analyze(trace_dir), model)
+            model.sources["trace"] = trace_dir
+        except Exception as e:  # noqa: BLE001 — fitting is best-effort
+            model.warnings.append(f"trace fit failed ({e})")
+    if snapshot:
+        fit_collector(snapshot, model)
+        model.sources["snapshot"] = "metrics snapshot"
+    return model
+
+
+# ---------------------------------------------------------------------------
+# the analytic pipeline model
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Plan:
+    """One what-if configuration.  ``workers=0`` means "enough fabric
+    workers that serving never binds" (the headline chips question)."""
+
+    ballots: int = 1_000_000
+    workers: int = 0
+    chips: int = 1
+    mix_stages: int = 0
+    backend: str = "cios"
+    batch_verify: bool = True
+    live_verify: bool = False
+
+    def to_json(self) -> dict:
+        return {"ballots": self.ballots, "workers": self.workers,
+                "chips": self.chips, "mix_stages": self.mix_stages,
+                "backend": self.backend,
+                "batch_verify": self.batch_verify,
+                "live_verify": self.live_verify}
+
+
+@dataclass
+class PhaseCost:
+    name: str
+    seconds: Estimate
+    limiter: str = "device"
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "seconds": self.seconds.to_json(),
+                "limiter": self.limiter}
+
+
+@dataclass
+class Prediction:
+    plan: Plan
+    phases: list
+    total: Estimate
+    bottleneck: str
+    knee_workers: Optional[int]
+    warnings: list = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {"plan": self.plan.to_json(),
+                "phases": [p.to_json() for p in self.phases],
+                "total_s": self.total.to_json(),
+                "bottleneck": self.bottleneck,
+                "knee_workers": self.knee_workers,
+                "warnings": list(self.warnings)}
+
+
+def worker_efficiency(workers: int, sigma: float) -> float:
+    """Amdahl effective-worker fraction: ``w_eff/w = 1/(1+σ·(w−1))``."""
+    if workers <= 1:
+        return 1.0
+    return 1.0 / (1.0 + sigma * (workers - 1))
+
+
+def predict(model: CostModel, plan: Plan) -> Prediction:
+    """End-to-end wall-clock of ``plan`` under ``model``: serve-encrypt
+    → K mix stages → compensated decrypt → verify (RLC batch or naive,
+    live-verify residual)."""
+    warnings: list[str] = []
+    pow_est = model.powmod_per_s.get(plan.backend)
+    if pow_est is None or pow_est.mean <= 0:
+        raise ValueError(f"no powmod roofline for backend "
+                         f"{plan.backend!r}; fit BENCH_BIGNUM.json first")
+    fixed_est = model.fixed_per_s.get(plan.backend)
+    if fixed_est is None:
+        fixed_est = pow_est
+        warnings.append(f"no fixed-base rate for {plan.backend}; "
+                        f"using the variable-base ladder rate")
+    occ = max(min(model.occupancy.mean, 1.0), 1e-3)
+    chips = max(plan.chips, 1)
+
+    def device_s(rows: float, rate: Estimate) -> Estimate:
+        sec = rows / (rate.mean * chips * occ)
+        band = math.hypot(rate.rel_band, model.occupancy.rel_band)
+        return Estimate(sec, band, rate.n)
+
+    phases: list[PhaseCost] = []
+
+    # serve-encrypt: device fixed-base exponentiations vs the fabric
+    # serving floor (admission + rpc + merge) — pipelined, so the wall
+    # is whichever side binds
+    enc_dev = device_s(plan.ballots * ROWS_PER_BALLOT["encrypt"],
+                       fixed_est)
+    enc = enc_dev
+    limiter = "device"
+    if plan.workers > 0 and model.rpc_per_ballot_s is not None:
+        eff = worker_efficiency(plan.workers, model.serial_fraction.mean)
+        serve_s = (plan.ballots * model.rpc_per_ballot_s.mean
+                   / (plan.workers * eff))
+        if serve_s > enc_dev.mean:
+            enc = Estimate(serve_s,
+                           math.hypot(model.rpc_per_ballot_s.rel_band,
+                                      model.serial_fraction.rel_band),
+                           model.rpc_per_ballot_s.n)
+            limiter = "rpc"
+    phases.append(PhaseCost("serve-encrypt", enc, limiter))
+
+    if plan.mix_stages > 0:
+        rows = (plan.ballots * ROWS_PER_BALLOT["mix_stage"]
+                * plan.mix_stages)
+        phases.append(PhaseCost(f"mix×{plan.mix_stages}",
+                                device_s(rows, pow_est)))
+
+    phases.append(PhaseCost(
+        "decrypt", device_s(plan.ballots * ROWS_PER_BALLOT["decrypt"],
+                            pow_est)))
+
+    rows_key = "verify_batch" if plan.batch_verify else "verify"
+    ver_rows = plan.ballots * ROWS_PER_BALLOT[rows_key]
+    ver_name = "verify-batch" if plan.batch_verify else "verify"
+    if plan.live_verify:
+        ver_rows *= LIVE_RESIDUAL_FRACTION
+        ver_name += "-residual"
+    phases.append(PhaseCost(ver_name, device_s(ver_rows, pow_est)))
+
+    total_mean = sum(p.seconds.mean for p in phases)
+    # phase terms are independent fits: absolute sigmas add in
+    # quadrature
+    sigma = math.sqrt(sum((p.seconds.mean * p.seconds.rel_band) ** 2
+                          for p in phases))
+    total = Estimate(total_mean,
+                     sigma / total_mean if total_mean else 0.0,
+                     min(p.seconds.n for p in phases))
+    bottleneck = max(phases, key=lambda p: p.seconds.mean).name
+    sf = model.serial_fraction.mean
+    knee = int(math.ceil(1.0 + 1.0 / sf)) if sf > 0 else None
+    return Prediction(plan, phases, total, bottleneck, knee, warnings)
+
+
+def chips_for_deadline(model: CostModel, ballots: int, deadline_s: float,
+                       backend: str, **plan_kwargs) -> dict:
+    """Smallest chip count whose predicted wall-clock meets the
+    deadline, with optimistic/pessimistic bounds from the band."""
+    def total_at(chips: int) -> Estimate:
+        return predict(model, Plan(ballots=ballots, chips=chips,
+                                   backend=backend,
+                                   **plan_kwargs)).total
+
+    def search(meets: Callable[[Estimate], bool]) -> Optional[int]:
+        if not meets(total_at(1)):
+            hi = 1
+            while hi < 2 ** 40 and not meets(total_at(hi)):
+                hi *= 2
+            if hi >= 2 ** 40:
+                return None
+            lo = hi // 2
+            while lo + 1 < hi:
+                mid = (lo + hi) // 2
+                if meets(total_at(mid)):
+                    hi = mid
+                else:
+                    lo = mid
+            return hi
+        return 1
+
+    chips = search(lambda t: t.mean <= deadline_s)
+    chips_lo = search(lambda t: t.lo <= deadline_s)   # optimistic
+    chips_hi = search(lambda t: t.hi <= deadline_s)   # pessimistic
+    pred = (predict(model, Plan(ballots=ballots, chips=chips,
+                                backend=backend, **plan_kwargs))
+            if chips else None)
+    return {"backend": backend, "ballots": ballots,
+            "deadline_s": deadline_s, "chips": chips,
+            "chips_lo": chips_lo, "chips_hi": chips_hi,
+            "bottleneck": pred.bottleneck if pred else None,
+            "total_s": pred.total.to_json() if pred else None}
+
+
+# ---------------------------------------------------------------------------
+# validation against measured configurations
+# ---------------------------------------------------------------------------
+
+def tolerance() -> float:
+    from electionguard_tpu.utils import knobs
+    return knobs.get_float("EGTPU_CAPACITY_TOL")
+
+
+def validate_fabric(scale_path: Optional[str] = None,
+                    tol: Optional[float] = None) -> dict:
+    """Hold out the last point of the SCALE.json fabric curve, fit the
+    worker-scaling law on the rest, predict the held-out throughput."""
+    tol = tolerance() if tol is None else tol
+    path = scale_path or os.path.join(REPO_ROOT, "SCALE.json")
+    out = {"name": "scale-fabric-holdout", "source": path}
+    try:
+        with open(path) as f:
+            rows = json.load(f)
+    except (OSError, ValueError) as e:
+        out.update(skipped=f"no SCALE.json ({e})")
+        return out
+    for r in rows:
+        if r.get("phase") == "fabric" and len(r.get("curve") or []) >= 3:
+            probe = CostModel()
+            row = _fit_fabric_curve(r["curve"], probe, holdout_last=True)
+            if row is None:
+                continue
+            out.update(row)
+            out["pass"] = row["err_pct"] <= tol * 100.0
+            return out
+    out.update(skipped="no fabric curve with ≥3 points")
+    return out
+
+
+def measure_traced_run(nballots: int, tag: str, seed: int = 7) -> dict:
+    """One tiny-group election end-to-end (encrypt → tally → verify)
+    under the trace plane: every phase is a ``phase.*`` span, so the
+    run's trace dir is a real flight-report trace.  Returns measured
+    per-phase and total wall seconds."""
+    from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+    from electionguard_tpu.core.group import tiny_group
+    from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+    from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+    from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+    from electionguard_tpu.obs import trace
+    from electionguard_tpu.publish.election_record import (ElectionConfig,
+                                                           ElectionRecord)
+    from electionguard_tpu.tally.accumulate import accumulate_ballots
+    from electionguard_tpu.verify.verifier import Verifier
+    from electionguard_tpu.workflow.e2e import sample_manifest
+
+    g = tiny_group()
+    manifest = sample_manifest(1, 2)
+    trustees = [KeyCeremonyTrustee(g, "guardian-0", 1, 1)]
+    init = key_ceremony_exchange(trustees, g).make_election_initialized(
+        ElectionConfig(manifest, 1, 1), {"created_by": "egplan"})
+    ballots = list(RandomBallotProvider(manifest, nballots,
+                                        seed=seed).ballots())
+    phases: dict = {}
+    t_run = clock.monotonic()
+    with trace.span(f"plan.{tag}", {"n": nballots}):
+        t0 = clock.monotonic()
+        with trace.span("phase.encrypt", {"n": nballots}):
+            encrypted, invalid = BatchEncryptor(init, g).encrypt_ballots(
+                ballots, seed=g.int_to_q(97))
+        phases["encrypt"] = clock.monotonic() - t0
+        if invalid or len(encrypted) != nballots:
+            raise RuntimeError(f"egplan measurement run rejected "
+                               f"{len(invalid)} ballots")
+        t0 = clock.monotonic()
+        with trace.span("phase.tally"):
+            tally_result = accumulate_ballots(init, encrypted)
+        phases["tally"] = clock.monotonic() - t0
+        record = ElectionRecord(election_init=init,
+                                encrypted_ballots=encrypted,
+                                tally_result=tally_result)
+        t0 = clock.monotonic()
+        with trace.span("phase.verify", {"n": nballots}):
+            res = Verifier(record, g).verify()
+        phases["verify"] = clock.monotonic() - t0
+        if not res.ok:
+            raise RuntimeError(f"egplan measurement run failed "
+                               f"verification: {res.summary()}")
+    return {"nballots": nballots, "phases": phases,
+            "wall_s": clock.monotonic() - t_run}
+
+
+def validate_e2e(runner: Callable[[int, str], dict] = measure_traced_run,
+                 sizes: Optional[tuple] = None,
+                 tol: Optional[float] = None) -> dict:
+    """Fit per-phase linear costs (fixed + per-ballot) on two measured
+    calibration elections and predict a third, held-out size between
+    them, comparing against its measured end-to-end wall-clock.
+
+    Warm passes run at every measured size first so each batch-bucket
+    shape's kernels are compiled before timing, and every measurement
+    is the per-phase MIN of three repetitions: scheduling jitter on a
+    loaded host is strictly additive, so the min is the estimator of
+    the actual cost (medians of sub-second runs still carry tens of
+    percent of noise).  The calibration sizes bracket the validation
+    size: device batches pad to power-of-two buckets, so per-ballot
+    cost is a step function of n and only interpolation across the
+    bracket is well-posed."""
+    tol = tolerance() if tol is None else tol
+    if sizes is None:
+        from electionguard_tpu.utils import knobs
+        sizes = tuple(int(s) for s in
+                      knobs.get_str("EGTPU_CAPACITY_VALIDATE_N").split(","))
+    n1, n2, n3 = sizes
+    if n1 == n2:
+        raise ValueError("calibration sizes must differ")
+
+    def _best_run(n: int, tag: str, reps: int = 3) -> dict:
+        runs = [runner(n, f"{tag}{i}") for i in range(reps)]
+        phases = {name: min(r["phases"][name] for r in runs)
+                  for name in runs[0]["phases"]}
+        return {"nballots": n, "phases": phases}
+
+    for n in sorted(set(sizes)):
+        runner(n, "warm")
+    m1 = _best_run(n1, "cal1-")
+    m2 = _best_run(n2, "cal2-")
+    fitted = {}
+    for name in m1["phases"]:
+        slope = (m2["phases"][name] - m1["phases"][name]) / (n2 - n1)
+        slope = max(slope, 0.0)
+        fixed = max(m1["phases"][name] - slope * n1, 0.0)
+        fitted[name] = {"per_ballot_s": slope, "fixed_s": fixed}
+    predicted = sum(f["fixed_s"] + f["per_ballot_s"] * n3
+                    for f in fitted.values())
+    m3 = _best_run(n3, "validate-")
+    measured = sum(m3["phases"].values())
+    err_pct = abs(predicted - measured) / measured * 100.0
+    return {"name": "e2e-traced-election", "sizes": list(sizes),
+            "fitted": fitted,
+            "predicted_s": round(predicted, 4),
+            "measured_s": round(measured, 4),
+            "err_pct": round(err_pct, 2),
+            "pass": err_pct <= tol * 100.0}
+
+
+def validate(runner: Callable[[int, str], dict] = measure_traced_run,
+             scale_path: Optional[str] = None,
+             tol: Optional[float] = None) -> dict:
+    """The full predicted-vs-actual gate: both measured configurations
+    (the traced e2e election and the SCALE.json fabric point) must
+    reproduce within the tolerance band."""
+    tol = tolerance() if tol is None else tol
+    configs = [validate_fabric(scale_path, tol), validate_e2e(runner,
+                                                              tol=tol)]
+    checked = [c for c in configs if "err_pct" in c]
+    max_err = max((c["err_pct"] for c in checked), default=None)
+    return {"tolerance_pct": tol * 100.0, "configs": configs,
+            "n_checked": len(checked),
+            "max_err_pct": max_err,
+            "pass": bool(checked) and all(c.get("pass") for c in checked)}
+
+
+# ---------------------------------------------------------------------------
+# flight-report integration: predicted vs actual phase shares
+# ---------------------------------------------------------------------------
+
+#: predicted phase name -> substrings matched against trace phase keys
+_PHASE_MATCH = {
+    "serve-encrypt": ("encrypt",),
+    "mix": ("mix", "shuffle"),
+    "decrypt": ("decrypt",),
+    "verify": ("verify", "tally"),
+}
+
+
+def phase_comparison(analysis, capacity_path: Optional[str] = None
+                     ) -> Optional[dict]:
+    """Predicted vs actual wall-clock shares per pipeline phase: the
+    tracked ``CAPACITY.json`` prediction against a run's trace buckets.
+    Returns ``None`` when either side is missing — flight reports render
+    the section best-effort."""
+    path = capacity_path or os.path.join(REPO_ROOT, "CAPACITY.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    pred = (doc.get("predictions") or [{}])[0]
+    pred_phases = pred.get("phases") or []
+    pred_total = sum(p["seconds"]["mean"] for p in pred_phases) or 1.0
+    actual: dict = {}
+    for (phase, _proc, _cat), us in analysis.buckets.items():
+        for name, needles in _PHASE_MATCH.items():
+            if any(n in phase.lower() for n in needles):
+                actual[name] = actual.get(name, 0) + int(us)
+                break
+    actual_total = sum(actual.values())
+    if not actual_total or not pred_phases:
+        return None
+    rows = []
+    for p in pred_phases:
+        name = p["name"]
+        key = next((k for k in _PHASE_MATCH if name.startswith(k)), name)
+        pred_share = p["seconds"]["mean"] / pred_total
+        act_share = actual.get(key, 0) / actual_total
+        rows.append({"phase": name,
+                     "predicted_share": round(pred_share, 3),
+                     "actual_share": round(act_share, 3),
+                     "delta_pp": round((act_share - pred_share) * 100, 1)})
+    return {"source": path, "plan": pred.get("plan"),
+            "validation": doc.get("validation"), "rows": rows}

@@ -55,6 +55,51 @@ DEFAULT_BUFFER = 5000
 #: max lines drained into one TelemetryBatch
 BATCH_LINES = 1000
 
+_SIZE_SUFFIX = {"KB": 1024, "MB": 1024 ** 2, "GB": 1024 ** 3}
+_AGE_SUFFIX = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+
+
+def parse_retain(spec: str) -> tuple[Optional[int], Optional[float]]:
+    """Parse an ``EGTPU_OBS_RETAIN`` value into
+    ``(max_bytes, max_age_s)`` — either may be None (unbounded).
+
+    Grammar: ``"SIZE[,AGE]"`` where SIZE takes a KB/MB/GB suffix
+    (plain number = bytes) and AGE takes s/m/h/d.  A leading comma
+    (``",24h"``) caps age only; empty spec disables retention.
+    Raises ValueError on anything else.
+    """
+    spec = (spec or "").strip()
+    if not spec:
+        return None, None
+    parts = spec.split(",")
+    if len(parts) > 2:
+        raise ValueError(f"retain spec wants SIZE[,AGE], got {spec!r}")
+    size_part = parts[0].strip()
+    age_part = parts[1].strip() if len(parts) == 2 else ""
+    max_bytes: Optional[int] = None
+    max_age_s: Optional[float] = None
+    if size_part:
+        up = size_part.upper()
+        mult = 1
+        for suf, m in _SIZE_SUFFIX.items():
+            if up.endswith(suf):
+                mult, up = m, up[: -len(suf)]
+                break
+        try:
+            max_bytes = int(float(up) * mult)
+        except ValueError:
+            raise ValueError(f"bad retain size {size_part!r}") from None
+    if age_part:
+        suf, num = age_part[-1].lower(), age_part[:-1]
+        if suf not in _AGE_SUFFIX:
+            raise ValueError(f"bad retain age {age_part!r} "
+                             f"(want s/m/h/d suffix)")
+        try:
+            max_age_s = float(num) * _AGE_SUFFIX[suf]
+        except ValueError:
+            raise ValueError(f"bad retain age {age_part!r}") from None
+    return max_bytes, max_age_s
+
 
 def _label_proc(snap: dict, proc: str) -> dict:
     """Relabel every series in one ``snapshot()`` dict with a
@@ -131,6 +176,14 @@ class ObsCollector:
         self._own_file = None
         self.live_path = os.path.join(out_dir, "trace_live.json")
         self.live_report: dict = {}
+        from electionguard_tpu.utils import knobs
+        try:
+            self.retain_bytes, self.retain_age_s = parse_retain(
+                knobs.get_str("EGTPU_OBS_RETAIN"))
+        except ValueError as e:
+            log.warning("EGTPU_OBS_RETAIN ignored: %s", e)
+            self.retain_bytes = self.retain_age_s = None
+        self._rotated = registry.REGISTRY.counter("obs_rotated_files_total")
 
     # ---- ingest ------------------------------------------------------
 
@@ -317,6 +370,10 @@ class ObsCollector:
                 self.evaluate_once()
             except Exception:  # noqa: BLE001 — the loop must survive
                 log.exception("slo evaluation failed")
+            try:
+                self._enforce_retention()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                log.exception("retention enforcement failed")
             now = clock.monotonic()
             if now - last_assemble >= self.assemble_every_s:
                 last_assemble = now
@@ -383,6 +440,64 @@ class ObsCollector:
                 self._red_until,
                 now + self.engine.config["heartbeat"]["dead_red_for_s"])
             self._red_reason = alert.summary()
+
+    def _enforce_retention(self, now: Optional[float] = None) -> int:
+        """Apply the ``EGTPU_OBS_RETAIN`` cap to the receive dir:
+        delete every ``*.jsonl`` past the age cap, then the oldest
+        files (by mtime) until total size fits the size cap.  Deleted
+        streams reopen on their next append, so a long sweep keeps its
+        retention-window tail.  Returns the number of files rotated
+        (also counted by ``obs_rotated_files_total``)."""
+        if self.retain_bytes is None and self.retain_age_s is None:
+            return 0
+        now = clock.now() if now is None else now
+        files = []
+        try:
+            names = os.listdir(self.recv_dir)
+        except OSError:
+            return 0
+        for name in names:
+            if not name.endswith(".jsonl"):
+                continue
+            path = os.path.join(self.recv_dir, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            files.append((st.st_mtime, st.st_size, path))
+        files.sort()                      # oldest first
+        total = sum(sz for _, sz, _ in files)
+        rotated = 0
+        with self._lock:
+            own_path = (None if self._own_file is None
+                        else self._own_file.name)
+        for mtime, size, path in files:
+            too_old = (self.retain_age_s is not None
+                       and now - mtime > self.retain_age_s)
+            over_cap = (self.retain_bytes is not None
+                        and total > self.retain_bytes)
+            if not too_old and not over_cap:
+                break                     # everything newer fits too
+            try:
+                os.remove(path)
+            except OSError as e:
+                log.warning("retention remove failed: %s", e)
+                continue
+            if path == own_path:
+                # reopen on next own-span export instead of writing to
+                # the unlinked inode forever
+                with self._lock:
+                    if self._own_file is not None:
+                        self._own_file.close()
+                        self._own_file = None
+            total -= size
+            rotated += 1
+        if rotated:
+            self._rotated.inc(rotated)
+            log.info("retention: rotated %d receive-dir file(s) "
+                     "(cap %s bytes / %s s)", rotated,
+                     self.retain_bytes, self.retain_age_s)
+        return rotated
 
     def _assemble_live(self) -> None:
         """Re-merge the receive dir plus every process's in-flight span

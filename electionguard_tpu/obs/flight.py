@@ -194,6 +194,37 @@ def render(a: analyze_mod.RunAnalysis) -> str:
             w(f"- `{p['kind']}` on **{p['subject']}**: {p['detail']}")
         w("")
 
+    # ---- predicted vs actual (capacity model) -------------------------
+    # best-effort: needs a tracked CAPACITY.json (tools/egplan.py) AND
+    # phase-attributable buckets in this run; silent otherwise
+    cmp_rows = None
+    try:
+        from electionguard_tpu.obs import capacity
+        cmp_rows = capacity.phase_comparison(a)
+    except Exception:  # noqa: BLE001 — the report never fails on this
+        cmp_rows = None
+    if cmp_rows:
+        w("## Predicted vs actual (capacity model)")
+        w("")
+        w(f"Model: `{cmp_rows['source']}` — shares of pipeline "
+          f"wall-clock, this run vs the tracked prediction.")
+        w("")
+        w("| phase | predicted share | actual share | delta |")
+        w("|-------|----------------:|-------------:|------:|")
+        for r in cmp_rows["rows"]:
+            w(f"| {r['phase']} | {r['predicted_share'] * 100:.1f}% | "
+              f"{r['actual_share'] * 100:.1f}% | "
+              f"{r['delta_pp']:+.1f}pp |")
+        w("")
+        val2 = cmp_rows.get("validation")
+        if val2 and val2.get("max_err_pct") is not None:
+            w(f"Last model validation: max err "
+              f"{val2['max_err_pct']:.1f}% over {val2['n_checked']} "
+              f"measured config(s) within a "
+              f"{val2['tolerance_pct']:.0f}% band "
+              f"(**{'PASS' if val2.get('pass') else 'FAIL'}**).")
+            w("")
+
     return "\n".join(lines) + "\n"
 
 

@@ -37,8 +37,46 @@ def flat_name(name: str, labels: Optional[dict] = None) -> str:
     """Prometheus-style series name: ``name{k="v",...}`` (keys sorted)."""
     if not labels:
         return name
-    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    inner = ",".join(f'{k}="{escape_label_value(str(labels[k]))}"'
+                     for k in sorted(labels))
     return f"{name}{{{inner}}}"
+
+
+def escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline (exposition format 0.0.4)."""
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def unescape_label_value(v: str) -> str:
+    """Inverse of :func:`escape_label_value` (for snapshot consumers
+    that parse flat names back into label dicts)."""
+    out = []
+    i = 0
+    while i < len(v):
+        ch = v[i]
+        if ch == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def election_labels(extra: Optional[dict] = None) -> dict:
+    """The per-tenant label set election-scoped series carry: the
+    ``EGTPU_ELECTION`` knob (``default`` in the single-election case)
+    as ``election=<id>``, plus any site-specific labels.  Threading
+    this through serve/fabric/mixfed counters is the seed for
+    multi-election SLO evaluation (obs/slo.py) over one fleet."""
+    from electionguard_tpu.utils import knobs
+    labels = {"election": knobs.get_str("EGTPU_ELECTION")}
+    if extra:
+        labels.update(extra)
+    return labels
 
 
 class Counter:

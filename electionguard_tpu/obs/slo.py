@@ -42,9 +42,13 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
+
+#: one k="v" pair in a flat series name, value possibly escaped
+_LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:\\.|[^"\\])*)"')
 
 DEFAULT_SLO: dict = {
     "availability": {
@@ -102,15 +106,17 @@ def load_config(spec: Optional[str] = None) -> dict:
 
 
 def parse_labels(flat: str) -> tuple[str, dict]:
-    """Invert ``registry.flat_name``: ``name{k="v",...}`` -> (name, {k: v})."""
+    """Invert ``registry.flat_name``: ``name{k="v",...}`` -> (name, {k: v}).
+
+    Values are unescaped (flat_name escapes ``\\``, ``"`` and newline),
+    so a round trip through a snapshot preserves arbitrary label
+    values — including ones containing ``,`` or ``"``."""
     if "{" not in flat:
         return flat, {}
+    from electionguard_tpu.obs import registry as _reg
     name, rest = flat.split("{", 1)
-    labels = {}
-    for part in rest.rstrip("}").split(","):
-        if "=" in part:
-            k, v = part.split("=", 1)
-            labels[k] = v.strip('"')
+    labels = {k: _reg.unescape_label_value(v)
+              for k, v in _LABEL_RE.findall(rest.rstrip("}"))}
     return name, labels
 
 

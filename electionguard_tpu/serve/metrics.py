@@ -22,7 +22,8 @@ from typing import Callable, Optional
 
 from electionguard_tpu.obs import jaxmon
 from electionguard_tpu.obs.registry import (Histogram,  # noqa: F401
-                                            MetricsRegistry, expose)
+                                            MetricsRegistry,
+                                            election_labels, expose)
 
 # default latency edges (ms): log-ish spacing from sub-ms to minutes
 _LATENCY_MS_BOUNDS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
@@ -53,7 +54,11 @@ class ServiceMetrics:
                  registry: Optional[MetricsRegistry] = None):
         self.registry = expose(registry if registry is not None
                                else MetricsRegistry("serve"))
-        self._counters = {name: self.registry.counter(name)
+        # every ballot-flow counter carries the election tenant label
+        # (EGTPU_ELECTION; "default" when a deployment serves one
+        # election) so a shared fleet's scrape stays per-tenant
+        labels = election_labels()
+        self._counters = {name: self.registry.counter(name, labels)
                           for name in self.COUNTERS}
         self._queue_depth = queue_depth
         self.latency_ms = self.registry.histogram("request_latency_ms",

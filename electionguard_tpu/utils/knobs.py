@@ -46,6 +46,21 @@ KNOBS: tuple[Knob, ...] = (
     Knob("EGTPU_BIGNUM", "str", "auto",
          "Bignum kernel backend: auto|pallas|ntt|cios; auto = pallas on "
          "TPU, cios elsewhere (core/group_jax)."),
+    Knob("EGTPU_CAPACITY_BALLOTS", "int", "1000000",
+         "Election size of the headline capacity question: chips needed "
+         "to finish this many ballots under EGTPU_CAPACITY_DEADLINE_S "
+         "(obs/capacity; tools/egplan)."),
+    Knob("EGTPU_CAPACITY_DEADLINE_S", "float", "60.0",
+         "Wall-clock deadline of the headline capacity question, "
+         "seconds (obs/capacity; tools/egplan)."),
+    Knob("EGTPU_CAPACITY_TOL", "float", "0.25",
+         "Predicted-vs-measured relative error band of the capacity "
+         "model validation gate: egplan --validate and the bench "
+         "capacity phase fail past it (obs/capacity)."),
+    Knob("EGTPU_CAPACITY_VALIDATE_N", "str", "128,512,384",
+         "Ballot counts of the traced e2e validation elections: two "
+         "calibration sizes bracketing the held-out predicted size "
+         "(obs/capacity.validate_e2e)."),
     Knob("EGTPU_CHAOS_HOLD_AFTER_BALLOTS", "int", None,
          "Chaos hook: the serving worker holds the device after N "
          "ballots so a SIGKILL lands mid-batch (cli/run_encryption_"
@@ -65,6 +80,11 @@ KNOBS: tuple[Knob, ...] = (
          "so a single-host fabric scale curve measures routing-plane "
          "scaling instead of host-core contention; 0 = off "
          "(serve/worker, set by tools/scale_run --fabric)."),
+    Knob("EGTPU_ELECTION", "str", "default",
+         "Election id stamped as the {election=...} label on the "
+         "serve/fabric/mixfed per-election metric series — the "
+         "per-tenant seed for multi-election fleets (serve/metrics; "
+         "fabric/router; mixfed)."),
     Knob("EGTPU_FABRIC_EVICT_AFTER", "int", "2",
          "Consecutive failed health polls before the router evicts a "
          "worker from routing (fabric/router)."),
@@ -141,6 +161,11 @@ KNOBS: tuple[Knob, ...] = (
          "Process name stamped on spans/logs (obs/trace)."),
     Knob("EGTPU_OBS_PUSH_INTERVAL", "float", "1.0",
          "Telemetry push interval, seconds (obs/collector)."),
+    Knob("EGTPU_OBS_RETAIN", "str", "",
+         "Collector receive-dir retention cap: 'SIZE[,AGE]' with "
+         "KB/MB/GB and s/m/h/d suffixes (e.g. '256MB,24h'); "
+         "oldest-first rotation, counted by obs_rotated_files_total; "
+         "empty = unbounded (obs/collector)."),
     Knob("EGTPU_OBS_SLO", "json", "",
          "SLO config override: inline JSON or @file (obs/slo)."),
     Knob("EGTPU_OBS_TRACE", "path", None,

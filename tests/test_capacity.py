@@ -1,0 +1,350 @@
+"""Capacity-planning plane (obs/capacity.py): the cost-model fitters,
+the analytic pipeline model, and the predicted-vs-actual validation
+gate — all on synthetic artifacts and a deterministic fake runner, so
+the tier covers every contract without timing a real election.  The
+real measured runs live in ``tools/egplan.py --validate`` (which the
+bench capacity phase replays per bench round).
+"""
+
+import json
+import os
+
+import pytest
+
+from electionguard_tpu.obs import capacity
+from electionguard_tpu.obs.capacity import (CostModel, Estimate, Plan,
+                                            ROWS_PER_BALLOT)
+
+
+# ---------------------------------------------------------------------------
+# estimates
+# ---------------------------------------------------------------------------
+
+def test_estimate_from_samples_band():
+    # one sample -> the prior band rides along
+    one = Estimate.from_samples([10.0], prior=0.2)
+    assert (one.mean, one.rel_band, one.n) == (10.0, 0.2, 1)
+    # repeated samples -> relative sample std
+    est = Estimate.from_samples([9.0, 10.0, 11.0])
+    assert est.mean == 10.0 and est.n == 3
+    assert est.rel_band == pytest.approx(0.1)
+    assert est.lo == pytest.approx(9.0) and est.hi == pytest.approx(11.0)
+    with pytest.raises(ValueError):
+        Estimate.from_samples([])
+    # json round trip preserves the band
+    assert Estimate.from_json(est.to_json()).rel_band == \
+        pytest.approx(est.rel_band, abs=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# fitters
+# ---------------------------------------------------------------------------
+
+def test_fit_bignum_normalizes_ladder_and_keeps_best():
+    model = CostModel()
+    capacity.fit_bignum({"platform": "cpu", "rows": [
+        # variable-base at a short exponent: rows/s scales by bits/256
+        {"backend": "cios", "op": "powmod", "batch": 8, "exp_bits": 32,
+         "per_s": 80.0},
+        {"backend": "cios", "op": "powmod", "batch": 8, "exp_bits": 32,
+         "per_s": 88.0},
+        # a slower config of the same backend must NOT win
+        {"backend": "cios", "op": "powmod", "batch": 1, "exp_bits": 32,
+         "per_s": 8.0},
+        # fixed-base rows are already at 256 bits
+        {"backend": "cios", "op": "fixed", "batch": 8, "exp_bits": 256,
+         "per_s": 145.0},
+        {"backend": "ntt", "op": "powmod", "batch": 8, "exp_bits": 256,
+         "per_s": 0.8},
+        {"backend": "cios", "op": "other", "per_s": 999.0},   # ignored
+    ]}, model)
+    assert model.platform == "cpu"
+    assert model.powmod_per_s["cios"].mean == pytest.approx(84.0 * 32 / 256)
+    assert model.powmod_per_s["cios"].n == 2
+    assert model.fixed_per_s["cios"].mean == pytest.approx(145.0)
+    assert model.powmod_per_s["ntt"].mean == pytest.approx(0.8)
+    assert "other" not in model.powmod_per_s
+
+
+def _amdahl_curve(r1, sigma, workers):
+    return [{"workers": w,
+             "ballots_per_s": w * r1 / (1.0 + sigma * (w - 1))}
+            for w in workers]
+
+
+def test_fit_scale_stream_fabric_and_prod_anchor():
+    model = CostModel()
+    capacity.fit_scale([
+        {"phase": "stream", "nballots": 1000, "encrypt_s": 4.0,
+         "verify_s": 3.0},
+        {"phase": "stream", "nballots": 2000, "encrypt_s": 8.4},
+        {"phase": "prod", "verify_per_s_per_chip": 0.6},
+        {"phase": "fabric", "curve": _amdahl_curve(15.0, 0.125,
+                                                   (1, 2, 4, 8))},
+    ], model)
+    # per-ballot host costs: two encrypt samples -> mean + sample band
+    enc = model.stream_per_ballot_s["encrypt"]
+    assert enc.mean == pytest.approx((0.004 + 0.0042) / 2) and enc.n == 2
+    assert model.stream_per_ballot_s["verify"].mean == pytest.approx(0.003)
+    assert model.prod_verify_per_s_per_chip.mean == pytest.approx(0.6)
+    # an exact Amdahl curve fits back to its own σ and service time
+    assert model.serial_fraction.mean == pytest.approx(0.125)
+    assert model.serial_fraction.rel_band == pytest.approx(0.0, abs=1e-9)
+    assert model.rpc_per_ballot_s.mean == pytest.approx(1 / 15.0)
+
+
+def test_fit_degrades_with_warnings_on_missing_artifacts(tmp_path):
+    model = capacity.fit(repo_root=str(tmp_path))
+    assert model.powmod_per_s == {}
+    assert any("bignum" in w for w in model.warnings)
+    assert any("scale" in w for w in model.warnings)
+
+
+def test_fit_collector_occupancy_from_histogram():
+    model = CostModel()
+    capacity.fit_collector({"histograms": {
+        'batch_occupancy{proc="serve"}': {"sum": 8.0, "count": 10},
+        "unrelated": {"sum": 99.0, "count": 1},
+    }}, model)
+    assert model.occupancy.mean == pytest.approx(0.8)
+    assert model.occupancy.n == 10
+
+
+# ---------------------------------------------------------------------------
+# the analytic pipeline model
+# ---------------------------------------------------------------------------
+
+def _model(powmod=100.0, fixed=400.0, sigma=0.125, rpc_s=0.001):
+    m = CostModel(platform="test")
+    m.powmod_per_s["cios"] = Estimate(powmod, 0.1, 3)
+    m.fixed_per_s["cios"] = Estimate(fixed, 0.1, 3)
+    m.serial_fraction = Estimate(sigma, 0.05, 2)
+    m.rpc_per_ballot_s = Estimate(rpc_s)
+    m.occupancy = Estimate(1.0, 0.0, 1)
+    return m
+
+
+def test_predict_composes_phases_and_names_bottleneck():
+    m = _model()
+    p = capacity.predict(m, Plan(ballots=1000, chips=1, mix_stages=2,
+                                 backend="cios"))
+    by_name = {ph.name: ph for ph in p.phases}
+    assert set(by_name) == {"serve-encrypt", "mix×2", "decrypt",
+                            "verify-batch"}
+    assert by_name["serve-encrypt"].seconds.mean == pytest.approx(
+        1000 * ROWS_PER_BALLOT["encrypt"] / 400.0)
+    assert by_name["mix×2"].seconds.mean == pytest.approx(
+        1000 * ROWS_PER_BALLOT["mix_stage"] * 2 / 100.0)
+    assert p.bottleneck == "mix×2"
+    assert p.total.mean == pytest.approx(
+        sum(ph.seconds.mean for ph in p.phases))
+    # knee: efficiency crosses 50% at 1 + 1/σ workers
+    assert p.knee_workers == 9
+    # doubling chips halves every device phase
+    p2 = capacity.predict(m, Plan(ballots=1000, chips=2, mix_stages=2,
+                                  backend="cios"))
+    assert p2.total.mean == pytest.approx(p.total.mean / 2)
+
+
+def test_predict_serving_floor_binds_with_few_workers():
+    # 1 worker at 1ms/ballot = 10s for 10k ballots >> device encrypt
+    m = _model()
+    p = capacity.predict(m, Plan(ballots=10_000, workers=1, chips=64,
+                                 backend="cios"))
+    enc = p.phases[0]
+    assert enc.limiter == "rpc"
+    assert enc.seconds.mean == pytest.approx(10.0)
+    # unlimited workers (workers=0): the device side binds again
+    p = capacity.predict(m, Plan(ballots=10_000, workers=0, chips=64,
+                                 backend="cios"))
+    assert p.phases[0].limiter == "device"
+
+
+def test_predict_verify_modes_and_live_residual():
+    m = _model()
+    naive = capacity.predict(m, Plan(ballots=1000, batch_verify=False))
+    batch = capacity.predict(m, Plan(ballots=1000))
+    live = capacity.predict(m, Plan(ballots=1000, live_verify=True))
+    ratio = ROWS_PER_BALLOT["verify"] / ROWS_PER_BALLOT["verify_batch"]
+    assert naive.phases[-1].seconds.mean == pytest.approx(
+        batch.phases[-1].seconds.mean * ratio)
+    assert live.phases[-1].name == "verify-batch-residual"
+    assert live.phases[-1].seconds.mean == pytest.approx(
+        batch.phases[-1].seconds.mean * capacity.LIVE_RESIDUAL_FRACTION)
+    with pytest.raises(ValueError):
+        capacity.predict(m, Plan(backend="missing"))
+
+
+def test_chips_for_deadline_inverts_predict():
+    m = _model()
+    row = capacity.chips_for_deadline(m, ballots=1_000_000,
+                                      deadline_s=60.0, backend="cios")
+    chips = row["chips"]
+    assert chips and chips > 1
+    # minimality: meets the deadline at chips, misses at chips-1
+    assert capacity.predict(
+        m, Plan(ballots=1_000_000, chips=chips)).total.mean <= 60.0
+    assert capacity.predict(
+        m, Plan(ballots=1_000_000, chips=chips - 1)).total.mean > 60.0
+    # bands order: optimistic needs fewer chips, pessimistic more
+    assert row["chips_lo"] <= chips <= row["chips_hi"]
+    assert row["bottleneck"] and row["total_s"]["mean"] <= 60.0
+    # an already-met deadline answers 1 chip
+    easy = capacity.chips_for_deadline(m, ballots=10, deadline_s=60.0,
+                                       backend="cios")
+    assert easy["chips"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the validation gate
+# ---------------------------------------------------------------------------
+
+def test_validate_fabric_holdout_on_exact_curve(tmp_path):
+    path = str(tmp_path / "SCALE.json")
+    with open(path, "w") as f:
+        json.dump([{"phase": "fabric",
+                    "curve": _amdahl_curve(15.0, 0.125, (1, 2, 4, 8))}],
+                  f)
+    out = capacity.validate_fabric(scale_path=path, tol=0.25)
+    assert out["workers"] == 8            # the held-out point
+    assert out["err_pct"] == pytest.approx(0.0, abs=0.1)
+    assert out["pass"]
+    # no usable curve -> skipped, not failed
+    with open(path, "w") as f:
+        json.dump([{"phase": "fabric", "curve": _amdahl_curve(
+            15.0, 0.125, (1, 2))}], f)
+    assert "skipped" in capacity.validate_fabric(scale_path=path, tol=0.25)
+
+
+class _FakeRunner:
+    """Deterministic election stand-in: linear per-phase cost plus a
+    one-off jitter spike on each first timed repetition — exactly the
+    noise shape the min-of-3 estimator must reject."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, n, tag):
+        self.calls.append((n, tag))
+        phases = {"encrypt": 0.2 + 0.004 * n,
+                  "tally": 0.01 + 0.0001 * n,
+                  "verify": 0.5 + 0.008 * n}
+        if tag.endswith("-0"):            # first timed rep of each set
+            phases = {k: v + 1.7 for k, v in phases.items()}  # jitter
+        return {"nballots": n, "phases": phases,
+                "wall_s": sum(phases.values())}
+
+
+def test_validate_e2e_fake_runner_interpolates_exactly():
+    runner = _FakeRunner()
+    out = capacity.validate_e2e(runner=runner, sizes=(128, 512, 384),
+                                tol=0.25)
+    # warm passes ran at EVERY measured size before any timing
+    warm = [c for c in runner.calls if c[1] == "warm"]
+    assert [n for n, _ in warm] == [128, 384, 512]
+    assert runner.calls[0][1] == "warm"
+    # a linear cost interpolates with zero error despite the jitter
+    # spikes (min-of-3 discards them)
+    assert out["err_pct"] == pytest.approx(0.0, abs=0.01)
+    assert out["pass"] and out["sizes"] == [128, 512, 384]
+    assert out["fitted"]["verify"]["per_ballot_s"] == pytest.approx(0.008)
+    assert out["fitted"]["verify"]["fixed_s"] == pytest.approx(0.5)
+
+
+def test_validate_e2e_rejects_equal_calibration_sizes():
+    with pytest.raises(ValueError):
+        capacity.validate_e2e(runner=_FakeRunner(), sizes=(128, 128, 64))
+
+
+def test_validate_aggregates_both_configs(tmp_path):
+    path = str(tmp_path / "SCALE.json")
+    with open(path, "w") as f:
+        json.dump([{"phase": "fabric",
+                    "curve": _amdahl_curve(15.0, 0.125, (1, 2, 4, 8))}],
+                  f)
+    out = capacity.validate(runner=_FakeRunner(), scale_path=path,
+                            tol=0.25)
+    assert out["n_checked"] == 2 and out["pass"]
+    assert out["max_err_pct"] is not None
+    assert {c["name"] for c in out["configs"]} == \
+        {"scale-fabric-holdout", "e2e-traced-election"}
+    # a measured point drifting off the law flips the verdict (without
+    # raising): the held-out 8-worker rate comes in 10% low
+    curve = _amdahl_curve(15.0, 0.125, (1, 2, 4, 8))
+    curve[-1]["ballots_per_s"] *= 0.9
+    with open(path, "w") as f:
+        json.dump([{"phase": "fabric", "curve": curve}], f)
+    drifted = capacity.validate(runner=_FakeRunner(), scale_path=path,
+                                tol=0.05)
+    assert not drifted["pass"]
+    assert drifted["max_err_pct"] > 5.0
+
+
+# ---------------------------------------------------------------------------
+# flight-report integration
+# ---------------------------------------------------------------------------
+
+class _FakeAnalysis:
+    def __init__(self, buckets):
+        self.buckets = buckets
+
+
+def test_phase_comparison_against_tracked_prediction(tmp_path):
+    m = _model()
+    pred = capacity.predict(m, Plan(ballots=1000, mix_stages=1))
+    doc = {"predictions": [pred.to_json()],
+           "validation": {"max_err_pct": 3.0, "n_checked": 2,
+                          "tolerance_pct": 25.0, "pass": True}}
+    path = str(tmp_path / "CAPACITY.json")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    a = _FakeAnalysis({("phase.encrypt", "drv", "device"): 600,
+                       ("phase.mix", "drv", "device"): 200,
+                       ("phase.verify", "drv", "device"): 200})
+    cmp_rows = capacity.phase_comparison(a, capacity_path=path)
+    rows = {r["phase"]: r for r in cmp_rows["rows"]}
+    assert rows["serve-encrypt"]["actual_share"] == pytest.approx(0.6)
+    assert set(rows) == {"serve-encrypt", "mix×1", "decrypt",
+                         "verify-batch"}
+    for r in rows.values():
+        assert r["delta_pp"] == pytest.approx(
+            (r["actual_share"] - r["predicted_share"]) * 100, abs=0.1)
+    assert cmp_rows["validation"]["pass"]
+    # either side missing -> None, never a crash
+    assert capacity.phase_comparison(
+        a, capacity_path=str(tmp_path / "nope.json")) is None
+    assert capacity.phase_comparison(
+        _FakeAnalysis({}), capacity_path=path) is None
+
+
+def test_egplan_renders_capacity_markdown(tmp_path):
+    """The egplan renderer turns a fitted-doc into the tracked
+    CAPACITY.md shape: headline band table, fitted terms, what-if grid,
+    validation verdict."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "egplan", os.path.join(os.path.dirname(__file__), os.pardir,
+                               "tools", "egplan.py"))
+    egplan = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(egplan)
+
+    m = _model()
+    headline = [capacity.chips_for_deadline(m, 1_000_000, 60.0, "cios")]
+    pred = capacity.predict(m, Plan(ballots=1_000_000, chips=8))
+    doc = {"ballots": 1_000_000, "deadline_s": 60.0,
+           "model": m.to_json(), "headline": headline,
+           "predictions": [pred.to_json()],
+           "validation": {"tolerance_pct": 25.0, "pass": True,
+                          "max_err_pct": 2.6, "n_checked": 1,
+                          "configs": [{
+                              "name": "scale-fabric-holdout",
+                              "workers": 4,
+                              "predicted_ballots_per_s": 42.0,
+                              "measured_ballots_per_s": 41.0,
+                              "err_pct": 2.6, "pass": True}]}}
+    md = egplan.render_markdown(doc)
+    assert "# Capacity plan" in md
+    assert "chips for a 10^6-ballot election under 60 s" in md
+    assert f"{headline[0]['chips']:,}" in md
+    assert "## Validation (predicted vs measured)" in md
+    assert "**PASS**" in md and "2.6%" in md

@@ -40,6 +40,8 @@ def test_walk_covers_new_packages_and_obs_modules():
     assert {"verify/live/__init__.py", "verify/live/verifier.py",
             "verify/live/commitment.py", "verify/live/board.py",
             "publish/framing.py"} <= rels
+    # the capacity-planning plane (cost models + predicted-vs-actual)
+    assert "obs/capacity.py" in rels
 
 
 def test_no_bare_print_in_library_code():

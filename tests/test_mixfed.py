@@ -28,7 +28,7 @@ from electionguard_tpu.crypto.elgamal import ElGamalKeypair, elgamal_encrypt
 from electionguard_tpu.mixfed import (MixCoordinator, MixFedError,
                                       MixServerServer)
 from electionguard_tpu.mixnet.verify_mix import verify_stages
-from electionguard_tpu.obs import REGISTRY
+from electionguard_tpu.obs import REGISTRY, election_labels
 from electionguard_tpu.publish import pb, serialize
 from electionguard_tpu.publish.publisher import Consumer
 from electionguard_tpu.remote import rpc_util
@@ -180,7 +180,8 @@ def test_tampering_server_requeued_on_spare(tmp_path, mixkey):
     K, qbar = mixkey.public_key, g.int_to_q(424242)
     pads, datas = _encrypt_rows(g, K, 6, 1)
     coord = MixCoordinator(g, str(tmp_path), port=0)
-    bad_counter = REGISTRY.counter("mixfed_bad_proofs_total")
+    bad_counter = REGISTRY.counter("mixfed_bad_proofs_total",
+                                   election_labels())
     before = bad_counter.value
     # the tamperer registers FIRST, so stage 0 is assigned to it
     cheat = MixServerServer(g, coord.url, "cheat", tamper=True)
@@ -233,7 +234,8 @@ def test_crash_mid_stage_requeues_on_spare(tmp_path, mixkey, fastrpc):
     plan.crash_cb = lambda _m: threading.Timer(
         0.05, lambda: victim["server"].server.stop(grace=0)).start()
     faults.install(plan)
-    requeue = REGISTRY.counter("mixfed_stage_requeues_total")
+    requeue = REGISTRY.counter("mixfed_stage_requeues_total",
+                               election_labels())
     before = requeue.value
     coord = MixCoordinator(g, str(tmp_path), port=0)
     servers = [MixServerServer(g, coord.url, f"mix{i}") for i in range(3)]

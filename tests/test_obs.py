@@ -85,6 +85,54 @@ def test_prometheus_text_format():
     assert "egtpu_lat_ms_count 1" in text
 
 
+def test_label_value_escaping_round_trips_through_flat_name():
+    """Satellite: flat_name escapes backslash/quote/newline per the
+    Prometheus text format, and slo.parse_labels inverts it exactly —
+    including values containing ``,`` and ``=`` that the old naive
+    splitter mangled."""
+    from electionguard_tpu.obs import slo as slo_mod
+    nasty = 'pre"cinct\\7\n, ward="N"'
+    flat = reg.flat_name("ballots_total",
+                         {"election": nasty, "shard": "3"})
+    assert "\n" not in flat               # exposition stays line-based
+    name, labels = slo_mod.parse_labels(flat)
+    assert name == "ballots_total"
+    assert labels == {"election": nasty, "shard": "3"}
+    # and the registry get-or-create keyed on the flat name agrees
+    r = reg.MetricsRegistry()
+    c = r.counter("ballots_total", {"election": nasty})
+    c.inc(2)
+    snap = r.snapshot()
+    [(k, v)] = snap["counters"].items()
+    assert slo_mod.parse_labels(k)[1]["election"] == nasty and v == 2
+
+
+def test_http_scrape_parse_round_trip_with_hostile_labels():
+    """Satellite: a counter whose label value holds quotes, backslashes
+    and newlines survives a REAL http scrape — correct versioned
+    Content-Type, one line per series, and the line parses back to the
+    original value."""
+    from electionguard_tpu.obs import slo as slo_mod
+    hostile = 'a"b\\c\nd'
+    reg.REGISTRY.counter("obs_hostile_total",
+                         {"election": hostile}).inc(5)
+    server, port = httpd.start(0)
+    try:
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10)
+        assert resp.headers["Content-Type"] == \
+            "text/plain; version=0.0.4; charset=utf-8"
+        text = resp.read().decode()
+    finally:
+        server.shutdown()
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("egtpu_obs_hostile_total{")][0]
+    series, value = line.rsplit(" ", 1)
+    assert int(value) == 5
+    _, labels = slo_mod.parse_labels(series[len("egtpu_"):])
+    assert labels["election"] == hostile
+
+
 def test_http_endpoint_scrape():
     marker = reg.REGISTRY.counter("obs_test_scrape_total")
     marker.inc(11)
@@ -388,6 +436,84 @@ def test_collector_persists_heartbeat_stream(tmp_path):
     hbs = analyze.load_heartbeats(os.path.join(str(tmp_path), "recv"))
     assert len(hbs) == 2
     assert hbs[0]["phase"].startswith("serving shard=3")
+
+
+def test_retain_spec_parsing():
+    """EGTPU_OBS_RETAIN grammar: SIZE[,AGE] with KB/MB/GB and s/m/h/d
+    suffixes; either half may be empty; junk raises."""
+    import pytest
+
+    from electionguard_tpu.obs import collector as coll
+    assert coll.parse_retain("") == (None, None)
+    assert coll.parse_retain("256MB,24h") == (256 * 1024 ** 2, 86400.0)
+    assert coll.parse_retain("4kb") == (4096, None)
+    assert coll.parse_retain("1000") == (1000, None)
+    assert coll.parse_retain(",30m") == (None, 1800.0)
+    assert coll.parse_retain("1.5GB,90s") == \
+        (int(1.5 * 1024 ** 3), 90.0)
+    for bad in ("24h", "1MB,fast", "1MB,2h,3d", "lots"):
+        with pytest.raises(ValueError):
+            coll.parse_retain(bad)
+
+
+def test_collector_retention_rotates_oldest_first(tmp_path, monkeypatch):
+    """Satellite: with EGTPU_OBS_RETAIN set, the eval-loop retention
+    pass deletes receive-dir files past the age cap, then oldest-first
+    until under the size cap — counting each in
+    obs_rotated_files_total — and an evicted stream reappears on its
+    next append."""
+    from electionguard_tpu.obs import collector as coll
+    from electionguard_tpu.obs import registry
+
+    monkeypatch.setenv("EGTPU_OBS_RETAIN", "150,1h")
+    c = coll.ObsCollector(str(tmp_path), slo_config=_quiet_slo())
+    assert (c.retain_bytes, c.retain_age_s) == (150, 3600.0)
+    span = json.dumps({"name": "s", "t0": 0, "dur": 1})
+    for pid in (1, 2, 3):
+        c.push_telemetry(_batch("simworker", pid, span_lines=[span] * 2))
+    recv = os.path.join(str(tmp_path), "recv")
+    now = 1_000_000.0
+    # pid 1 far past the age cap, pid 2 inside it but oldest under the
+    # size cap, pid 3 fresh; heartbeats.jsonl fresh too
+    os.utime(os.path.join(recv, "spans-simworker-1.jsonl"),
+             (now - 7200, now - 7200))
+    os.utime(os.path.join(recv, "spans-simworker-2.jsonl"),
+             (now - 60, now - 60))
+    for name in ("spans-simworker-3.jsonl", "heartbeats.jsonl"):
+        os.utime(os.path.join(recv, name), (now, now))
+    # size the cap so exactly the two fresh files fit under it
+    c.retain_bytes = (
+        os.path.getsize(os.path.join(recv, "spans-simworker-3.jsonl"))
+        + os.path.getsize(os.path.join(recv, "heartbeats.jsonl")))
+    before = registry.REGISTRY.counter("obs_rotated_files_total").value
+
+    rotated = c._enforce_retention(now=now)
+
+    assert rotated == 2
+    left = sorted(os.listdir(recv))
+    assert "spans-simworker-1.jsonl" not in left      # age-capped
+    assert "spans-simworker-2.jsonl" not in left      # size cap, oldest
+    assert "spans-simworker-3.jsonl" in left
+    assert "heartbeats.jsonl" in left
+    assert registry.REGISTRY.counter(
+        "obs_rotated_files_total").value == before + 2
+    # nothing over cap now: a second pass is a no-op
+    assert c._enforce_retention(now=now) == 0
+    # the evicted stream comes back on the next push
+    c.push_telemetry(_batch("simworker", 1, seq=2, span_lines=[span]))
+    assert os.path.exists(os.path.join(recv, "spans-simworker-1.jsonl"))
+
+
+def test_collector_retention_disabled_by_default(tmp_path):
+    """No EGTPU_OBS_RETAIN -> retention is a no-op (unbounded)."""
+    from electionguard_tpu.obs import collector as coll
+    c = coll.ObsCollector(str(tmp_path), slo_config=_quiet_slo())
+    assert (c.retain_bytes, c.retain_age_s) == (None, None)
+    c.push_telemetry(_batch("simworker", 5, span_lines=[
+        json.dumps({"name": "s", "t0": 0, "dur": 1})]))
+    assert c._enforce_retention(now=1e12) == 0
+    assert os.path.exists(os.path.join(
+        str(tmp_path), "recv", "spans-simworker-5.jsonl"))
 
 
 def test_collector_heartbeat_death_red_window_and_recovery(tmp_path,

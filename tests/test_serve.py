@@ -394,8 +394,10 @@ def test_loadgen_smoke_occupancy_and_compile_stability(
             f"http://127.0.0.1:{svc.metrics_http_port}/metrics",
             timeout=10).read().decode()
         assert "# TYPE egtpu_ballots_encrypted counter" in text
+        # ballot-flow counters carry the per-tenant election label
         enc_line = [ln for ln in text.splitlines()
-                    if ln.startswith("egtpu_ballots_encrypted ")][0]
+                    if ln.startswith(
+                        'egtpu_ballots_encrypted{election="default"} ')][0]
         assert int(enc_line.split()[-1]) >= 16
         assert "egtpu_rpc_server_calls_total" in text
         assert "egtpu_request_latency_ms_bucket" in text

@@ -162,6 +162,35 @@ def test_open_root_no_critical_path_but_no_crash(tmp_path):
     assert "Critical path unavailable" in report
 
 
+def test_empty_trace_dir_degrades_with_warning(tmp_path):
+    """A dir with no span files at all (a run killed before its first
+    export, or a wrong -trace path) analyzes to an empty partial
+    report with a warning — and still renders as a flight report."""
+    d = str(tmp_path / "empty")
+    os.makedirs(d)
+    a = analyze.analyze(d)
+    assert a.spans == [] and a.path == [] and a.buckets == {}
+    assert any("no spans" in w for w in a.warnings)
+    report = flight.render(a)
+    assert "partial report" in report
+    assert "Critical path unavailable" in report
+
+
+def test_heartbeatless_trace_dir_degrades(tmp_path):
+    """Spans but no heartbeats.jsonl (a file-export run that never went
+    through a collector): analytics that need heartbeats degrade —
+    queue stats empty, SLO verdict says so — without warnings-spam or
+    a crash."""
+    d = str(tmp_path / "trace")
+    _write(d, _workflow_spans())
+    assert not os.path.exists(os.path.join(d, "heartbeats.jsonl"))
+    a = analyze.analyze(d)
+    assert a.queue_max == {}
+    assert a.path                        # span analytics fully intact
+    report = flight.render(a)
+    assert "queue depth: no heartbeat data" in report
+
+
 def test_clock_skewed_child_is_clipped_not_fatal(tmp_path):
     d = str(tmp_path / "trace")
     spans = [
@@ -410,3 +439,27 @@ def test_egtop_critical_path_pane(tmp_path):
     # a trace with no closed root degrades to a notice, never a crash
     assert "unavailable" in egtop.render_critical_path(
         str(tmp_path / "missing"))
+
+
+def test_egtop_capacity_pane(tmp_path):
+    egtop = _tool("egtop")
+    doc = {"ballots": 1_000_000, "deadline_s": 60.0,
+           "model": {"platform": "cpu"},
+           "headline": [
+               {"backend": "cios", "chips": 9781, "chips_lo": 8192,
+                "chips_hi": 11369, "bottleneck": "verify-batch"},
+               {"backend": "bad", "chips": None, "chips_lo": None,
+                "chips_hi": None, "bottleneck": None}],
+           "validation": {"max_err_pct": 14.4, "n_checked": 2,
+                          "pass": True}}
+    p = str(tmp_path / "CAPACITY.json")
+    with open(p, "w") as f:
+        json.dump(doc, f)
+    pane = egtop.render_capacity(p)
+    assert "1,000,000 ballots < 60s" in pane
+    assert "9,781" in pane and "verify-batch" in pane
+    assert "unreachable" in pane          # no-roofline backend row
+    assert "max err 14.4% over 2 config(s) (PASS)" in pane
+    # a missing file degrades to a notice, never a crash
+    assert "unavailable" in egtop.render_capacity(
+        str(tmp_path / "nope.json"))

@@ -11,21 +11,31 @@ re-analyzes the span dir (the collector's receive dir, or the run's
 wall-clock is actually waiting on — the live version of the flight
 report's first table.
 
+With ``-capacity [path]`` the board gains a capacity pane from the
+tracked CAPACITY.json (tools/egplan.py): headline chips-for-deadline
+per backend plus the last predicted-vs-measured validation verdict.
+
 Usage::
 
     python tools/egtop.py -collector localhost:17171
     python tools/egtop.py -collector localhost:17171 -once   # one frame
     python tools/egtop.py -collector localhost:17171 -trace /tmp/eg/obs/recv
+    python tools/egtop.py -collector localhost:17171 -capacity
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_DEFAULT_CAPACITY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "CAPACITY.json")
 
 _STATE_GLYPH = {"ALIVE": "✓", "EXITED": "-", "DEAD": "✗"}
 _COLORS = {"green": "\x1b[32m", "red": "\x1b[31m"}
@@ -126,6 +136,37 @@ def render_critical_path(trace_dir: str, rows: int = 5) -> str:
     return "\n".join(lines)
 
 
+def render_capacity(capacity_path: str) -> str:
+    """Capacity pane: headline chips-for-deadline per backend and the
+    last validation verdict from the tracked CAPACITY.json
+    (``tools/egplan.py``).  A missing or damaged file degrades to a
+    one-line notice, never breaks the board."""
+    try:
+        with open(capacity_path) as f:
+            doc = json.load(f)
+        headline = doc["headline"]
+    except Exception as e:  # noqa: BLE001 — the pane must never kill the board
+        return f"capacity plan unavailable: {e}"
+    lines = [f"capacity plan  {doc.get('ballots', 0):,} ballots "
+             f"< {doc.get('deadline_s', 0):.0f}s  "
+             f"[{doc.get('model', {}).get('platform', '?')}]"]
+    for row in headline:
+        if row.get("chips") is None:
+            lines.append(f"  {row['backend']:<8} unreachable")
+            continue
+        lo, hi = row.get("chips_hi"), row.get("chips_lo")
+        band = f"  [{min(lo, hi):,}–{max(lo, hi):,}]" if lo and hi else ""
+        lines.append(f"  {row['backend']:<8}{row['chips']:>10,} chip(s)"
+                     f"{band}  bottleneck: {row.get('bottleneck', '-')}")
+    val = doc.get("validation")
+    if val and val.get("max_err_pct") is not None:
+        lines.append(f"  model vs measured: max err "
+                     f"{val['max_err_pct']:.1f}% over "
+                     f"{val.get('n_checked', 0)} config(s) "
+                     f"({'PASS' if val.get('pass') else 'FAIL'})")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser("egtop")
     ap.add_argument("-collector", required=True,
@@ -139,6 +180,10 @@ def main(argv=None) -> int:
                     help="span dir to analyze per frame (collector recv "
                          "dir or EGTPU_OBS_TRACE): adds a critical-path "
                          "pane under the fleet board")
+    ap.add_argument("-capacity", dest="capacity_path", default=None,
+                    nargs="?", const=_DEFAULT_CAPACITY,
+                    help="CAPACITY.json to render as a capacity pane "
+                         "(bare flag = the repo's tracked copy)")
     args = ap.parse_args(argv)
 
     from electionguard_tpu.publish import pb
@@ -157,6 +202,8 @@ def main(argv=None) -> int:
             frame = render(status, color=color)
         if args.trace_dir:
             frame += "\n" + render_critical_path(args.trace_dir)
+        if args.capacity_path:
+            frame += "\n" + render_capacity(args.capacity_path)
         if args.once:
             print(frame)
             return 0 if status is not None else 1
