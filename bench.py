@@ -671,6 +671,19 @@ def run_workload(nballots: int, n_chips: int) -> None:
         RESULT["capacity_error"] = f"{type(e).__name__}: {e}"
     flush_partial()
 
+    # ---- simscale phase: virtual-election playout rate ------------------
+    # a million-ballot virtual election (sim/election) at a reduced
+    # event rate: how many SIMULATED ballots the process-model layer
+    # plays out per real second.  Guards the sim layer's own speed (a
+    # scheduler or devicemodel regression shows up here, not in any
+    # crypto metric).  Best-effort like the planes above.
+    try:
+        _bench_simscale()
+    except Exception as e:  # noqa: BLE001 — diagnostics
+        note(f"simscale phase failed: {type(e).__name__}: {e}")
+        RESULT["simscale_error"] = f"{type(e).__name__}: {e}"
+    flush_partial()
+
     import jax
     if jax.devices()[0].platform != "cpu":
         # the NTT-vs-CIOS shootout only means something on the chip; on
@@ -709,6 +722,36 @@ def _bench_capacity() -> None:
     RESULT["phases_done"] = RESULT.get("phases_done", "") + " capacity"
     note(f"capacity model err {v['max_err_pct']}% over {len(checked)} "
          f"measured config(s) ({'PASS' if v['pass'] else 'FAIL'})")
+
+
+def _bench_simscale() -> None:
+    """Virtual-election playout rate: one chaos-enabled 10^6-ballot
+    election on the virtual clock at a reduced event rate (4 quarter-
+    million micro-batches, 4 representative ballots per shape), timed
+    end-to-end in real seconds.  ``sim_ballots_per_s`` carries a
+    bench_diff band so a slowdown in the scheduler, procmodel, or
+    devicemodel layers gates like any perf regression; the trace hash
+    rides along so a rerun's bit-for-bit claim is checkable from
+    BENCH.json alone."""
+    from electionguard_tpu.sim.election import (ElectionSpec,
+                                                run_virtual_election)
+
+    spec = ElectionSpec(ballots=1_000_000, batch=250_000,
+                        rep_ballots=4, workers=2, chips=8,
+                        chaos_after_batches=2)
+    rep = run_virtual_election(seed=3, spec=spec, chaos=True)
+    if not rep.ok:
+        raise RuntimeError(f"virtual election oracles: {rep.violations}")
+    RESULT.update(
+        sim_ballots_per_s=round(rep.ballots / max(rep.wall_s, 1e-9), 1),
+        sim_virtual_s=round(rep.virtual_s, 1),
+        sim_trace_hash=rep.trace_hash,
+        sim_events=rep.events,
+    )
+    RESULT["phases_done"] = RESULT.get("phases_done", "") + " simscale"
+    note(f"simscale: {RESULT['sim_ballots_per_s']:.0f} simulated "
+         f"ballots/s ({rep.events} events in {rep.wall_s:.1f}s real, "
+         f"{rep.virtual_s:.0f}s virtual)")
 
 
 def _bench_live(nballots: int = 64, chunk: int = 8) -> None:

@@ -69,6 +69,30 @@ def pad_rows(arr, nb: int, fill_one: bool = False):
     return jnp.concatenate([arr, pad], axis=0)
 
 
+def pad_rows_np(arr: np.ndarray, nb: int,
+                fill_one: bool = False) -> np.ndarray:
+    """``pad_rows`` on the host: identical row semantics, numpy ops."""
+    b = arr.shape[0]
+    if nb == b:
+        return arr
+    pad = np.zeros((nb - b,) + arr.shape[1:], dtype=arr.dtype)
+    if fill_one:
+        pad[:, 0] = 1
+    return np.concatenate([arr, pad], axis=0)
+
+
+def _host_pad() -> bool:
+    """Host-side padding fast path (EGTPU_DISPATCH_HOST_PAD, default
+    on): when every input is already a host array, bucket-pad in numpy
+    (microseconds) and let the jitted program's own argument transfer
+    move the rows, instead of dispatching zeros/scatter/concatenate as
+    eager device ops before every call.  On small batches that eager
+    glue costs ~5x the jitted dispatch itself and was the seeds/s
+    ceiling of the sim sweeps (tools/sim_matrix reports the
+    before/after)."""
+    return knobs.get_str("EGTPU_DISPATCH_HOST_PAD") != "0"
+
+
 def run_tiled(jfn, arrays, fills, cap: int | None = None):
     """THE dispatch policy, shared by every batch plane (group ops,
     exponent ops, device SHA-256): dispatch ``jfn(*arrays)`` over
@@ -77,14 +101,17 @@ def run_tiled(jfn, arrays, fills, cap: int | None = None):
     cap) — so any workload size compiles the same bounded set of
     programs.  ``fills[i]`` selects 1-rows (True) or 0-rows (False) as
     the i-th array's padding."""
-    arrays = [jnp.asarray(a) for a in arrays]
-    n = arrays[0].shape[0]
     cap = cap or _dispatch_tile()
+    host = _host_pad() and all(isinstance(a, np.ndarray) for a in arrays)
+    if not host:
+        arrays = [jnp.asarray(a) for a in arrays]
+    pad = pad_rows_np if host else pad_rows
+    n = arrays[0].shape[0]
 
     def one(tiles, nb):
         m = tiles[0].shape[0]
-        return jfn(*[pad_rows(a, nb, f)
-                     for a, f in zip(tiles, fills)])[:m]
+        out = jfn(*[pad(a, nb, f) for a, f in zip(tiles, fills)])
+        return out if m == nb else out[:m]
 
     if n <= cap:
         return one(arrays, dispatch_bucket(n, cap))
@@ -98,14 +125,17 @@ def run_tiled_multi(jfn, arrays, fills, cap: int | None = None):
     (fused pipelines that keep many products of one dispatch).  Same
     bounded-shape bucketing; each output is sliced back to the tile's
     true row count and concatenated across tiles."""
-    arrays = [jnp.asarray(a) for a in arrays]
-    n = arrays[0].shape[0]
     cap = cap or _dispatch_tile()
+    host = _host_pad() and all(isinstance(a, np.ndarray) for a in arrays)
+    if not host:
+        arrays = [jnp.asarray(a) for a in arrays]
+    pad = pad_rows_np if host else pad_rows
+    n = arrays[0].shape[0]
 
     def one(tiles, nb):
         m = tiles[0].shape[0]
-        out = jfn(*[pad_rows(a, nb, f) for a, f in zip(tiles, fills)])
-        return [o[:m] for o in out]
+        out = jfn(*[pad(a, nb, f) for a, f in zip(tiles, fills)])
+        return list(out) if m == nb else [o[:m] for o in out]
 
     if n <= cap:
         return one(arrays, dispatch_bucket(n, cap))

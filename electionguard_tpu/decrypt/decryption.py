@@ -43,6 +43,7 @@ from electionguard_tpu.keyceremony.interface import Result
 from electionguard_tpu.keyceremony.trustee import commitment_product
 from electionguard_tpu.publish.election_record import (DecryptingGuardian,
                                                        ElectionInitialized)
+from electionguard_tpu.utils import devicetime
 
 
 def lagrange_coefficient(group: GroupContext, xs: Sequence[int],
@@ -324,6 +325,7 @@ class Decryption:
         by GROUP POSITION, not id, so duplicated ballot ids in a tampered
         record decrypt independently instead of silently sharing one
         result."""
+        devicetime.charge("decrypt", len(groups))
         texts, keys = [], []
         for gi, (_, contests) in enumerate(groups):
             for c in contests:

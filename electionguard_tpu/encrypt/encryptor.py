@@ -43,7 +43,7 @@ from electionguard_tpu.crypto.chaum_pedersen import (
     ConstantChaumPedersenProof, DisjunctiveChaumPedersenProof)
 from electionguard_tpu.crypto.elgamal import ElGamalCiphertext
 from electionguard_tpu.publish.election_record import ElectionInitialized
-from electionguard_tpu.utils import clock, knobs
+from electionguard_tpu.utils import clock, devicetime, knobs
 
 
 @dataclass
@@ -92,6 +92,7 @@ class BatchEncryptor:
             timestamp: Optional[int] = None,
     ) -> tuple[list[EncryptedBallot], list[tuple[PlaintextBallot, str]]]:
         from electionguard_tpu.obs import trace
+        devicetime.charge("encrypt", len(ballots))
         attrs = {"n": len(ballots)} if trace.enabled() else None
         with trace.span("encrypt.batch", attrs):
             return self._encrypt_ballots(

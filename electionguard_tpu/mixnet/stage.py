@@ -20,6 +20,7 @@ from electionguard_tpu.core.group import GroupContext
 from electionguard_tpu.mixnet.proof import MixProof, prove_shuffle, \
     rows_digest
 from electionguard_tpu.mixnet.shuffle import Shuffler, get_shuffler
+from electionguard_tpu.utils import devicetime
 
 
 @dataclass
@@ -64,6 +65,7 @@ def run_stage(group: GroupContext, public_key: int, qbar,
     test-only injection point for adversarial permutations."""
     if not in_pads:
         raise ValueError("mix stage needs at least one input row")
+    devicetime.charge("mix_stage", len(in_pads))
     seed = seed if seed is not None else secrets.token_bytes(32)
     sh = shuffler if shuffler is not None else get_shuffler(group,
                                                             public_key)

@@ -682,15 +682,60 @@ def validate_e2e(runner: Callable[[int, str], dict] = measure_traced_run,
             "pass": err_pct <= tol * 100.0}
 
 
+def validate_sim_election(tol: Optional[float] = None,
+                          seed: int = 7) -> dict:
+    """Predicted-vs-PLAYED-OUT: run the process-model virtual election
+    (``sim/election``, chaos on — a mid-election worker SIGKILL/restart
+    included) and gate its phase timeline against ``predict`` for the
+    same plan.  Both sides share the fitted per-op rates, so the error
+    measures the *composition* — shared-device queueing, micro-batch
+    rounding, Amdahl'd worker drain, residual verification overlap —
+    against the closed form."""
+    tol = tolerance() if tol is None else tol
+    out: dict = {"name": "sim-election"}
+    try:
+        from electionguard_tpu.sim import election
+        model = fit()
+        spec = election.ElectionSpec.from_knobs()
+        rep = election.run_virtual_election(seed=seed, spec=spec,
+                                            model=model, chaos=True)
+        pred = predict(model, spec.plan())
+    except Exception as e:  # noqa: BLE001 — gate degrades, never raises
+        out["skipped"] = f"virtual election failed ({e})"
+        return out
+    sim_s = rep.modeled_total_s()
+    err_pct = (abs(pred.total.mean - sim_s) / max(sim_s, 1e-9)) * 100.0
+    out.update({
+        "ballots": spec.ballots, "chaos": rep.chaos,
+        "oracles_ok": rep.ok, "violations": list(rep.violations),
+        "simulated_s": round(sim_s, 3),
+        "predicted_s": round(pred.total.mean, 3),
+        "err_pct": round(err_pct, 2),
+        "phases": {k: round(v, 3)
+                   for k, v in rep.phase_seconds().items()},
+        "predicted_phases": {p.name: round(p.seconds.mean, 3)
+                             for p in pred.phases},
+        "trace_hash": rep.trace_hash,
+        "events": rep.events,
+        "wall_s": round(rep.wall_s, 3),
+        "pass": rep.ok and err_pct <= tol * 100.0})
+    return out
+
+
 def validate(runner: Callable[[int, str], dict] = measure_traced_run,
              scale_path: Optional[str] = None,
-             tol: Optional[float] = None) -> dict:
+             tol: Optional[float] = None,
+             sim: bool = False) -> dict:
     """The full predicted-vs-actual gate: both measured configurations
     (the traced e2e election and the SCALE.json fabric point) must
-    reproduce within the tolerance band."""
+    reproduce within the tolerance band.  With ``sim=True`` the
+    played-out virtual election (``validate_sim_election``) joins the
+    gate as a third config."""
     tol = tolerance() if tol is None else tol
     configs = [validate_fabric(scale_path, tol), validate_e2e(runner,
                                                               tol=tol)]
+    if sim:
+        configs.append(validate_sim_election(tol))
     checked = [c for c in configs if "err_pct" in c]
     max_err = max((c["err_pct"] for c in checked), default=None)
     return {"tolerance_pct": tol * 100.0, "configs": configs,

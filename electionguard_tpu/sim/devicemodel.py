@@ -1,0 +1,146 @@
+"""Device-time model: fitted per-op cost as virtual clock advance.
+
+The analytic capacity plane (PR 18, ``obs/capacity``) predicts a
+10^6-ballot election from the ``BENCH_BIGNUM.json`` rooflines; this
+module lets the sim *play one out* with the same numbers.  A
+:class:`DeviceModel` wraps a fitted ``capacity.CostModel`` and converts
+semantic batch ops ("encrypt N ballots", "mix one stage of N") into
+virtual seconds using exactly the rate algebra ``capacity.predict``
+uses — rows-per-ballot × ballots / (rate × chips × occupancy) for the
+device leg, Amdahl-deflated rpc cost for the host leg — so the
+played-out timeline and the analytic prediction disagree only where
+*composition* (queueing, micro-batch rounding, phase overlap) differs
+from the closed form.  That difference is what ``egplan --validate``
+gates.
+
+The actual arithmetic still runs, once per distinct batch shape, on
+the tiny group (see ``sim/election.py``): full protocol fidelity,
+scaled device time — the SZKP-style roofline treatment (arXiv
+2408.05890) of projecting chip-scale throughput without fabricating
+the chip.
+
+Charges are serialized through named :class:`DevicePlane` queues (a
+shared accelerator is a resource, not a rate): a charge begins at
+``max(now, plane.busy_until)``, extends the plane, and sleeps the
+caller until the work's end — concurrent workers therefore contend
+for device time exactly like batches queueing on one chip, while the
+live verifier charges a separate ``verify`` plane (its own chips in
+the capacity model's accounting).
+
+Two ways in:
+
+* explicit — the election driver holds a ``DeviceModel`` and calls
+  :meth:`DeviceModel.charge` at each pipeline stage;
+* ambient — :func:`install` routes the ``utils.devicetime.charge``
+  no-op seam in the batch crypto entry points here, so existing sims
+  gain device time without touching their call sites.  (The election
+  driver runs its real representative legs with the seam OFF to avoid
+  double-charging.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from electionguard_tpu.obs import capacity
+from electionguard_tpu.utils import clock, devicetime
+
+#: semantic ops charged to the shared accelerator plane; everything
+#: verify-flavored goes to the separate live-verification plane
+_VERIFY_OPS = ("verify", "verify_batch")
+
+
+@dataclass
+class DevicePlane:
+    """One serialized device resource (chips set): charges queue."""
+
+    name: str
+    busy_until: float = 0.0
+    busy_s: float = 0.0
+    charges: int = 0
+
+
+@dataclass
+class DeviceModel:
+    """Fitted per-op virtual device cost for one plan configuration."""
+
+    model: capacity.CostModel
+    backend: str = "cios"
+    chips: int = 1
+    workers: int = 1
+    planes: dict = field(default_factory=dict)
+
+    def plane(self, name: str) -> DevicePlane:
+        p = self.planes.get(name)
+        if p is None:
+            p = self.planes[name] = DevicePlane(name)
+        return p
+
+    # ---- rate algebra (mirrors capacity.predict) ---------------------
+    def _rate(self, op: str) -> float:
+        pow_est = self.model.powmod_per_s.get(self.backend)
+        if pow_est is None or pow_est.mean <= 0:
+            raise ValueError(f"no powmod roofline for backend "
+                             f"{self.backend!r}; fit BENCH_BIGNUM.json")
+        if op == "encrypt":
+            fixed = self.model.fixed_per_s.get(self.backend)
+            return (fixed or pow_est).mean
+        return pow_est.mean
+
+    def seconds_rows(self, rows: float, op: str = "decrypt") -> float:
+        """Virtual device seconds for ``rows`` full-ladder rows (at
+        ``op``'s rate) — ``capacity.predict``'s ``device_s``."""
+        occ = max(min(self.model.occupancy.mean, 1.0), 1e-3)
+        return rows / (self._rate(op) * max(self.chips, 1) * occ)
+
+    def seconds(self, op: str, ballots: float) -> float:
+        """Virtual device seconds for ``ballots`` of ``op``."""
+        return self.seconds_rows(capacity.ROWS_PER_BALLOT[op] * ballots,
+                                 op)
+
+    def host_seconds(self, ballots: float) -> float:
+        """Virtual host-leg seconds ONE worker spends admitting +
+        journaling ``ballots``: rpc cost Amdahl-inflated by the fitted
+        serial fraction, so W workers draining in parallel play out to
+        ``ballots·rpc/(W·eff)`` — ``capacity.predict``'s serving
+        floor."""
+        rpc = self.model.rpc_per_ballot_s
+        if rpc is None:
+            return 0.0
+        eff = capacity.worker_efficiency(self.workers,
+                                         self.model.serial_fraction.mean)
+        return ballots * rpc.mean / eff
+
+    # ---- the charging seam -------------------------------------------
+    def charge_seconds(self, plane_name: str, sec: float) -> None:
+        """Queue ``sec`` of work on a plane and sleep (virtual) until
+        it completes.  Read-modify-write then sleep: the scheduler is
+        cooperative and only the clock call yields, so two workers can
+        never claim the same device window."""
+        p = self.plane(plane_name)
+        now = clock.monotonic()
+        start = max(now, p.busy_until)
+        p.busy_until = start + sec
+        p.busy_s += sec
+        p.charges += 1
+        clock.sleep(p.busy_until - now)
+
+    def charge(self, op: str, ballots: float) -> None:
+        plane = "verify" if op in _VERIFY_OPS else "device"
+        self.charge_seconds(plane, self.seconds(op, ballots))
+
+
+def install(dm: DeviceModel) -> None:
+    """Route the ``utils.devicetime`` crypto-entry-point seam to
+    ``dm`` (one sim at a time)."""
+    devicetime.set_charger(dm.charge)
+
+
+def uninstall() -> None:
+    devicetime.set_charger(None)
+
+
+def fit_default(repo_root: Optional[str] = None) -> DeviceModel:
+    """A DeviceModel over the repo's measured artifacts."""
+    return DeviceModel(capacity.fit(repo_root=repo_root))

@@ -1,0 +1,271 @@
+"""In-sim process model: whole OS processes as scheduler events.
+
+The deterministic sim (sim/scheduler) virtualizes *threads* — every
+clock-seam call is a yield point — but the chaos planes still model
+whole processes with real subprocesses (``workflow/run_command.py``):
+SIGKILL drills burn real wall-clock and sit outside the trace hash.
+:class:`SimProcess` closes that gap: a simulated process is a task
+group keyed by the scheduler's node tag, with the RunCommand lifecycle
+(SPAWNING → RUNNING → {EXITED, KILLED}) driven entirely by scheduler
+events on the virtual clock.
+
+* **spawn** — ``SimProcess(...)`` / ``SimProcess.python_module(...)``
+  mirrors ``RunCommand.python_module``: the "module" names an entry
+  point registered via :func:`register_entry` (the in-sim stand-in for
+  ``python -m module``), the env dict is snapshotted per incarnation,
+  and a ``proc-spawn`` event lands in the trace.
+* **kill / kill_hard** — tears down every task of the process's node
+  via the scheduler's existing ``kill_node`` (tasks unwind with
+  ``TaskKilled`` at their next yield point) and records ``proc-kill``;
+  ``poll()`` flips to the signal-style exit code immediately, like a
+  SIGKILLed subprocess.
+* **restart / restart_on_exit** — replays the SAME entry point with
+  the (possibly env-stripped) resume env on the SAME node tag, after a
+  virtual downtime; ``proc-restart`` lands in the trace, so a replayed
+  seed reproduces the whole crash/recovery story bit-for-bit.
+
+Every lifecycle transition is a ``sched.event(...)`` — the sha256
+event-trace hash therefore covers process chaos exactly as it covers
+dispatch decisions, which is what makes kill/restart schedules
+replayable artifacts instead of wall-clock races.
+
+Install the current scheduler with :func:`install` for the duration of
+a run (the election driver and the test harness do this) so
+``SimProcess.python_module`` can mirror ``RunCommand.python_module``'s
+signature without threading the scheduler through every call site.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional
+
+from electionguard_tpu.sim.scheduler import SimScheduler, TaskKilled
+from electionguard_tpu.utils import clock, knobs
+
+#: lifecycle states (string-valued so they read well in traces/logs)
+SPAWNING = "SPAWNING"
+RUNNING = "RUNNING"
+EXITED = "EXITED"
+KILLED = "KILLED"
+
+#: signal-style exit codes reported after kill()/kill_hard(), mirroring
+#: what a real subprocess.poll() returns after SIGTERM / SIGKILL
+EXIT_TERM = -15
+EXIT_KILL = -9
+
+#: registered in-sim entry points: module name -> fn(flags, env) -> rc.
+#: The in-sim twin of ``python -m module flags...``; entries run inside
+#: the process's task, may block only through the clock seam, and their
+#: return value (None = 0) is the process exit code.
+_ENTRIES: dict[str, Callable] = {}
+
+_SCHED: Optional[SimScheduler] = None
+
+
+def register_entry(module: str, fn: Callable) -> None:
+    """Register ``fn(flags: list[str], env: dict) -> int|None`` as the
+    in-sim entry point for ``python -m module``."""
+    _ENTRIES[module] = fn
+
+
+def entry_for(module: str) -> Callable:
+    fn = _ENTRIES.get(module)
+    if fn is None:
+        raise KeyError(
+            f"no in-sim entry registered for module {module!r}; "
+            f"register_entry() one of {sorted(_ENTRIES) or '(none yet)'}")
+    return fn
+
+
+def install(sched: SimScheduler) -> None:
+    """Make ``sched`` the ambient scheduler for ``python_module`` (one
+    sim at a time, like ``utils.clock.install``)."""
+    global _SCHED
+    _SCHED = sched
+
+
+def uninstall() -> None:
+    global _SCHED
+    _SCHED = None
+
+
+def current_scheduler() -> SimScheduler:
+    if _SCHED is None:
+        raise RuntimeError("no sim scheduler installed "
+                           "(procmodel.install(sched) first)")
+    return _SCHED
+
+
+class SimProcess:
+    """One simulated process: a task group on its own node tag, with
+    the ``RunCommand`` control surface (`wait_for`/`poll`/`kill`/
+    `kill_hard`/`restart`/`restart_on_exit`/`show`)."""
+
+    def __init__(self, name: str, entry: Callable, flags: list[str],
+                 env: Optional[dict] = None,
+                 sched: Optional[SimScheduler] = None,
+                 node: Optional[str] = None):
+        self.name = name
+        self.entry = entry
+        self.flags = list(flags)
+        self._env = dict(env or {})
+        self.sched = sched or current_scheduler()
+        #: the scheduler node tag that owns every task this process
+        #: spawns — kill() is exactly ``kill_node(self.node)``
+        self.node = node or f"proc:{name}"
+        self.state = SPAWNING
+        self.exit_code: Optional[int] = None
+        self.error: Optional[BaseException] = None
+        #: (virtual_t, transition) lifecycle log for show()
+        self.log: list[tuple[float, str]] = []
+        self._gen = 0
+        self._spawn()
+
+    # ---- construction mirror -----------------------------------------
+    @staticmethod
+    def python_module(name: str, module: str, flags: list[str],
+                      output_dir: str, env: Optional[dict] = None
+                      ) -> "SimProcess":
+        """Signature twin of ``RunCommand.python_module`` — launch the
+        registered in-sim entry for ``module`` instead of a subprocess.
+        ``output_dir`` is accepted for interface parity (a sim process
+        captures its story in the trace, not in stdout files)."""
+        env = dict(env or {})
+        env.setdefault("EGTPU_OBS_PROC", name)
+        return SimProcess(name, entry_for(module), flags, env)
+
+    # ---- lifecycle ---------------------------------------------------
+    def _mark(self, transition: str) -> None:
+        self.log.append((self.sched.now, transition))
+
+    def _spawn(self) -> None:
+        gen = self._gen
+        self.state = SPAWNING
+        self.exit_code = None
+        self._mark("spawn")
+        self.sched.event("proc-spawn", f"{self.name} gen={gen}")
+        env = dict(self._env)
+
+        def body():
+            if self._gen != gen:
+                return                      # superseded by a restart
+            self.state = RUNNING
+            self._mark("running")
+            self.sched.event("proc-running", self.name)
+            rc: Optional[int] = 0
+            try:
+                rc = self.entry(list(self.flags), env)
+            except TaskKilled:
+                # kill()/kill_hard() already recorded the transition
+                return
+            except SystemExit as e:         # an entry's sys.exit(rc)
+                rc = e.code if isinstance(e.code, int) else 1
+            except BaseException as e:      # noqa: BLE001 - nonzero exit
+                if self.state == KILLED or self._gen != gen:
+                    return
+                self.error = e
+                rc = 1
+            if self.state == KILLED or self._gen != gen:
+                return
+            self.state = EXITED
+            self.exit_code = int(rc or 0)
+            self._mark(f"exit rc={self.exit_code}")
+            self.sched.event("proc-exit",
+                             f"{self.name} rc={self.exit_code}")
+
+        self.sched.spawn(f"proc:{self.name}#g{gen}", body, node=self.node)
+
+    def _kill(self, transition: str, code: int) -> None:
+        if self.exit_code is not None:
+            return                          # already down
+        self.state = KILLED
+        self.exit_code = code
+        self._mark(transition)
+        self.sched.event(f"proc-{transition}", self.name)
+        # unwind every task of this process at its next yield point
+        self.sched.kill_node(self.node)
+
+    def kill(self) -> None:
+        """Simulated SIGTERM→SIGKILL: in the sim both are the same
+        instantaneous teardown (there are no signal handlers to drain),
+        reported with the SIGTERM-style code for API parity."""
+        self._kill("kill", EXIT_TERM)
+
+    def kill_hard(self) -> None:
+        """Simulated SIGKILL — no handlers, no atexit, no drain: the
+        node's tasks unwind with ``TaskKilled`` wherever they are."""
+        self._kill("kill-hard", EXIT_KILL)
+
+    def restart(self) -> None:
+        """Replay the SAME entry point (same flags, current env
+        snapshot — e.g. after ``restart_on_exit`` stripped a fault
+        knob) on the same node.  The previous incarnation must be
+        down, mirroring ``RunCommand.restart``."""
+        if self.exit_code is None:
+            raise RuntimeError(f"{self.name} still running; kill first")
+        self._gen += 1
+        self._mark("restart")
+        self.sched.event("proc-restart", f"{self.name} gen={self._gen}")
+        self._spawn()
+
+    def restart_on_exit(self, strip_env: tuple[str, ...] = (),
+                        downtime_s: Optional[float] = None) -> None:
+        """Arm a watcher task (on the driver node, so it survives the
+        process's own kill) that waits for this process's FIRST exit,
+        strips ``strip_env`` keys from the resume env, sleeps the
+        virtual ``downtime_s`` (default ``EGTPU_SIM_PROC_DOWNTIME_S``),
+        and restarts it once — the virtual twin of
+        ``RunCommand.restart_on_exit``."""
+        down = (knobs.get_float("EGTPU_SIM_PROC_DOWNTIME_S")
+                if downtime_s is None else downtime_s)
+
+        def fire():
+            self.sched.poll_until(lambda: self.exit_code is not None,
+                                  None)
+            for k in strip_env:
+                self._env.pop(k, None)
+            clock.sleep(down)
+            self.restart()
+
+        self.sched.spawn(f"chaos-{self.name}", fire, node="driver")
+
+    # ---- observation mirror ------------------------------------------
+    def wait_for(self, timeout: float) -> Optional[int]:
+        """Virtual-time wait (call from inside a sim task); returns the
+        exit code, or None on timeout."""
+        self.sched.poll_until(lambda: self.exit_code is not None, timeout)
+        return self.exit_code
+
+    def poll(self) -> Optional[int]:
+        return self.exit_code
+
+    def env(self) -> dict:
+        """The env snapshot the NEXT incarnation would receive."""
+        return dict(self._env)
+
+    def show(self, stream=sys.stdout) -> None:
+        print(f"----- {self.name} " + "-" * 40, file=stream)
+        print(f"  flags: {' '.join(self.flags)}", file=stream)
+        print(f"  state: {self.state}  exit: {self.exit_code}",
+              file=stream)
+        for t, what in self.log:
+            print(f"  t={t:10.3f}s  {what}", file=stream)
+        if self.error is not None:
+            print(f"  error: {self.error!r}", file=stream)
+
+
+def wait_all(procs: list[SimProcess], timeout: float) -> bool:
+    """Virtual-time twin of ``run_command.wait_all``: wait for every
+    process, kill stragglers at the deadline."""
+    deadline = clock.monotonic() + timeout
+    ok = True
+    for p in procs:
+        remaining = max(0.0, deadline - clock.monotonic())
+        code = p.wait_for(remaining)
+        if code is None:
+            p.kill()
+            ok = False
+        elif code != 0:
+            ok = False
+    return ok

@@ -1,0 +1,36 @@
+"""Bottom-layer hook point for the sim's virtual device-time model.
+
+The batch crypto entry points (encrypt / mix / decrypt / verify) call
+:func:`charge` with a semantic op name and a ballot count.  Outside the
+sim nothing is installed and the call is a no-op costing one attribute
+read; under ``sim/devicemodel`` the installed charger advances the
+virtual clock by the fitted per-op device cost.  This module exists so
+those crypto modules never import the sim package (``sim/__init__``
+pulls in the whole exploration stack) — same layering trick as the
+``utils.clock`` seam.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+_CHARGER: Optional[Callable[[str, float], None]] = None
+
+
+def set_charger(fn: Optional[Callable[[str, float], None]]) -> None:
+    """Install (or, with None, remove) the ambient device-time charger.
+    One sim at a time, like ``utils.clock.install``."""
+    global _CHARGER
+    _CHARGER = fn
+
+
+def active() -> bool:
+    return _CHARGER is not None
+
+
+def charge(op: str, ballots: float) -> None:
+    """Charge ``ballots`` worth of semantic op ``op`` ("encrypt",
+    "mix_stage", "decrypt", "verify", "verify_batch") to the installed
+    device-time model, if any."""
+    if _CHARGER is not None:
+        _CHARGER(op, float(ballots))

@@ -68,6 +68,12 @@ KNOBS: tuple[Knob, ...] = (
     Knob("EGTPU_COORDINATOR", "str", None,
          "jax.distributed coordinator address host:port "
          "(parallel/distributed)."),
+    Knob("EGTPU_DISPATCH_HOST_PAD", "str", "1",
+         "Host-side numpy bucket padding in the tiled dispatch policy "
+         "(default on; 0 reverts to eager device-op padding) — removes "
+         "the per-call zeros/scatter/concatenate dispatch tax on "
+         "host-resident batches; tools/sim_matrix measures seeds/s "
+         "both ways (core/group_jax.run_tiled)."),
     Knob("EGTPU_DRYRUN_INLINE", "flag", None,
          "Harness-internal: run the smoke dry-run inline instead of "
          "re-exec'ing (repo entry shim)."),
@@ -231,10 +237,32 @@ KNOBS: tuple[Knob, ...] = (
     Knob("EGTPU_SIM_PARAM_SEEDS", "int", "200",
          "Seed count of the default parameter-adversary sweep "
          "(tools/sim_matrix --param-adversaries)."),
+    Knob("EGTPU_SIM_PROC_DOWNTIME_S", "float", "1.0",
+         "Virtual downtime between a simulated process's exit and its "
+         "restart_on_exit replay — the in-sim twin of the guardian "
+         "restart drill's real sleep (sim/procmodel)."),
     Knob("EGTPU_SIM_PCT_DEPTH", "int", "3",
          "PCT bug depth d under EGTPU_SIM_STRATEGY=pct: d-1 priority "
          "change points are drawn per run (sim/explore; "
          "sim/scheduler)."),
+    Knob("EGTPU_SIM_SCALE_BALLOTS", "int", "1000000",
+         "Virtual electorate size of the default virtual election "
+         "(sim/election)."),
+    Knob("EGTPU_SIM_SCALE_BATCH", "int", "8192",
+         "Admission micro-batch (journal unit) of the virtual "
+         "election; one scheduler event cluster per batch "
+         "(sim/election)."),
+    Knob("EGTPU_SIM_SCALE_CHIPS", "int", "8",
+         "Accelerator chips the virtual election's device-time model "
+         "divides rooflined work across (sim/election; "
+         "sim/devicemodel)."),
+    Knob("EGTPU_SIM_SCALE_REP", "int", "64",
+         "Real-arithmetic cap per distinct batch shape: how many "
+         "representative ballots actually run on the tiny group "
+         "(sim/election)."),
+    Knob("EGTPU_SIM_SCALE_WORKERS", "int", "16",
+         "Serve-worker SimProcess count of the virtual election "
+         "(sim/election)."),
     Knob("EGTPU_SIM_SEED", "int", "0",
          "First seed of the default simulation sweep range "
          "(sim/explore; tools/sim_matrix)."),

@@ -45,7 +45,7 @@ from electionguard_tpu.decrypt.decryption import lagrange_coefficient
 from electionguard_tpu.keyceremony.trustee import commitment_product
 from electionguard_tpu.obs import REGISTRY, span
 from electionguard_tpu.publish.election_record import ElectionRecord
-from electionguard_tpu.utils import knobs
+from electionguard_tpu.utils import devicetime, knobs
 from electionguard_tpu.verify import rlc
 
 
@@ -176,6 +176,9 @@ class Verifier:
             chunk = list(itertools.islice(it, self.chunk_size))
             if not chunk:
                 break
+            devicetime.charge(
+                "verify_batch" if knobs.get_flag("EGTPU_VERIFY_BATCH")
+                else "verify", len(chunk))
             self._verify_ballot_chunk(res, chunk, agg)
 
     @staticmethod

@@ -42,6 +42,10 @@ def test_walk_covers_new_packages_and_obs_modules():
             "publish/framing.py"} <= rels
     # the capacity-planning plane (cost models + predicted-vs-actual)
     assert "obs/capacity.py" in rels
+    # the process-model sim layer (virtual processes + device costs +
+    # the million-ballot election driver) and its ambient charge seam
+    assert {"sim/procmodel.py", "sim/devicemodel.py", "sim/election.py",
+            "utils/devicetime.py"} <= rels
 
 
 def test_no_bare_print_in_library_code():

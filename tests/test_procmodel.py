@@ -1,0 +1,601 @@
+"""Process-model sim layer: SimProcess lifecycle, devicemodel cost
+determinism, virtual-time chaos twins, and million-ballot virtual
+elections on the virtual clock.
+
+Three tiers of guarantee:
+
+* **procmodel mechanics** — the RunCommand-mirror control surface
+  (`python_module`/`kill`/`kill_hard`/`restart`/`wait_for`/`poll`)
+  drives whole simulated processes as scheduler events, so every
+  spawn/SIGKILL/restart lands in the sha256 trace hash and a same-seed
+  rerun replays the chaos story bit-for-bit.
+* **virtual-time chaos twins** — the real-time SIGKILL/restart drills
+  (`workflow/e2e.py -chaosRestartGuardian`, mixfed kill/requeue) run
+  here on the virtual clock with the SAME oracles and real tiny-group
+  crypto, but zero real sleeps; the subprocess originals stay under the
+  `e2e` marker in test_e2e_subprocess.py as the reality anchor.
+* **virtual elections at scale** — `sim/election.py` plays out a
+  10^6-ballot election (reduced event rate in tier-1, the full default
+  spec `@slow`), gated against the analytic capacity model.
+
+Trace hashes are compared across runs INSIDE one process (see
+test_sim.py on PYTHONHASHSEED).
+"""
+
+import dataclasses
+import hashlib
+
+import numpy as np
+import pytest
+
+from electionguard_tpu.obs import capacity
+from electionguard_tpu.sim import devicemodel, procmodel
+from electionguard_tpu.sim.devicemodel import DeviceModel, DevicePlane
+from electionguard_tpu.sim.election import (ElectionSpec, Journal,
+                                            run_virtual_election)
+from electionguard_tpu.sim.procmodel import (EXIT_KILL, EXIT_TERM, EXITED,
+                                             KILLED, RUNNING, SimProcess,
+                                             wait_all)
+from electionguard_tpu.sim.scheduler import SimClock, SimScheduler
+from electionguard_tpu.utils import clock, devicetime
+
+
+def _run(main, seed=1, horizon=1e6):
+    """One procmodel sim: scheduler + clock + ambient install, main on
+    the driver node, full teardown; returns the finished scheduler."""
+    sched = SimScheduler(seed=seed, horizon=horizon)
+    clock.install(SimClock(sched))
+    procmodel.install(sched)
+    try:
+        sched.run(main)
+    finally:
+        procmodel.uninstall()
+        clock.uninstall()
+    return sched
+
+
+def _kinds(sched):
+    return [k for _t, k, _d in sched.trace]
+
+
+def _events(sched, kind):
+    return [d for _t, k, d in sched.trace if k == kind]
+
+
+# ===================================================================
+# SimProcess lifecycle mechanics (the RunCommand mirror)
+# ===================================================================
+
+def test_lifecycle_events_land_in_trace():
+    """SPAWNING -> RUNNING -> EXITED, with every transition a scheduler
+    event covered by the trace hash."""
+    seen = {}
+
+    def entry(flags, env):
+        seen["flags"], seen["env"] = flags, env
+        clock.sleep(2.0)
+        return 0
+
+    def main():
+        p = SimProcess("svc", entry, ["-x", "1"], env={"K": "v"})
+        assert p.state in ("SPAWNING", RUNNING)
+        assert p.wait_for(100.0) == 0
+        assert p.state == EXITED and p.poll() == 0
+        seen["proc"] = p
+
+    sched = _run(main)
+    assert seen["flags"] == ["-x", "1"]
+    assert seen["env"]["K"] == "v"
+    assert _events(sched, "proc-spawn") == ["svc gen=0"]
+    assert _events(sched, "proc-running") == ["svc"]
+    assert _events(sched, "proc-exit") == ["svc rc=0"]
+    # the lifecycle log carries virtual timestamps
+    assert [w for _t, w in seen["proc"].log] == \
+        ["spawn", "running", "exit rc=0"]
+
+
+def test_python_module_mirrors_runcommand(tmp_path):
+    """The registry twin of ``python -m module``: env snapshot gets the
+    EGTPU_OBS_PROC identity, unknown modules fail loudly."""
+    procmodel.register_entry("egtpu.test.echo",
+                             lambda flags, env: int(flags[0]))
+
+    def main():
+        p = SimProcess.python_module("echo-1", "egtpu.test.echo", ["7"],
+                                     str(tmp_path))
+        assert p.env()["EGTPU_OBS_PROC"] == "echo-1"
+        assert p.wait_for(10.0) == 7   # nonzero rc propagates
+
+    _run(main)
+    with pytest.raises(KeyError, match="no in-sim entry"):
+        procmodel.entry_for("egtpu.test.unregistered")
+
+
+def test_kill_and_kill_hard_signal_codes():
+    """kill()/kill_hard() tear the node's tasks down at their next
+    yield point and report signal-style exit codes immediately."""
+    def spin(flags, env):
+        while True:
+            clock.sleep(1.0)
+
+    def main():
+        a = SimProcess("spin-a", spin, [])
+        b = SimProcess("spin-b", spin, [])
+        clock.sleep(3.0)
+        a.kill()
+        b.kill_hard()
+        assert (a.state, a.poll()) == (KILLED, EXIT_TERM)
+        assert (b.state, b.poll()) == (KILLED, EXIT_KILL)
+        a.kill_hard()           # idempotent: already down
+        assert a.poll() == EXIT_TERM
+        clock.sleep(5.0)        # the unwind produces no exit event
+
+    sched = _run(main)
+    assert _events(sched, "proc-kill") == ["spin-a"]
+    assert _events(sched, "proc-kill-hard") == ["spin-b"]
+    assert _events(sched, "proc-exit") == []
+
+
+def test_restart_replays_entry_with_env_snapshot():
+    """restart() requires the previous incarnation down, bumps the
+    generation, and replays the entry with the CURRENT env snapshot."""
+    incarnations = []
+
+    def entry(flags, env):
+        incarnations.append(dict(env))
+        while True:
+            clock.sleep(1.0)
+
+    def main():
+        p = SimProcess("svc", entry, [], env={"MODE": "first"})
+        clock.sleep(1.5)
+        with pytest.raises(RuntimeError, match="still running"):
+            p.restart()
+        p.kill_hard()
+        p._env["MODE"] = "second"
+        p.restart()
+        clock.sleep(1.5)
+        assert p.state == RUNNING
+        p.kill_hard()
+
+    sched = _run(main)
+    assert [e["MODE"] for e in incarnations] == ["first", "second"]
+    assert _events(sched, "proc-restart") == ["svc gen=1"]
+    assert _events(sched, "proc-spawn") == ["svc gen=0", "svc gen=1"]
+
+
+def test_restart_on_exit_strips_fault_env_and_waits_downtime():
+    """The chaos-watcher twin: first exit triggers one restart with the
+    fault knob stripped, after the virtual downtime."""
+    runs = []
+
+    def entry(flags, env):
+        runs.append((clock.monotonic(), dict(env)))
+        if env.get("EGTPU_FAULT"):
+            raise SystemExit(3)
+        return 0
+
+    def main():
+        p = SimProcess("flaky", entry, [], env={"EGTPU_FAULT": "1"})
+        p.restart_on_exit(strip_env=("EGTPU_FAULT",), downtime_s=4.0)
+        clock.sleep(20.0)
+        assert (p.state, p.poll()) == (EXITED, 0)
+
+    sched = _run(main)
+    assert len(runs) == 2
+    assert "EGTPU_FAULT" not in runs[1][1]
+    assert runs[1][0] - runs[0][0] >= 4.0       # virtual downtime held
+    assert _events(sched, "proc-exit") == ["flaky rc=3", "flaky rc=0"]
+
+
+def test_wait_for_timeout_and_wait_all_kills_stragglers():
+    def quick(flags, env):
+        clock.sleep(1.0)
+        return 0
+
+    def forever(flags, env):
+        while True:
+            clock.sleep(1.0)
+
+    def main():
+        p = SimProcess("slowpoke", forever, [])
+        assert p.wait_for(5.0) is None          # virtual timeout
+        q = SimProcess("quick", quick, [])
+        assert not wait_all([q, p], timeout=10.0)
+        assert q.poll() == 0
+        assert (p.state, p.poll()) == (KILLED, EXIT_TERM)
+
+    _run(main)
+
+
+def test_kill_restart_schedule_replays_bit_for_bit():
+    """The tentpole determinism pin: a whole kill/restart chaos story
+    (spawn, mid-flight SIGKILL, downtime, restart, drain) replays to
+    the identical trace hash under the same seed, and a different seed
+    diverges."""
+    def story(seed):
+        done = []
+
+        def entry(flags, env):
+            for i in range(10):
+                clock.sleep(1.0)
+                done.append(i)
+            return 0
+
+        def main():
+            p = SimProcess("svc", entry, [])
+            p.restart_on_exit(downtime_s=2.0)
+            clock.sleep(3.5)
+            p.kill_hard()
+            clock.sleep(30.0)
+            assert (p.state, p.poll()) == (EXITED, 0)
+
+        return _run(main, seed=seed).trace_hash()
+
+    assert story(11) == story(11)
+    assert story(11) != story(12)
+
+
+# ===================================================================
+# devicemodel: fitted per-op cost as virtual clock advance
+# ===================================================================
+
+def _toy_model():
+    return capacity.CostModel(
+        platform="test",
+        powmod_per_s={"cios": capacity.Estimate(1000.0)},
+        fixed_per_s={"cios": capacity.Estimate(4000.0)},
+        rpc_per_ballot_s=capacity.Estimate(0.002),
+        occupancy=capacity.Estimate(0.8),
+        serial_fraction=capacity.Estimate(0.1))
+
+
+def test_devicemodel_rate_algebra_mirrors_capacity_predict():
+    """seconds() is exactly capacity.predict's device_s term — same
+    rows-per-ballot table, same chips x occupancy deflation, encrypt on
+    the fixed-base roofline, everything else on powmod."""
+    dm = DeviceModel(_toy_model(), backend="cios", chips=4, workers=8)
+    occ = 0.8
+    rows = capacity.ROWS_PER_BALLOT["encrypt"] * 100
+    assert dm.seconds("encrypt", 100) == pytest.approx(
+        rows / (4000.0 * 4 * occ))
+    rows = capacity.ROWS_PER_BALLOT["mix_stage"] * 100
+    assert dm.seconds("mix_stage", 100) == pytest.approx(
+        rows / (1000.0 * 4 * occ))
+    # host leg: Amdahl-deflated rpc seconds for ONE worker's drain
+    eff = capacity.worker_efficiency(8, 0.1)
+    assert dm.host_seconds(1000) == pytest.approx(1000 * 0.002 / eff)
+    # determinism: same inputs, same virtual cost, every time
+    assert dm.seconds("decrypt", 12345) == dm.seconds("decrypt", 12345)
+    with pytest.raises(ValueError, match="no powmod roofline"):
+        DeviceModel(_toy_model(), backend="pallas").seconds("decrypt", 1)
+
+
+def test_device_plane_queueing_serializes_concurrent_charges():
+    """Two workers charging the shared plane contend like batches on
+    one chip: total busy time is the sum, and each charge begins at the
+    plane's busy_until, never inside another's window.  Verify-flavored
+    ops land on their own plane (the live-verification chips)."""
+    dm = DeviceModel(_toy_model(), backend="cios", chips=1)
+    ends = {}
+
+    def worker(name):
+        def body():
+            dm.charge_seconds("device", 5.0)
+            ends[name] = clock.monotonic()
+        return body
+
+    def main():
+        sched = procmodel.current_scheduler()
+        sched.spawn("w1", worker("w1"), node="driver")
+        sched.spawn("w2", worker("w2"), node="driver")
+        sched.poll_until(lambda: len(ends) == 2, None)
+        dm.charge("verify_batch", 100)
+
+    sched = _run(main)
+    plane = dm.plane("device")
+    assert plane.busy_s == pytest.approx(10.0)
+    assert sorted(ends.values()) == pytest.approx([5.0, 10.0])
+    assert dm.plane("verify").charges == 1
+    assert dm.plane("verify").busy_s > 0
+    assert sched.now >= 10.0
+
+
+def test_devicetime_seam_routes_batch_crypto_entry_points(election):
+    """The ambient seam: utils.devicetime is a no-op until a charger is
+    installed; with one installed, the batch crypto entry points
+    (mixnet run_stage here) charge their semantic op + row count."""
+    calls = []
+    assert not devicetime.active()
+    devicetime.charge("encrypt", 5)            # no-op, no charger
+    devicetime.set_charger(lambda op, n: calls.append((op, n)))
+    try:
+        assert devicetime.active()
+        from electionguard_tpu.mixnet.stage import (rows_from_ballots,
+                                                    run_stage)
+        g, init = election["group"], election["init"]
+        pads, datas = rows_from_ballots(election["encrypted"])
+        run_stage(g, init.joint_public_key.value,
+                  init.extended_base_hash, 0, pads, datas,
+                  seed=b"seam-test")
+    finally:
+        devicetime.set_charger(None)
+    assert ("mix_stage", float(len(pads))) in calls
+    assert not devicetime.active()
+
+
+def test_devicemodel_install_routes_seam_to_planes():
+    """devicemodel.install(dm) wires the seam to the plane queues (and
+    uninstall() restores the no-op)."""
+    dm = DeviceModel(_toy_model(), backend="cios", chips=8)
+
+    def main():
+        devicemodel.install(dm)
+        try:
+            devicetime.charge("decrypt", 1000)
+            devicetime.charge("verify", 1000)
+        finally:
+            devicemodel.uninstall()
+
+    _run(main)
+    assert dm.plane("device").charges == 1
+    assert dm.plane("verify").charges == 1
+    assert dm.plane("device").busy_s == pytest.approx(
+        dm.seconds("decrypt", 1000))
+    assert not devicetime.active()
+
+
+# ===================================================================
+# virtual-time chaos twins of the subprocess drills
+# ===================================================================
+
+def test_virtual_guardian_chaos_restart_twin(tgroup):
+    """-chaosRestartGuardian on the virtual clock: guardian-1's process
+    hard-exits right after it commits + checkpoints its FIRST received
+    key share, restart_on_exit strips the fault knob and replays the
+    entry from the resume checkpoint — and the ceremony completes with
+    the committed share intact, the x-coordinate reclaimed, and the
+    joint key identical to the guardians' public-key product.  Same
+    oracles as the subprocess drill (test_e2e_subprocess /
+    test_faults.test_key_ceremony_survives_trustee_crash_restart),
+    zero real sleeps, and the whole story in the trace hash."""
+    from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+
+    g = tgroup
+    n = 3
+    trustees = [KeyCeremonyTrustee(g, f"guardian-{i}", i + 1, 2)
+                for i in range(n)]
+    # round 1 outside the sim (the coordinator's registration phase):
+    # every guardian validates every other's public keys
+    for t in trustees:
+        for u in trustees:
+            if t is not u:
+                assert t.receive_public_keys(u.send_public_keys()).ok
+    senders = {t.id: t for t in trustees}
+    # resume files: the per-guardian mid-ceremony checkpoint store
+    store = {t.id: t.ceremony_state() for t in trustees}
+    order = [t.id for t in trustees]
+    restored_x = {}
+
+    def guardian_entry_for(name):
+        def entry(flags, env):
+            # a fresh incarnation has ONLY its resume file: restore,
+            # like run_remote_trustee -resumeFile
+            me = KeyCeremonyTrustee.from_ceremony_state(g, store[name])
+            restored_x[name] = me.x_coordinate
+            for sender in order:
+                if sender == name or sender in me.received_shares:
+                    continue            # replayed rpc dedupes
+                share = senders[sender].send_secret_key_share(name)
+                assert me.receive_secret_key_share(share).ok
+                store[name] = me.ceremony_state()   # commit+checkpoint
+                clock.sleep(0.5)
+                if env.get("EGTPU_FAULT_PLAN") and \
+                        len(me.received_shares) == 1:
+                    # crash_after receiveSecretKeyShare on_calls=[1]
+                    raise SystemExit(1)
+            return 0
+        return entry
+
+    def main():
+        procs = []
+        for t in trustees:
+            env = {"EGTPU_FAULT_PLAN": "crash_after"} \
+                if t.id == "guardian-1" else {}
+            procs.append(SimProcess(t.id, guardian_entry_for(t.id), [],
+                                    env=env))
+        procs[1].restart_on_exit(strip_env=("EGTPU_FAULT_PLAN",),
+                                 downtime_s=1.0)
+        assert wait_all([procs[0], procs[2]], timeout=600.0)
+        procmodel.current_scheduler().poll_until(
+            lambda: procs[1].state == EXITED and procs[1].poll() == 0,
+            None)
+
+    sched = _run(main, seed=5)
+
+    # the crash + env-stripped restart is in the story
+    assert "guardian-1 rc=1" in _events(sched, "proc-exit")
+    assert _events(sched, "proc-restart") == ["guardian-1 gen=1"]
+    # the restarted incarnation reclaimed its x, didn't re-register
+    assert restored_x["guardian-1"] == 2
+    # ceremony oracles, from the resume files (what a real restart has)
+    final = {name: KeyCeremonyTrustee.from_ceremony_state(g, st)
+             for name, st in store.items()}
+    assert all(len(t.received_shares) == n - 1 for t in final.values())
+    # the checkpointed first share survived the crash (guardian-0 sends
+    # first in the pinned order)
+    assert "guardian-0" in final["guardian-1"].received_shares
+    joint = g.mult_p(*(t.election_public_key for t in trustees))
+    assert g.mult_p(*(t.election_public_key
+                      for t in final.values())) == joint
+
+
+def test_virtual_mixfed_kill_requeue_twin(tgroup, election):
+    """The mixfed SIGKILL drill on the virtual clock: mix server 0 is
+    SIGKILL'd mid-stage (during its device window, after claiming the
+    stage job), the coordinator requeues the stage on the spare exactly
+    once, and the finished cascade is bit-identical to the undisturbed
+    reference — stage seeds pin the shuffle, so WHO runs a stage must
+    not matter.  Mirrors `-chaosKillMixServer` (workflow/e2e.py) with
+    the same green-record oracle and no real sleeps."""
+    from electionguard_tpu.mixnet.stage import rows_from_ballots, run_stage
+
+    g, init = tgroup, election["init"]
+    jpk, qbar = init.joint_public_key.value, init.extended_base_hash
+    pads0, datas0 = rows_from_ballots(election["encrypted"])
+    seeds = [hashlib.sha256(f"mixtwin|{k}".encode()).digest()
+             for k in range(2)]
+
+    # the undisturbed reference cascade (also warms the jit programs the
+    # in-sim replay hits)
+    ref = []
+    p, d = pads0, datas0
+    for k in range(2):
+        st = run_stage(g, jpk, qbar, k, p, d, seed=seeds[k])
+        ref.append(st)
+        p, d = st.pads, st.datas
+
+    def story(seed):
+        committed: dict[int, object] = {}
+        jobs = list(range(2))
+        claimed: dict[str, int] = {}
+
+        def server_entry(flags, env):
+            me = env["EGTPU_OBS_PROC"]
+            while True:
+                sched = procmodel.current_scheduler()
+                sched.poll_until(
+                    lambda: (jobs and len(committed) >= jobs[0])
+                    or len(committed) == 2, None)
+                if len(committed) == 2:
+                    return 0
+                k = jobs.pop(0)
+                claimed[me] = k
+                sched.event("mix-claim", f"stage={k} {me}")
+                clock.sleep(2.0)        # the device window: killable
+                if k in committed:      # exactly-once under requeue
+                    continue
+                pin, din = (pads0, datas0) if k == 0 else \
+                    (committed[k - 1].pads, committed[k - 1].datas)
+                st = run_stage(g, jpk, qbar, k, pin, din, seed=seeds[k])
+                committed[k] = st
+                claimed.pop(me, None)
+                sched.event("mix-commit", f"stage={k} {me}")
+
+        def main():
+            sched = procmodel.current_scheduler()
+            servers = [SimProcess(f"mix-{i}", server_entry, [],
+                                  env={"EGTPU_OBS_PROC": f"mix-{i}"})
+                       for i in range(2)]
+
+            def saboteur():
+                sched.poll_until(lambda: "mix-0" in claimed, None)
+                victim = servers[0]
+                victim.kill_hard()
+                k = claimed.pop("mix-0", None)
+                if k is not None and k not in committed:
+                    jobs.insert(0, k)
+                    sched.event("requeue", f"stage={k} on spare")
+
+            sched.spawn("saboteur", saboteur, node="driver")
+            sched.poll_until(lambda: len(committed) == 2, None)
+            servers[1].wait_for(600.0)
+
+        sched = _run(main, seed=seed)
+        return sched, committed
+
+    sched, committed = story(seed=3)
+    assert _events(sched, "proc-kill-hard") == ["mix-0"]
+    assert any("on spare" in d for d in _events(sched, "requeue"))
+    # exactly-once: each stage committed once, by the spare
+    assert sorted(committed) == [0, 1]
+    assert all("mix-1" in d for d in _events(sched, "mix-commit"))
+    # green record: bit-identical to the undisturbed reference cascade
+    for k in range(2):
+        assert np.array_equal(np.asarray(committed[k].pads),
+                              np.asarray(ref[k].pads))
+        assert np.array_equal(np.asarray(committed[k].datas),
+                              np.asarray(ref[k].datas))
+    # and the whole kill/requeue story replays bit-for-bit
+    sched2, _ = story(seed=3)
+    assert sched2.trace_hash() == sched.trace_hash()
+
+
+# ===================================================================
+# virtual elections at scale
+# ===================================================================
+
+#: tier-1 reduced event rate: the full 10^6 electorate in 4 micro-
+#: batches, 4 representative ballots per shape
+_SMOKE = ElectionSpec(ballots=1_000_000, batch=250_000, rep_ballots=4,
+                      workers=2, chips=8, chaos_after_batches=2)
+
+
+def test_million_ballot_smoke_replays_bit_for_bit():
+    """A 10^6-ballot virtual election at a reduced event rate: every
+    phase plays out, every oracle green, and a same-seed rerun —
+    THROUGH a mid-election worker SIGKILL/restart with its in-flight
+    batch requeued — reproduces the trace hash bit-for-bit."""
+    a = run_virtual_election(seed=3, spec=_SMOKE, chaos=True)
+    assert a.ok, a.violations
+    assert a.ballots == 1_000_000
+    assert a.batches == 4
+    names = [s.name for s in a.timeline]
+    assert names == ["ceremony", "serve-encrypt", "mix×2", "decrypt",
+                     "verify-batch-residual"]
+    assert a.virtual_s > 0 and a.device_busy_s["device"] > 0
+    assert a.live["live_root"] == a.live["batch_root"]
+
+    b = run_virtual_election(seed=3, spec=_SMOKE, chaos=True)
+    assert b.trace_hash == a.trace_hash
+    assert (b.events, b.virtual_s, b.journal_head) == \
+        (a.events, a.virtual_s, a.journal_head)
+
+    c = run_virtual_election(seed=4, spec=_SMOKE, chaos=True)
+    assert c.ok and c.trace_hash != a.trace_hash
+
+
+def test_chaos_kill_restart_is_in_the_election_trace():
+    """chaos=True injects the worker SIGKILL + requeue + restart into
+    the event trace (so the two modes hash differently), while the
+    journal still admits every ballot exactly once."""
+    calm = run_virtual_election(seed=3, spec=_SMOKE, chaos=False)
+    chaos = run_virtual_election(seed=3, spec=_SMOKE, chaos=True)
+    assert calm.ok and chaos.ok
+    assert calm.trace_hash != chaos.trace_hash
+    assert calm.ballots == chaos.ballots == 1_000_000
+
+
+def test_election_spec_from_knobs(monkeypatch):
+    monkeypatch.setenv("EGTPU_SIM_SCALE_BALLOTS", "500000")
+    monkeypatch.setenv("EGTPU_SIM_SCALE_WORKERS", "9")
+    spec = ElectionSpec.from_knobs()
+    assert (spec.ballots, spec.workers) == (500_000, 9)
+    assert spec.plan().ballots == 500_000
+    assert dataclasses.replace(spec, ballots=10).ballots == 10
+
+
+def test_journal_chain_detects_tamper_and_duplicates():
+    j = Journal()
+    j.append(0, 100)
+    j.append(1, 50)
+    assert j.total() == 150 and j.chain_ok() and j.has(0)
+    with pytest.raises(ValueError, match="duplicate"):
+        j.append(0, 100)
+    j.entries[0] = (0, 999, j.entries[0][2])    # tamper
+    assert not j.chain_ok()
+
+
+@pytest.mark.slow
+def test_full_default_election_meets_capacity_gate():
+    """Acceptance: the full default spec (10^6 ballots, 8192-ballot
+    micro-batches, 16 workers) plays out end-to-end under chaos in <= 5
+    minutes of real wall-clock, and the played-out timeline agrees with
+    the analytic capacity prediction within EGTPU_CAPACITY_TOL — the
+    same gate `egplan --validate` runs."""
+    out = capacity.validate_sim_election()
+    assert not out.get("skipped"), out
+    assert out["oracles_ok"], out["violations"]
+    assert out["pass"], out
+    assert out["wall_s"] <= 300.0
+    assert out["err_pct"] <= capacity.tolerance() * 100

@@ -62,6 +62,10 @@ METRICS: dict[str, tuple[bool, float]] = {
     # calibration elections while still catching a model whose error
     # doubles (drift in the cost structure it was fitted on)
     "capacity_model_err_pct": (False, 1.0),
+    # process-model sim layer: simulated ballots played out per real
+    # second for the reduced-event-rate million-ballot election; wide
+    # band — the run is scheduler-bound and shares the box with jit
+    "sim_ballots_per_s": (True, 0.25),
 }
 #: per-backend powmod rates live in a dict metric
 _POWMOD_TOL = (True, 0.15)

@@ -25,10 +25,16 @@ attack to be detected in-band or by the verifier, and the artifact
 histogram with the detection classes seen.  A green sweep is the
 repo's zero-green-undetected claim.
 
-``--procs N`` shards the seed range over N worker subprocesses (the
-per-seed cost is JAX dispatch-bound, so sweep throughput scales with
-cores).  Workers share the persistent JAX compilation cache, so only
-the first sweep on a machine pays the compile warmup.
+``--procs N`` shards the seed range over N worker subprocesses.
+Workers share the persistent JAX compilation cache, so only the first
+sweep on a machine pays the compile warmup; within a worker, seeds
+share the process-wide jitted program set and tiny-group tables, and
+the host-pad dispatch trim (EGTPU_DISPATCH_HOST_PAD,
+core/group_jax.run_tiled) removes the per-call eager padding tax that
+used to bound steady-state seeds/s.  The artifact records the honest
+split: ``warmup_s`` (first seed, dispatch/deserialize-bound),
+``steady_seeds_per_s`` (everything after), and ``dispatch_trim`` — a
+same-process calibration of seeds/s with the trim off vs on.
 
 Trace hashes are deterministic per process; to compare them across
 processes or machines, pin PYTHONHASHSEED.
@@ -83,9 +89,15 @@ def _sweep(start: int, count: int, fast: bool,
     fired_total = 0
     live_stats = {"runs": 0, "converged": 0, "crashes": 0, "torn": 0,
                   "chunks": 0, "rejected_chunks": 0}
+    warmup_s = 0.0
+    t_loop = time.time()
     for seed in range(start, start + count):
         r = run_sim(seed, config=cfg, adversaries=adversaries,
                     plant=plant, param_adversaries=param)
+        if seed == start:
+            # first seed pays the per-process jit dispatch/deserialize
+            # warmup; the rest run against warm program + table caches
+            warmup_s = time.time() - t_loop
         if r.live:
             live_stats["runs"] += 1
             live_stats["converged"] += bool(r.live["converged"])
@@ -129,7 +141,10 @@ def _sweep(start: int, count: int, fast: bool,
         failures.append(entry)
         print(f"FAIL {r.summary()}", file=sys.stderr)
     return {"ok": ok, "failures": failures, "attacks": attacks,
-            "fired_total": fired_total, "live": live_stats}
+            "fired_total": fired_total, "live": live_stats,
+            "warmup_s": round(warmup_s, 3),
+            "steady_s": round(time.time() - t_loop - warmup_s, 3),
+            "steady_seeds": max(count - 1, 0)}
 
 
 def _sweep_procs(start: int, count: int, procs: int, fast: bool,
@@ -162,7 +177,8 @@ def _sweep_procs(start: int, count: int, procs: int, fast: bool,
         jobs.append((subprocess.Popen(cmd), out))
     merged = {"ok": 0, "failures": [], "attacks": {}, "fired_total": 0,
               "live": {"runs": 0, "converged": 0, "crashes": 0,
-                       "torn": 0, "chunks": 0, "rejected_chunks": 0}}
+                       "torn": 0, "chunks": 0, "rejected_chunks": 0},
+              "warmup_s": 0.0, "steady_s": 0.0, "steady_seeds": 0}
     rc = 0
     for proc, out in jobs:
         rc |= proc.wait()
@@ -171,6 +187,13 @@ def _sweep_procs(start: int, count: int, procs: int, fast: bool,
             merged["ok"] += chunk["ok"]
             merged["failures"].extend(chunk["failures"])
             merged["fired_total"] += chunk.get("fired_total", 0)
+            # workers run concurrently: warmup/steady wall is the
+            # slowest worker's, steady seed count sums across them
+            merged["warmup_s"] = max(merged["warmup_s"],
+                                     chunk.get("warmup_s", 0.0))
+            merged["steady_s"] = max(merged["steady_s"],
+                                     chunk.get("steady_s", 0.0))
+            merged["steady_seeds"] += chunk.get("steady_seeds", 0)
             for k, n_k in chunk.get("live", {}).items():
                 merged["live"][k] += n_k
             for name, a in chunk.get("attacks", {}).items():
@@ -184,6 +207,36 @@ def _sweep_procs(start: int, count: int, procs: int, fast: bool,
         raise SystemExit(f"a sweep worker failed (exit {rc})")
     merged["failures"].sort(key=lambda f: f["seed"])
     return merged
+
+
+def _dispatch_calibration(fast: bool, seeds: int = 8) -> dict:
+    """Honest before/after of the host-pad dispatch trim: run the same
+    seeds in THIS warm process with EGTPU_DISPATCH_HOST_PAD off then on
+    (seeds 999_984.., disjoint from any sweep range), so the only
+    variable is the eager-padding tax the trim removes."""
+    from electionguard_tpu.sim.explore import run_sim
+
+    cfg = _config(fast)
+    run_sim(999_983, config=cfg)      # warm programs outside both timings
+    out: dict = {"seeds": seeds}
+    prev = os.environ.get("EGTPU_DISPATCH_HOST_PAD")
+    try:
+        for label, flag in (("before", "0"), ("after", "1")):
+            os.environ["EGTPU_DISPATCH_HOST_PAD"] = flag
+            t0 = time.time()
+            for s in range(999_984, 999_984 + seeds):
+                run_sim(s, config=cfg)
+            dt = time.time() - t0
+            out[f"{label}_seeds_per_s"] = round(seeds / dt, 2) if dt else None
+    finally:
+        if prev is None:
+            os.environ.pop("EGTPU_DISPATCH_HOST_PAD", None)
+        else:
+            os.environ["EGTPU_DISPATCH_HOST_PAD"] = prev
+    if out.get("before_seeds_per_s") and out.get("after_seeds_per_s"):
+        out["speedup"] = round(
+            out["after_seeds_per_s"] / out["before_seeds_per_s"], 2)
+    return out
 
 
 def _replay(seed: int, schedule_json: str, fast: bool) -> int:
@@ -283,7 +336,10 @@ def main(argv=None) -> int:
                         args.shrink_budget, args.adversaries, args.live,
                         args.param_adversaries)
     wall = time.time() - t0
+    trim = _dispatch_calibration(args.fast)
 
+    steady = (round(merged["steady_seeds"] / merged["steady_s"], 2)
+              if merged.get("steady_s") else None)
     result = {
         "generated_by": "tools/sim_matrix.py",
         "seed_start": args.start,
@@ -295,10 +351,18 @@ def main(argv=None) -> int:
         "failures": merged["failures"],
         "wall_s": round(wall, 1),
         "schedules_per_s": round(args.seeds / wall, 2) if wall else None,
+        "warmup_s": merged.get("warmup_s"),
+        "steady_seeds_per_s": steady,
+        "dispatch_trim": trim,
     }
     print(f"{merged['ok']}/{args.seeds} seeds green, "
           f"{len(merged['failures'])} failures, {wall:.1f}s "
-          f"({result['schedules_per_s']} schedules/s)")
+          f"({result['schedules_per_s']} schedules/s; "
+          f"{steady} steady after {merged.get('warmup_s')}s warmup)")
+    print(f"  dispatch trim: {trim.get('before_seeds_per_s')} -> "
+          f"{trim.get('after_seeds_per_s')} seeds/s "
+          f"(x{trim.get('speedup')}, host-pad off vs on, "
+          f"{trim['seeds']} calibration seeds)")
     if args.live:
         ls = merged["live"]
         result.update({"mode": ("live+adversaries" if args.adversaries
