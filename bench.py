@@ -92,6 +92,8 @@ def _append_progress_row() -> None:
         "ballots_per_s_per_chip": RESULT.get("value"),
         "vs_baseline": RESULT.get("vs_baseline"),
         "powmod_per_s": RESULT.get("powmod_per_s"),
+        "tenant_aggregate_ballots_per_s":
+            RESULT.get("tenant_aggregate_ballots_per_s"),
         "platform": RESULT.get("platform"),
         "nballots": RESULT.get("nballots"),
         "git": git_rev,
@@ -611,6 +613,20 @@ def run_workload(nballots: int, n_chips: int) -> None:
     except Exception as e:  # noqa: BLE001 — diagnostics
         note(f"fabric phase failed: {type(e).__name__}: {e}")
         RESULT["fabric_error"] = f"{type(e).__name__}: {e}"
+    flush_partial()
+
+    # ---- multitenant phase: N elections through ONE worker pool ---------
+    # the shared-program fabric's numbers: aggregate ballots/s with 4
+    # overlapping elections on one pool vs the same pool single-tenant
+    # (the consolidation tax), the per-tenant p99 spread, and the
+    # device-compile delta across the multi-tenant leg (0 = the traced
+    # election key really is shared).  Tiny group, best-effort like the
+    # planes above
+    try:
+        _bench_multitenant()
+    except Exception as e:  # noqa: BLE001 — diagnostics
+        note(f"multitenant phase failed: {type(e).__name__}: {e}")
+        RESULT["multitenant_error"] = f"{type(e).__name__}: {e}"
     flush_partial()
 
     # ---- live phase: incremental verifier chunks/s + residual drain -----
@@ -1351,6 +1367,110 @@ def _bench_fabric(fleets=(1, 2, 4), nsingles: int = 24,
         RESULT["phases_done"] = RESULT.get("phases_done", "") + " fabric"
     finally:
         shutil.rmtree(out, ignore_errors=True)
+
+
+def _bench_multitenant(n_tenants: int = 4, per_tenant: int = 16) -> None:
+    """Multi-tenant consolidation tax: ``n_tenants`` elections with
+    distinct key ceremonies interleaved through ONE EncryptionService
+    vs the same pool serving a single tenant.  Three numbers: aggregate
+    ballots/s across the overlapping elections, the per-tenant p99
+    spread (max - min), and the device-compile delta across the
+    multi-tenant leg — 0 means the election key really is a traced
+    argument and tenants share every compiled bucket program.  Tiny
+    group: this measures the tenant plane, not modexp throughput."""
+    import threading
+    from dataclasses import replace as dc_replace
+
+    from electionguard_tpu.ballot.plaintext import RandomBallotProvider
+    from electionguard_tpu.core.group import tiny_group
+    from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+    from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+    from electionguard_tpu.obs import tenant
+    from electionguard_tpu.publish.election_record import ElectionConfig
+    from electionguard_tpu.serve.service import (EncryptionClient,
+                                                 EncryptionService)
+    from electionguard_tpu.serve.tenants import (ElectionContext,
+                                                 TenantRegistry)
+    from electionguard_tpu.workflow.e2e import sample_manifest
+
+    g = tiny_group()
+    manifest = sample_manifest(1, 2)
+
+    def ceremony(tag):
+        trustees = [KeyCeremonyTrustee(g, f"{tag}-g0", 1, 1)]
+        return key_ceremony_exchange(trustees, g).make_election_initialized(
+            ElectionConfig(manifest, 1, 1), {"created_by": f"bench-{tag}"})
+
+    protos = list(RandomBallotProvider(manifest, per_tenant,
+                                       seed=53).ballots())
+
+    def run_pool(tenant_ids):
+        registry = TenantRegistry()
+        for i, el in enumerate(tenant_ids):
+            registry.add(ElectionContext(el, ceremony(el), group=g,
+                                         seed=g.int_to_q(301 + i)))
+        svc = EncryptionService(ceremony(f"{tenant_ids[0]}-house"), g,
+                                max_batch=8, max_wait_ms=5,
+                                tenants=registry)
+        try:
+            url = f"localhost:{svc.port}"
+            warm = EncryptionClient(url, g)   # build each lane's key table
+            for el in tenant_ids:
+                with tenant.tenant_scope(el):
+                    warm.encrypt(dc_replace(protos[0],
+                                            ballot_id=f"{el}-warm"))
+            warm.close()
+            compiles0 = svc.metrics.counters()["device_compiles"]
+            done = []
+
+            def one_tenant(el):
+                client = EncryptionClient(url, g)
+                try:
+                    with tenant.tenant_scope(el):
+                        mine = [dc_replace(b,
+                                           ballot_id=f"{el}-{b.ballot_id}")
+                                for b in protos]
+                        for k in range(0, len(mine), 8):
+                            res = client.encrypt_batch(mine[k:k + 8])
+                            assert all(e is not None for e, _ in res)
+                    done.append(len(mine))
+                finally:
+                    client.close()
+
+            threads = [threading.Thread(target=one_tenant, args=(el,),
+                                        daemon=True)
+                       for el in tenant_ids]
+            t0 = time.time()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            dt = time.time() - t0
+            total = sum(done)
+            assert total == len(tenant_ids) * per_tenant, \
+                f"multitenant: {total}/{len(tenant_ids) * per_tenant}"
+            p99s = [svc.metrics.histogram_for("request_latency_ms",
+                                              el).quantile(0.99)
+                    for el in tenant_ids]
+            compiles = svc.metrics.counters()["device_compiles"] - compiles0
+            return total / max(dt, 1e-9), p99s, compiles
+        finally:
+            svc.drain()
+
+    els = [f"mt-{c}" for c in "abcdefgh"][:n_tenants]
+    agg_rate, p99s, compiles = run_pool(els)
+    solo_rate, _, _ = run_pool(["mt-solo"])
+    RESULT.update(
+        tenant_aggregate_ballots_per_s=round(agg_rate, 1),
+        tenant_single_ballots_per_s=round(solo_rate, 1),
+        tenant_p99_spread_ms=round(max(p99s) - min(p99s), 2),
+        tenant_compiles_delta=int(compiles),
+    )
+    note(f"multitenant x{n_tenants}: {agg_rate:.1f} ballots/s aggregate "
+         f"(solo {solo_rate:.1f}/s), p99 spread "
+         f"{max(p99s) - min(p99s):.2f}ms, {compiles} compiles after "
+         f"warmup")
+    RESULT["phases_done"] = RESULT.get("phases_done", "") + " multitenant"
 
 
 def _cpu_fallback(tpu_error: str) -> bool:

@@ -151,8 +151,8 @@ def load_default_passes() -> None:
                                             ingestion_validation,
                                             jit_hygiene, lock_discipline,
                                             no_bare_print, rpc_contract,
-                                            secret_taint, trace_coverage,
-                                            wall_clock)
+                                            secret_taint, tenant_label,
+                                            trace_coverage, wall_clock)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,101 @@
+"""tenant-label: serving-plane metric series must carry the election label.
+
+Every counter/histogram registered in the multi-tenant planes (serve,
+fabric, mixfed, verify) must pass ``election_labels(...)`` — directly, or
+via a local variable assigned from it in the same function — so the
+series splits per election on a shared fleet.  An unlabeled series
+silently merges every tenant's traffic into one line: per-tenant SLOs
+read garbage, the noisy-neighbor join has nothing to attribute, and the
+cross-tenant blindness only shows up during the first real incident.
+
+Gauges are exempt: the existing gauge series are process-scoped facts
+(queue depth, compile counts, audit lag) that the collector already
+namespaces with ``proc=``; counters and histograms are the event/latency
+series per-tenant SLOs are computed from.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from electionguard_tpu.analysis import core
+
+#: subpackages whose metric series MUST be election-labeled
+TENANT_DIRS = ("serve", "fabric", "mixfed", "verify")
+#: registry factory method names that create per-tenant series
+_FACTORIES = ("counter", "histogram")
+
+RULE = "tenant-label"
+
+
+def _is_election_labels_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    return (isinstance(fn, ast.Name) and fn.id == "election_labels") or \
+        (isinstance(fn, ast.Attribute) and fn.attr == "election_labels")
+
+
+def _labeled_names(scope: ast.AST) -> set[str]:
+    """Names assigned from ``election_labels(...)`` anywhere in the
+    enclosing function scope (the ``labels = election_labels()`` idiom)."""
+    names: set[str] = set()
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) \
+                and _is_election_labels_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+    return names
+
+
+def _carries_labels(call: ast.Call, labeled: set[str]) -> bool:
+    args = list(call.args) + [kw.value for kw in call.keywords]
+    for a in args:
+        if _is_election_labels_call(a):
+            return True
+        if isinstance(a, ast.Name) and a.id in labeled:
+            return True
+    return False
+
+
+@core.register(RULE, doc="metric series in serve/fabric/mixfed/verify "
+                         "missing election_labels() (cross-tenant blind "
+                         "spot on a shared fleet)")
+def run(project: core.Project) -> Iterator[core.Finding]:
+    for f in project.files():
+        parts = project.package_rel_parts(f)
+        if not parts or parts[0] not in TENANT_DIRS:
+            continue
+        # function scopes first, so variable-indirection resolves; the
+        # module body is its own scope for module-level registrations
+        scopes = [n for n in ast.walk(f.tree)
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))]
+        scopes.append(f.tree)
+        seen: set[int] = set()
+        for scope in scopes:
+            labeled = _labeled_names(scope)
+            walker = (ast.walk(scope) if not isinstance(scope, ast.Module)
+                      else iter(ast.iter_child_nodes(scope)))
+            for node in walker:
+                for call in ast.walk(node):
+                    if not (isinstance(call, ast.Call)
+                            and isinstance(call.func, ast.Attribute)
+                            and call.func.attr in _FACTORIES):
+                        continue
+                    if id(call) in seen:
+                        continue
+                    seen.add(id(call))
+                    if _carries_labels(call, labeled):
+                        continue
+                    yield core.Finding(
+                        RULE, f.rel, call.lineno,
+                        f"registry.{call.func.attr}() without "
+                        f"election_labels(): this series merges every "
+                        f"tenant's traffic on a shared fleet — pass "
+                        f"election_labels() (or a local assigned from "
+                        f"it) so per-tenant SLOs and noisy-neighbor "
+                        f"attribution can split it")
+    return

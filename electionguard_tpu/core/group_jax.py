@@ -268,8 +268,11 @@ class JaxGroupOps:
     # fixed-base tables (PowRadix)
     # ------------------------------------------------------------------
     def _table_fingerprint(self, kind: str, base: int) -> str:
+        # keyed by GROUP digest + base digest + geometry, nothing else:
+        # no election id, manifest, or tenant component — concurrent
+        # elections over one group share entries (table_cache contract)
         return table_cache.fingerprint(
-            kind, p=table_cache.int_digest(self.group.p),
+            kind, group=table_cache.group_digest(self.group),
             base=table_cache.int_digest(base % self.group.p),
             nwin8=self.nwin8, n=self.n)
 

@@ -217,6 +217,9 @@ def _build_ntt_arrays(p: int) -> dict:
 @functools.lru_cache(maxsize=None)
 def make_ntt_ctx(p: int) -> NttCtx:
     mctx = bn.make_mont_ctx(p, NL)
+    # keyed by the modulus digest + engine geometry only — the arrays
+    # are pure functions of p, so every tenant (and every election key)
+    # over one group shares this entry (table_cache contract)
     fp = table_cache.fingerprint(
         "nttctx", p=table_cache.int_digest(p), nl=NL, nd=ND, nc=NC,
         primes=list(PRIMES), omega=[OMEGA[m] for m in PRIMES])

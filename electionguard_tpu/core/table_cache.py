@@ -44,7 +44,21 @@ from electionguard_tpu.utils import knobs
 
 log = logging.getLogger(__name__)
 
-VERSION = 1
+VERSION = 2
+
+
+def group_digest(group) -> str:
+    """Stable digest of a GroupContext's defining constants (p, q, g).
+
+    Setup-table fingerprints key on THIS — never on an election id,
+    manifest hash, or any key-ceremony output — so N concurrent tenants
+    running elections over the same group share every powradix/nttctx
+    cache entry byte-for-byte.  That sharing is the multi-tenant cache
+    contract: the N-tenant drill asserts cross-tenant ``hits`` > 0."""
+    blob = b"".join(
+        x.to_bytes(max(1, (x.bit_length() + 7) // 8), "little")
+        for x in (group.p, group.q, group.g))
+    return hashlib.sha256(blob).hexdigest()
 
 _stats = {"hits": 0, "misses": 0, "writes": 0, "errors": 0}
 

@@ -37,8 +37,10 @@ from electionguard_tpu import obs
 from electionguard_tpu.core.group import GroupContext
 from electionguard_tpu.crypto import validate
 from electionguard_tpu.obs import REGISTRY, election_labels
+from electionguard_tpu.obs import tenant as obs_tenant
 from electionguard_tpu.publish import pb
 from electionguard_tpu.remote import rpc_util
+from electionguard_tpu.serve.tenants import TenantQuota, TenantQuotaError
 from electionguard_tpu.utils import clock, knobs
 
 log = logging.getLogger("fabric.router")
@@ -51,12 +53,17 @@ class _Shard:
     """Router-side handle for one registered encryption worker."""
 
     def __init__(self, shard_id: int, worker_id: str, url: str,
-                 nonce: bytes, public_key: bytes):
+                 nonce: bytes, public_key: bytes,
+                 elections: frozenset = frozenset()):
         self.shard_id = shard_id
         self.worker_id = worker_id
         self.url = url
         self.reg_nonce = nonce
         self.public_key = public_key
+        #: elections this shard serves; empty = every election (shared
+        #: pool).  Routing intersects the request's ambient election
+        #: with this set, so dedicated and shared shards coexist.
+        self.elections = elections
         self.live = False          # at least one health success, not evicted
         self.evicted = False
         self.fail_count = 0
@@ -68,6 +75,9 @@ class _Shard:
         self.requeued: list[str] = []
         self._channel = None
         self._stub: Optional[rpc_util.Stub] = None
+
+    def serves(self, election: str) -> bool:
+        return not self.elections or election in self.elections
 
     def stub(self) -> rpc_util.Stub:
         if self._stub is None:
@@ -116,16 +126,11 @@ class EncryptionRouter:
         self._fwd_policy = rpc_util.RetryPolicy(
             attempts=1, base_wait=0.1, max_wait=0.1,
             connect_window=self._health_timeout, budget=0.0)
-        _el = election_labels()   # per-tenant series on a shared fleet
-        self._c_requeues = REGISTRY.counter("fabric_requeues_total", _el)
-        self._c_evictions = REGISTRY.counter("fabric_evictions_total",
-                                             _el)
-        self._c_readmissions = REGISTRY.counter(
-            "fabric_readmissions_total", _el)
-        self._c_saturated = REGISTRY.counter(
-            "fabric_rejects_saturated_total", _el)
-        self._c_no_shards = REGISTRY.counter(
-            "fabric_rejects_no_live_shards_total", _el)
+        # per-tenant admission quota over the whole fleet (the serving
+        # processes enforce their own copy; the router's is the front
+        # line, so a flooding election is shed before it ever crosses
+        # the wire to a worker)
+        self._tenant_quota = TenantQuota()
         self.server, self.port = rpc_util.make_server(
             port, max_workers=max_workers)
         self.url = f"localhost:{self.port}"
@@ -146,6 +151,15 @@ class EncryptionRouter:
         log.info("fabric router listening on %d (health every %.1fs, "
                  "evict after %d misses)", self.port,
                  self._health_interval, self._evict_after)
+
+    @staticmethod
+    def _c(name: str):
+        """Fabric counter resolved PER EVENT against the ambient tenant
+        context (the registry get-or-creates by flat name), so the same
+        event series splits cleanly per election on a shared fleet —
+        binding once at __init__ would pin every tenant's events to the
+        election the router happened to start under."""
+        return REGISTRY.counter(name, election_labels())
 
     # ---- registration ------------------------------------------------
     def _register(self, request, context):
@@ -195,6 +209,7 @@ class EncryptionRouter:
                 s.url = request.remote_url
                 s.reg_nonce = nonce
                 s.public_key = bytes(request.manifest_public_key)
+                s.elections = frozenset(request.election_ids)
                 s.close()
                 s.live = False
                 s.evicted = False
@@ -204,7 +219,8 @@ class EncryptionRouter:
                             requeued_ballot_ids=s.requeued,
                             constants=constants)
             shard = _Shard(len(self.shards), wid, request.remote_url,
-                           nonce, bytes(request.manifest_public_key))
+                           nonce, bytes(request.manifest_public_key),
+                           elections=frozenset(request.election_ids))
             self.shards.append(shard)
             log.info("registered encryption worker %s as shard %d at %s",
                      wid, shard.shard_id, shard.url)
@@ -254,7 +270,7 @@ class EncryptionRouter:
             s.queue_depth = h.queue_depth
             if s.evicted:
                 s.evicted = False
-                self._c_readmissions.inc()
+                self._c("fabric_readmissions_total").inc()
                 log.info("shard %d readmitted (status=%s depth=%d)",
                          s.shard_id, h.status, h.queue_depth)
             if not s.live:
@@ -268,17 +284,20 @@ class EncryptionRouter:
         s.live = False
         s.evicted = True
         s.close()
-        self._c_evictions.inc()
+        self._c("fabric_evictions_total").inc()
         log.warning("evicted shard %d (%s): %s", s.shard_id, s.worker_id,
                     reason)
 
     # ---- routing -----------------------------------------------------
-    def _pick(self, tried: set[int]) -> Optional[_Shard]:
-        """Least-loaded live shard not yet tried and under the in-flight
-        cap; claims one in-flight slot under the lock."""
+    def _pick(self, tried: set[int],
+              election: str = "") -> Optional[_Shard]:
+        """Least-loaded live shard serving ``election``, not yet tried
+        and under the in-flight cap; claims one in-flight slot under the
+        lock."""
         with self._lock:
             candidates = [s for s in self.shards
                           if s.live and s.shard_id not in tried
+                          and s.serves(election)
                           and s.in_flight < self._max_inflight]
             if not candidates:
                 return None
@@ -302,31 +321,55 @@ class EncryptionRouter:
                timeout: float):
         """Forward ``request`` to shards in load order until one answers.
 
-        RESOURCE_EXHAUSTED tries the next shard; a transport failure
-        evicts the shard and requeues (recording ``ballot_ids`` against
-        it so the worker's recovery skips them).  Aborts
-        RESOURCE_EXHAUSTED only when every reachable shard is saturated,
-        UNAVAILABLE when none is reachable at all.
+        The request's ambient election (gRPC metadata → ``obs.tenant``)
+        scopes everything: only shards serving it are candidates, and
+        the per-tenant admission quota (EGTPU_TENANT_QUOTA) sheds THAT
+        election's overflow — RESOURCE_EXHAUSTED naming the tenant —
+        before a single forward leaves the router.
+
+        RESOURCE_EXHAUSTED from a worker tries the next shard; a
+        transport failure evicts the shard and requeues (recording
+        ``ballot_ids`` against it so the worker's recovery skips them).
+        Aborts RESOURCE_EXHAUSTED only when every reachable shard is
+        saturated, UNAVAILABLE when none is reachable at all.
         """
+        election = obs_tenant.current_election()
+        try:
+            quota_release = self._tenant_quota.acquire(election)
+        except TenantQuotaError as e:
+            self._c("fabric_rejects_tenant_quota_total").inc()
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        try:
+            return self._route_inner(method, request, context,
+                                     ballot_ids, timeout, election)
+        finally:
+            if quota_release is not None:
+                quota_release()
+
+    def _route_inner(self, method: str, request, context, ballot_ids,
+                     timeout: float, election: str):
         tried: set[int] = set()
         n_exhausted = 0
         while True:
-            shard = self._pick(tried)
+            shard = self._pick(tried, election)
             if shard is None:
                 with self._lock:
-                    any_live = any(s.live for s in self.shards)
+                    any_live = any(s.live and s.serves(election)
+                                   for s in self.shards)
                 if n_exhausted or any_live:
                     # a live shard we can't route to is a saturated one:
                     # either its worker said RESOURCE_EXHAUSTED or the
                     # router's own in-flight cap is the bound
-                    self._c_saturated.inc()
+                    self._c("fabric_rejects_saturated_total").inc()
                     context.abort(
                         grpc.StatusCode.RESOURCE_EXHAUSTED,
                         f"fleet saturated: {n_exhausted} shard(s) "
                         f"exhausted, none under the in-flight cap")
-                self._c_no_shards.inc()
+                self._c("fabric_rejects_no_live_shards_total").inc()
                 context.abort(grpc.StatusCode.UNAVAILABLE,
-                              "no live encryption workers")
+                              "no live encryption workers"
+                              + (f" serving election {election!r}"
+                                 if election else ""))
             tried.add(shard.shard_id)
             try:
                 return shard.stub().call(method, request, timeout=timeout,
@@ -343,7 +386,7 @@ class EncryptionRouter:
                 with self._lock:
                     self._evict_locked(shard, f"{method}: {code}")
                     shard.requeued.extend(ballot_ids)
-                    self._c_requeues.inc(len(ballot_ids))
+                    self._c("fabric_requeues_total").inc(len(ballot_ids))
                 log.warning("requeued %d ballot(s) away from shard %d "
                             "after %s", len(ballot_ids), shard.shard_id,
                             code)
@@ -376,6 +419,7 @@ class EncryptionRouter:
         with self._lock:
             return [{"shard_id": s.shard_id, "worker_id": s.worker_id,
                      "url": s.url, "live": s.live, "evicted": s.evicted,
+                     "elections": sorted(s.elections),
                      "queue_depth": s.queue_depth,
                      "in_flight": s.in_flight, "forwarded": s.forwarded,
                      "requeued": len(s.requeued)}
@@ -392,13 +436,17 @@ class EncryptionRouter:
 def register_worker(router_url: str, group: GroupContext, worker_id: str,
                     serve_port: int, manifest_public_key: bytes = b"",
                     host: str = "localhost",
-                    timeout: float = 120.0) -> tuple[int, list[str]]:
+                    timeout: float = 120.0,
+                    election_ids=()) -> tuple[int, list[str]]:
     """Worker-side reverse dial: register with the router (retrying while
     it is unreachable), returning ``(shard_id, requeued_ballot_ids)`` —
     the shard this worker owns and the admissions the router moved to
     surviving shards while a previous incarnation was down.  One nonce
     per process: a lost-response retry replays idempotently, a relaunch
-    (fresh nonce, same ``worker_id``) reclaims the shard."""
+    (fresh nonce, same ``worker_id``) reclaims the shard.
+    ``election_ids``: the elections this worker serves (empty = all) —
+    the router routes a request only to shards whose set contains its
+    ambient election."""
     nonce = os.urandom(16)
     deadline = clock.monotonic() + timeout
     channel = rpc_util.make_channel(router_url)
@@ -413,7 +461,8 @@ def register_worker(router_url: str, group: GroupContext, worker_id: str,
                         remote_url=f"{host}:{serve_port}",
                         group_fingerprint=group.fingerprint(),
                         registration_nonce=nonce,
-                        manifest_public_key=manifest_public_key))
+                        manifest_public_key=manifest_public_key,
+                        election_ids=list(election_ids)))
             except grpc.RpcError:
                 if clock.monotonic() >= deadline:
                     raise

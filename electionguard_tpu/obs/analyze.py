@@ -127,6 +127,9 @@ class RunAnalysis:
     midrun_recompiles: list[dict] = field(default_factory=list)
     heartbeats: list[dict] = field(default_factory=list)
     queue_max: dict = field(default_factory=dict)       # proc -> depth
+    #: election -> {n_batches, device_us, share}: device time attributed
+    #: per tenant from the ``election`` attr on device-batch spans
+    tenants: dict = field(default_factory=dict)
     alerts: list[dict] = field(default_factory=list)    # slo.alert spans
     antipatterns: list[dict] = field(default_factory=list)
 
@@ -160,6 +163,8 @@ class RunAnalysis:
                         "max_us": s.max_us, "queue_max": s.queue_max}
                        for s in self.shards],
             "stragglers": self.stragglers,
+            "tenants": [{"election": el, **stats}
+                        for el, stats in sorted(self.tenants.items())],
             "recompiles_total": self.recompiles_total,
             "recompile_us": self.recompile_us,
             "midrun_recompiles": self.midrun_recompiles,
@@ -479,6 +484,24 @@ def analyze(trace_dir: str, top_n: Optional[int] = None,
                               f"{s.mean_us / 1e3:.1f} ms vs fleet median "
                               f"{median / 1e3:.1f} ms "
                               f"({s.mean_us / median:.1f}x)"})
+
+    # ---- per-tenant device-time attribution ---------------------------
+    # device-batch spans carry an ``election`` attr (serve/worker stamps
+    # it per lane); bucketing by it answers "who used the device" even
+    # for runs with no metrics snapshot — hostile election ids are plain
+    # JSON attr values here, no exposition escaping involved
+    per_tenant: dict[str, list[int]] = {}
+    for s in closed:
+        if s["name"] in _DEVICE_BATCHES:
+            el = (s.get("attrs") or {}).get("election")
+            if el is not None:
+                per_tenant.setdefault(str(el), []).append(s.get("dur", 0))
+    tenant_total = sum(sum(v) for v in per_tenant.values())
+    for el, durs in per_tenant.items():
+        a.tenants[el] = {
+            "n_batches": len(durs), "device_us": sum(durs),
+            "share": (round(sum(durs) / tenant_total, 4)
+                      if tenant_total else 0.0)}
 
     # ---- slo.alert spans recorded in the timeline ---------------------
     a.alerts = [s for s in closed if s["name"] == "slo.alert"]

@@ -68,12 +68,14 @@ def unescape_label_value(v: str) -> str:
 
 def election_labels(extra: Optional[dict] = None) -> dict:
     """The per-tenant label set election-scoped series carry: the
-    ``EGTPU_ELECTION`` knob (``default`` in the single-election case)
-    as ``election=<id>``, plus any site-specific labels.  Threading
-    this through serve/fabric/mixfed counters is the seed for
-    multi-election SLO evaluation (obs/slo.py) over one fleet."""
-    from electionguard_tpu.utils import knobs
-    labels = {"election": knobs.get_str("EGTPU_ELECTION")}
+    AMBIENT election id (``obs.tenant`` contextvar, set per request by
+    the router/service; the ``EGTPU_ELECTION`` knob — ``default`` out
+    of the box — when no scope is active) as ``election=<id>``, plus
+    any site-specific labels.  Resolve at WRITE time, not registration
+    time: one process serving N tenants labels each increment with the
+    requesting election, never a process-global."""
+    from electionguard_tpu.obs import tenant
+    labels = {"election": tenant.current_election()}
     if extra:
         labels.update(extra)
     return labels

@@ -30,6 +30,15 @@ env var.  Objectives:
   yet verified); a lag past the objective means the auditor has fallen
   behind the election it is supposed to be watching.  ``objective:
   null`` (the default) resolves the ``EGTPU_LIVE_AUDIT_LAG_MAX`` knob;
+* ``noisy_neighbor`` — multi-tenant attribution: per-election device
+  time (``tenant_device_ms_total{election=...}``, written by the serve
+  worker) is joined against per-election SLO burn.  When some election
+  is burning a tenant-scoped objective (a VICTIM) while ANOTHER
+  election holds more than ``share`` of the fleet's device time over
+  the trailing ``window_s`` (the OFFENDER), the alert names the
+  offender — the tenant to throttle — not the victim that paged.
+  ``share``/``window_s`` default to the ``EGTPU_TENANT_NOISY_SHARE`` /
+  ``EGTPU_TENANT_NOISY_WINDOW`` knobs;
 * ``heartbeat`` — liveness: a process that misses ``miss_threshold``
   consecutive heartbeat intervals without having said goodbye
   (status EXITING) is declared dead.  This fires in
@@ -64,6 +73,17 @@ DEFAULT_SLO: dict = {
         "objective": 5000.0,
         # histogram base names checked against the merged snapshot
         "histograms": ["request_latency_ms"],
+        # tenant-scoped overrides: {election_id: objective_ms}.  Every
+        # election-labeled latency series is already checked separately
+        # (one SLO instance per tenant); this pins a DIFFERENT objective
+        # for specific elections on the same fleet.
+        "per_election": {},
+    },
+    "noisy_neighbor": {
+        # None -> resolved from EGTPU_TENANT_NOISY_SHARE /
+        # EGTPU_TENANT_NOISY_WINDOW at evaluation time
+        "share": None,
+        "window_s": None,
     },
     "queue_depth_max": 256,
     "stage_lag_s": 300.0,
@@ -142,7 +162,7 @@ class Alert:
     lands verbatim on the alert span."""
 
     kind: str       # heartbeat_miss | availability_burn | serving_p99 |
-    #                 queue_depth | stage_lag
+    #                 queue_depth | stage_lag | audit_lag | noisy_neighbor
     subject: str    # process role / deadline class / histogram name
     detail: str
     t: float
@@ -169,6 +189,9 @@ class SLOEngine:
         self._active: dict[str, Alert] = {}
         #: per deadline class: deque[(t, calls, failures)] cumulative
         self._avail: dict[str, deque] = {}
+        #: per election: deque[(t, cumulative device ms)] — the trailing
+        #: window the noisy-neighbor share is computed over
+        self._device_ms: dict[str, deque] = {}
         self._method_class = method_class or _default_method_class
 
     # ---- evaluation --------------------------------------------------
@@ -187,6 +210,8 @@ class SLOEngine:
         fired += self._check_queues(t, processes)
         fired += self._check_stage_lag(t, processes)
         fired += self._check_audit_lag(t, metrics)
+        # last, so it sees this tick's victim alerts in self._active
+        fired += self._check_noisy_neighbor(t, metrics)
         self.fired.extend(fired)
         return fired
 
@@ -261,16 +286,78 @@ class SLOEngine:
         cfg = self.config["serving_p99_ms"]
         out = []
         for flat, hist in metrics.get("histograms", {}).items():
-            name, _ = parse_labels(flat)
+            name, labels = parse_labels(flat)
             if name not in cfg["histograms"]:
                 continue
+            # one SLO instance per series: an election-labeled latency
+            # histogram is ONE tenant's p99, checked against that
+            # tenant's objective (per_election override, else fleet)
+            election = labels.get("election", "")
+            objective = cfg.get("per_election", {}).get(election,
+                                                        cfg["objective"])
             p99 = histogram_quantile(hist, 0.99)
-            out += self._fire(p99 > cfg["objective"],
-                              lambda flat=flat, p99=p99: Alert(
+            out += self._fire(p99 > objective,
+                              lambda flat=flat, p99=p99,
+                              objective=objective, election=election:
+                              Alert(
                 "serving_p99", flat,
-                f"p99 {p99:.0f}ms > objective {cfg['objective']:.0f}ms",
+                f"p99 {p99:.0f}ms > objective {objective:.0f}ms",
                 t, attrs={"p99_ms": p99,
-                          "objective_ms": cfg["objective"]}))
+                          "objective_ms": objective,
+                          "election": election}))
+        return out
+
+    def _check_noisy_neighbor(self, t: float, metrics) -> list[Alert]:
+        """Attribution, not detection: the per-tenant checks say WHO is
+        hurting; this one says who is CAUSING it.  An offender is an
+        election holding ≥ ``share`` of the fleet's device time over
+        the trailing window while a DIFFERENT election (the victim)
+        burns a tenant-scoped SLO."""
+        cfg = self.config["noisy_neighbor"]
+        share_min, window = cfg["share"], cfg["window_s"]
+        if share_min is None or window is None:
+            from electionguard_tpu.utils import knobs
+            if share_min is None:
+                share_min = knobs.get_float("EGTPU_TENANT_NOISY_SHARE")
+            if window is None:
+                window = knobs.get_float("EGTPU_TENANT_NOISY_WINDOW")
+        # cumulative per-election device time from the merged counters
+        cum: dict[str, float] = {}
+        for flat, v in metrics.get("counters", {}).items():
+            name, labels = parse_labels(flat)
+            if name == "tenant_device_ms_total":
+                el = labels.get("election", "")
+                cum[el] = cum.get(el, 0.0) + v
+        deltas: dict[str, float] = {}
+        for el, v in cum.items():
+            hist = self._device_ms.setdefault(el, deque())
+            hist.append((t, v))
+            while hist and hist[0][0] < t - window - 1:
+                hist.popleft()
+            start = next((s for s in hist if s[0] >= t - window), None)
+            if start is not None:
+                deltas[el] = max(0.0, v - start[1])
+        total = sum(deltas.values())
+        # victims: elections currently burning a tenant-scoped alert
+        victims = {a.attrs["election"] for a in self._active.values()
+                   if a.attrs.get("election")}
+        out = []
+        for offender in sorted(self._device_ms):
+            share = (deltas.get(offender, 0.0) / total) if total > 0 \
+                else 0.0
+            victs = sorted(v for v in victims if v != offender)
+            noisy = bool(victs) and share >= share_min
+            out += self._fire(noisy, lambda offender=offender,
+                              share=share, victs=victs: Alert(
+                "noisy_neighbor", offender,
+                f"election {offender!r} holds {share:.0%} of fleet "
+                f"device time over the last {window:.0f}s while "
+                f"{', '.join(repr(v) for v in victs)} burns its SLO",
+                t, attrs={"offender": offender,
+                          "victim": victs[0] if victs else "",
+                          "victims": list(victs),
+                          "share": round(share, 3),
+                          "window_s": window}))
         return out
 
     def _check_queues(self, t: float, processes) -> list[Alert]:

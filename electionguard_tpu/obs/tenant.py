@@ -1,0 +1,187 @@
+"""Ambient per-request tenant (election) context.
+
+One process serving N overlapping elections must label every metric,
+span, and log line with the election the CURRENT request belongs to —
+without threading an election id through every call signature.  This
+module is that ambient channel, built exactly like ``obs.trace``:
+
+* across **threads/frames**: a ``contextvars`` var — ``tenant_scope``
+  sets the election id for everything the enclosed code does, and
+  ``current_election()`` resolves it (falling back to the
+  ``EGTPU_ELECTION`` knob, so a single-tenant deployment never touches
+  a contextvar);
+* across **processes over gRPC**: the client interceptor stamps the
+  active election id onto the call metadata (binary key, so hostile
+  ids with newlines survive) and the server wrapper adopts it — hooked
+  at the same ``rpc_util.make_channel``/``generic_service`` points as
+  the trace/fault interceptors, zero call-site changes;
+* under the **sim transport** nothing is needed: the sim dispatches
+  handlers inline on the caller's task, so the contextvar itself
+  propagates client → server.
+
+``registry.election_labels()`` resolves through ``current_election``,
+so every call site that already labels its series per election becomes
+multi-tenant-correct the moment a router/service wraps request
+handling in a ``tenant_scope``.
+
+Cardinality guard: the set of distinct election ids one process will
+label series with is bounded by ``EGTPU_TENANT_MAX``.  A hostile or
+misconfigured client cycling fresh ids would otherwise mint unbounded
+metric series (the classic label-cardinality explosion); past the
+bound, ``tenant_scope`` raises the named ``tenant.cardinality`` error
+instead of admitting the id.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+from typing import Iterator, Optional
+
+import grpc
+
+from electionguard_tpu.utils import errors
+
+#: gRPC metadata key carrying the election id.  The ``-bin`` suffix
+#: makes it binary-valued metadata: arbitrary utf-8 (commas, quotes,
+#: newlines — hostile-id tests exercise all of them) round-trips where
+#: ASCII metadata would be rejected by the transport.
+MD_ELECTION = "egtpu-election-bin"
+
+_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "egtpu_tenant", default=None)
+_lock = threading.Lock()
+#: distinct election ids this process has labeled anything with
+_seen: set[str] = set()
+
+
+class TenantCardinalityError(RuntimeError):
+    """Raised when a process would exceed ``EGTPU_TENANT_MAX`` distinct
+    election ids — the bounded-label-set guard."""
+
+
+def current_election() -> str:
+    """The election id of the ambient request context, falling back to
+    the ``EGTPU_ELECTION`` knob (``default`` out of the box)."""
+    t = _ctx.get()
+    if t is not None:
+        return t
+    from electionguard_tpu.utils import knobs
+    return knobs.get_str("EGTPU_ELECTION")
+
+
+def seen_elections() -> frozenset:
+    """The distinct election ids admitted by this process so far."""
+    with _lock:
+        return frozenset(_seen)
+
+
+def admit(election_id: str) -> str:
+    """Count ``election_id`` against the per-process tenant bound;
+    raises the named ``tenant.cardinality`` error past
+    ``EGTPU_TENANT_MAX`` distinct ids.  Idempotent per id."""
+    from electionguard_tpu.utils import knobs
+    with _lock:
+        if election_id in _seen:
+            return election_id
+        cap = knobs.get_int("EGTPU_TENANT_MAX")
+        if len(_seen) >= cap:
+            raise TenantCardinalityError(errors.named(
+                "tenant.cardinality",
+                f"election id {election_id!r} would be distinct tenant "
+                f"#{len(_seen) + 1} in this process but EGTPU_TENANT_MAX"
+                f"={cap}; raise the knob or fix the client"))
+        _seen.add(election_id)
+    return election_id
+
+
+@contextlib.contextmanager
+def tenant_scope(election_id: str) -> Iterator[str]:
+    """Make ``election_id`` the ambient election for the enclosed code
+    (and everything it calls, including onward rpcs).  Applies the
+    cardinality guard on entry."""
+    admit(election_id)
+    token = _ctx.set(election_id)
+    try:
+        yield election_id
+    finally:
+        _ctx.reset(token)
+
+
+def _reset_for_tests() -> None:
+    """Clear the seen-tenant set (tests only)."""
+    with _lock:
+        _seen.clear()
+
+
+# ---------------------------------------------------------------------------
+# gRPC propagation (real transport only — the sim dispatches inline and
+# the contextvar flows by itself)
+# ---------------------------------------------------------------------------
+
+class _CallDetails(grpc.ClientCallDetails):
+    __slots__ = ("method", "timeout", "metadata", "credentials",
+                 "wait_for_ready", "compression")
+
+    def __init__(self, base, metadata):
+        self.method = base.method
+        self.timeout = base.timeout
+        self.metadata = metadata
+        self.credentials = base.credentials
+        self.wait_for_ready = getattr(base, "wait_for_ready", None)
+        self.compression = getattr(base, "compression", None)
+
+
+class TenantClientInterceptor(grpc.UnaryUnaryClientInterceptor):
+    """Stamps the ambient election id (when one is set — a single-tenant
+    caller with no scope active stamps nothing) onto outgoing rpc
+    metadata for the server wrapper to adopt."""
+
+    def intercept_unary_unary(self, continuation, client_call_details,
+                              request):
+        election = _ctx.get()
+        if election is None:
+            outcome = continuation(client_call_details, request)
+        else:
+            md = list(client_call_details.metadata or ())
+            md.append((MD_ELECTION, election.encode("utf-8")))
+            outcome = continuation(_CallDetails(client_call_details, md),
+                                   request)
+        # grpc's continuation wrapper converts an error RAISED by an
+        # inner interceptor (the fault injector) into a returned
+        # outcome; a raw RpcError is not a call — re-raise it so it
+        # propagates to the caller exactly as it did before this layer
+        # existed, instead of dying on ``outcome.result()`` upstream
+        if isinstance(outcome, grpc.RpcError) \
+                and not hasattr(outcome, "result"):
+            raise outcome
+        return outcome
+
+
+def intercept_channel(channel: grpc.Channel) -> grpc.Channel:
+    """Wrap ``channel`` with the tenant interceptor."""
+    return grpc.intercept_channel(channel, TenantClientInterceptor())
+
+
+def wrap_server_method(fn):
+    """Wrap one ``fn(request, context)`` impl so it runs under the
+    caller's election scope when the rpc metadata carries one.  With no
+    tenant metadata the impl runs unchanged — in particular the sim's
+    inline dispatch keeps whatever scope the caller already holds."""
+
+    def scoped(request, context):
+        election: Optional[str] = None
+        for k, v in (context.invocation_metadata() or ()):
+            if k == MD_ELECTION:
+                election = (v.decode("utf-8")
+                            if isinstance(v, (bytes, bytearray)) else str(v))
+        if election is None:
+            return fn(request, context)
+        try:
+            with tenant_scope(election):
+                return fn(request, context)
+        except TenantCardinalityError as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+
+    return scoped

@@ -384,6 +384,12 @@ class TraceClientInterceptor(grpc.UnaryUnaryClientInterceptor):
                 code = None
             if code is not None and code != grpc.StatusCode.OK:
                 s.set("status", code.name)
+            # an inner interceptor's RAISED error comes back as a raw
+            # RpcError outcome (not a call) — re-raise so it reaches
+            # the caller instead of dying on ``outcome.result()``
+            if isinstance(outcome, grpc.RpcError) \
+                    and not hasattr(outcome, "result"):
+                raise outcome
             return outcome
 
 

@@ -22,6 +22,7 @@ import grpc
 from google.protobuf import message_factory
 
 from electionguard_tpu.obs import registry as obs_registry
+from electionguard_tpu.obs import tenant as obs_tenant
 from electionguard_tpu.obs import trace as obs_trace
 from electionguard_tpu.publish import pb
 from electionguard_tpu.testing import faults
@@ -232,6 +233,11 @@ def generic_service(service_name: str,
             # propagate PAST the adversary hook, so an attack whose
             # response never left the server is not recorded as fired
             inner = _adversary_wrap(m.name, inner)
+        # tenant adoption wraps OUTSIDE the impl (and the fault/metric
+        # layers) so every election_labels() resolution below runs
+        # under the requesting election's scope; the trace span wraps
+        # outermost so its subtree also carries the election context
+        inner = obs_tenant.wrap_server_method(inner)
         wrapped = obs_trace.wrap_server_method(service_name, m.name, inner)
         handlers[m.name] = grpc.unary_unary_rpc_method_handler(
             wrapped,
@@ -396,11 +402,12 @@ def make_channel(url: str, max_message: int = MAX_TRUSTEE_MESSAGE,
     if _transport is not None:
         return _transport.channel(url, max_message)
     return obs_trace.intercept_channel(
-        faults.intercept_channel(grpc.insecure_channel(url, options=[
-            ("grpc.max_receive_message_length", max_message),
-            ("grpc.max_send_message_length", max_message),
-            ("grpc.keepalive_time_ms", keepalive_ms),
-        ])))
+        obs_tenant.intercept_channel(
+            faults.intercept_channel(grpc.insecure_channel(url, options=[
+                ("grpc.max_receive_message_length", max_message),
+                ("grpc.max_send_message_length", max_message),
+                ("grpc.keepalive_time_ms", keepalive_ms),
+            ]))))
 
 
 def make_plain_channel(url: str, max_message: int = MAX_TRUSTEE_MESSAGE,

@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 from electionguard_tpu.ballot.plaintext import PlaintextBallot
+from electionguard_tpu.obs import tenant as _tenant
 from electionguard_tpu.utils import clock
 
 
@@ -46,13 +47,17 @@ class DrainingError(Exception):
 
 @dataclass
 class PendingRequest:
-    """One admitted request: the ballot, its completion future, and the
-    admission time (t_enqueue) the latency histogram measures from."""
+    """One admitted request: the ballot, its completion future, the
+    admission time (t_enqueue) the latency histogram measures from, and
+    the election the request belongs to — captured HERE, on the request
+    thread, because the worker thread that later processes the batch
+    has no ambient tenant context of its own."""
 
     ballot: PlaintextBallot
     spoil: bool = False
     future: Future = field(default_factory=Future)
     t_enqueue: float = field(default_factory=clock.monotonic)
+    tenant: str = field(default_factory=_tenant.current_election)
 
 
 def _default_buckets(max_batch: int) -> tuple[int, ...]:

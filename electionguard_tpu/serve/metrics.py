@@ -21,9 +21,12 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from electionguard_tpu.obs import jaxmon
+from electionguard_tpu.obs import tenant as _tenant
 from electionguard_tpu.obs.registry import (Histogram,  # noqa: F401
                                             MetricsRegistry,
                                             election_labels, expose)
+
+_current_election = _tenant.current_election
 
 # default latency edges (ms): log-ish spacing from sub-ms to minutes
 _LATENCY_MS_BOUNDS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
@@ -50,23 +53,36 @@ class ServiceMetrics:
                 "ballots_encrypted", "ballots_invalid", "ballots_spoiled",
                 "ballots_recovered", "batches_flushed", "padded_slots")
 
+    #: histogram families and their bucket edges — every instance is
+    #: election-labeled (one histogram per family per tenant)
+    HISTOGRAMS = {"request_latency_ms": _LATENCY_MS_BOUNDS,
+                  "batch_occupancy": _OCCUPANCY_BOUNDS,
+                  "queue_depth_at_flush": _DEPTH_BOUNDS}
+
     def __init__(self, queue_depth: Optional[Callable[[], int]] = None,
                  registry: Optional[MetricsRegistry] = None):
         self.registry = expose(registry if registry is not None
                                else MetricsRegistry("serve"))
-        # every ballot-flow counter carries the election tenant label
-        # (EGTPU_ELECTION; "default" when a deployment serves one
-        # election) so a shared fleet's scrape stays per-tenant
-        labels = election_labels()
-        self._counters = {name: self.registry.counter(name, labels)
+        # every ballot-flow series carries the election tenant label,
+        # resolved at WRITE time from the ambient tenant context (the
+        # EGTPU_ELECTION knob when no request scope is active) — one
+        # service instance serving N elections keeps N disjoint series
+        # sets.  The small (name, election) cache keeps the hot path at
+        # one dict probe instead of a registry lock per increment.
+        el = _current_election()
+        self._counters = {(name, el): self.registry.counter(
+                              name, election_labels({"election": el}))
                           for name in self.COUNTERS}
+        self._hists = {(name, el): self.registry.histogram(
+                           name, bounds,
+                           election_labels({"election": el}))
+                       for name, bounds in self.HISTOGRAMS.items()}
+        self._device_ms: dict = {}
         self._queue_depth = queue_depth
-        self.latency_ms = self.registry.histogram("request_latency_ms",
-                                                  _LATENCY_MS_BOUNDS)
-        self.batch_occupancy = self.registry.histogram("batch_occupancy",
-                                                       _OCCUPANCY_BOUNDS)
-        self.queue_depth_at_flush = self.registry.histogram(
-            "queue_depth_at_flush", _DEPTH_BOUNDS)
+        self.latency_ms = self.histogram_for("request_latency_ms")
+        self.batch_occupancy = self.histogram_for("batch_occupancy")
+        self.queue_depth_at_flush = self.histogram_for(
+            "queue_depth_at_flush")
         install_compile_listener()
         self._compiles_at_start = device_compile_count()
         if queue_depth is not None:
@@ -77,23 +93,85 @@ class ServiceMetrics:
             fn=lambda: device_compile_count() - self._compiles_at_start)
 
     # ---- writers -----------------------------------------------------
-    def inc(self, name: str, by: int = 1) -> None:
-        self._counters[name].inc(by)
+    def inc(self, name: str, by: int = 1,
+            election: Optional[str] = None) -> None:
+        if election is None:
+            election = _current_election()
+        c = self._counters.get((name, election))
+        if c is None:
+            if name not in self.COUNTERS:
+                raise KeyError(name)
+            c = self._counters[(name, election)] = self.registry.counter(
+                name, election_labels({"election": election}))
+        c.inc(by)
 
     def get(self, name: str) -> int:
-        return self._counters[name].value
+        """Counter total summed across every tenant's series."""
+        return sum(c.value for (n, _), c in self._counters.items()
+                   if n == name)
 
-    def observe_flush(self, n_real: int, bucket: int,
-                      queue_depth: int) -> None:
-        self.inc("batches_flushed")
-        self.inc("padded_slots", bucket - n_real)
-        self.batch_occupancy.observe(n_real / bucket)
-        self.queue_depth_at_flush.observe(float(queue_depth))
+    def inc_device_ms(self, ms: float,
+                      election: Optional[str] = None) -> None:
+        """Per-tenant device-time attribution: cumulative milliseconds
+        the device owner spent on this election's batches — the
+        ``tenant_device_ms_total{election=...}`` series the
+        noisy-neighbor detector (obs/slo) reads."""
+        if election is None:
+            election = _current_election()
+        c = self._device_ms.get(election)
+        if c is None:
+            c = self._device_ms[election] = self.registry.counter(
+                "tenant_device_ms_total",
+                election_labels({"election": election}))
+        c.inc(ms)
+
+    def histogram_for(self, name: str,
+                      election: Optional[str] = None) -> Histogram:
+        """The ``name`` histogram of one tenant (ambient by default)."""
+        if election is None:
+            election = _current_election()
+        h = self._hists.get((name, election))
+        if h is None:
+            h = self._hists[(name, election)] = self.registry.histogram(
+                name, self.HISTOGRAMS[name],
+                election_labels({"election": election}))
+        return h
+
+    def latency_quantile(self, q: float) -> float:
+        """Cross-tenant q-quantile of request latency (upper-bound
+        estimate over the merged per-tenant buckets)."""
+        hists = [h.snapshot() for (n, _), h in self._hists.items()
+                 if n == "request_latency_ms"]
+        total = sum(h["count"] for h in hists)
+        if total == 0:
+            return 0.0
+        bounds = hists[0]["bounds"]
+        counts = [0] * (len(bounds) + 1)
+        for h in hists:
+            for i, c in enumerate(h["counts"]):
+                counts[i] += c
+        target, seen = q * total, 0
+        for i, c in enumerate(counts):
+            seen += c
+            if seen >= target:
+                return bounds[i] if i < len(bounds) else bounds[-1]
+        return bounds[-1]
+
+    def observe_flush(self, n_real: int, bucket: int, queue_depth: int,
+                      election: Optional[str] = None) -> None:
+        self.inc("batches_flushed", election=election)
+        self.inc("padded_slots", bucket - n_real, election=election)
+        self.histogram_for("batch_occupancy",
+                           election).observe(n_real / bucket)
+        self.histogram_for("queue_depth_at_flush",
+                           election).observe(float(queue_depth))
 
     # ---- readers -----------------------------------------------------
     def counters(self) -> dict:
-        """Counters + point-in-time gauges, as one flat map."""
-        out = {name: c.value for name, c in self._counters.items()}
+        """Counters + point-in-time gauges, as one flat map (counter
+        values summed across tenants — per-tenant series live in the
+        registry snapshot under their {election=...} flat names)."""
+        out = {name: self.get(name) for name in self.COUNTERS}
         out["queue_depth"] = (self._queue_depth()
                               if self._queue_depth else 0)
         out["device_compiles"] = device_compile_count()
@@ -104,8 +182,7 @@ class ServiceMetrics:
     def to_proto(self):
         from electionguard_tpu.publish import pb
         resp = pb.msg("MetricsResponse")(counters=self.counters())
-        for h in (self.latency_ms, self.batch_occupancy,
-                  self.queue_depth_at_flush):
+        for h in list(self._hists.values()):
             s = h.snapshot()
             resp.histograms.add(name=s["name"], bounds=s["bounds"],
                                 counts=s["counts"], sum=s["sum"],
@@ -114,6 +191,10 @@ class ServiceMetrics:
 
     def summary(self) -> str:
         c = self.counters()
+        occ = [h for (n, _), h in self._hists.items()
+               if n == "batch_occupancy"]
+        occ_n = sum(h.snapshot()["count"] for h in occ)
+        occ_sum = sum(h.snapshot()["sum"] for h in occ)
         return (f"admitted={c['requests_admitted']} "
                 f"encrypted={c['ballots_encrypted']} "
                 f"invalid={c['ballots_invalid']} "
@@ -121,8 +202,8 @@ class ServiceMetrics:
                 f"rejected={c['requests_rejected_queue_full']} "
                 f"recovered={c['ballots_recovered']} "
                 f"batches={c['batches_flushed']} "
-                f"occupancy_mean={self.batch_occupancy.mean():.2f} "
-                f"latency_p50={self.latency_ms.quantile(0.5):.0f}ms "
-                f"p99={self.latency_ms.quantile(0.99):.0f}ms "
+                f"occupancy_mean={(occ_sum / occ_n) if occ_n else 0:.2f} "
+                f"latency_p50={self.latency_quantile(0.5):.0f}ms "
+                f"p99={self.latency_quantile(0.99):.0f}ms "
                 f"queue_depth={c['queue_depth']} "
                 f"compiles={c['device_compiles_since_start']}")

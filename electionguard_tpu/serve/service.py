@@ -39,6 +39,8 @@ from electionguard_tpu.serve import journal as wal
 from electionguard_tpu.serve.batcher import (DrainingError, DynamicBatcher,
                                              QueueFullError)
 from electionguard_tpu.serve.metrics import ServiceMetrics
+from electionguard_tpu.serve.tenants import (TenantQuota, TenantQuotaError,
+                                             TenantRegistry)
 from electionguard_tpu.serve.worker import EncryptionWorker, InvalidBallotError
 from electionguard_tpu.utils import clock, errors
 
@@ -75,7 +77,8 @@ class EncryptionService:
                  worker_id: Optional[str] = None,
                  chain_seed: Optional[bytes] = None,
                  skip_ballot_ids: Sequence[str] = (),
-                 manifest_keypair=None):
+                 manifest_keypair=None,
+                 tenants: Optional[TenantRegistry] = None):
         self.init = init
         self.group = group if group is not None else \
             init.joint_public_key.group
@@ -140,13 +143,22 @@ class EncryptionService:
                                       max_wait_ms=max_wait_ms,
                                       max_queue=max_queue, buckets=buckets)
         self.metrics = ServiceMetrics(queue_depth=self.batcher.depth)
+        # multi-tenant mode: tenant lanes ride the SAME batcher, worker,
+        # and compiled bucket programs (the election key is a traced
+        # argument — encrypt/fused.py); what each lane adds is its own
+        # encryptor/seed/stream/code chain.  Per-tenant admission is
+        # bounded by EGTPU_TENANT_QUOTA in-flight requests so one
+        # flooding election sheds ITS OWN load, not the fleet's.
+        self.tenants = tenants
+        self._tenant_quota = TenantQuota()
         self.worker = EncryptionWorker(
             self.batcher, BatchEncryptor(init, self.group, mesh=mesh),
             self.metrics, seed=seed, timestamp=timestamp,
             stream=self._stream, hold=hold,
             code_seed=(code_seed if code_seed is not None
                        else self._chain_seed),
-            hold_after=hold_after)
+            hold_after=hold_after,
+            lanes=tenants.lanes() if tenants is not None else None)
         if prewarm:
             # compile every (program, bucket) pair before the first
             # request: under load the compile counter stays flat
@@ -285,13 +297,28 @@ class EncryptionService:
             errors.reject("serve.reserved_id", msg)
             return None, errors.named("serve.reserved_id", msg)
         try:
+            # per-tenant quota BEFORE the fleet-wide queue: a flooding
+            # election hits ITS cap (RESOURCE_EXHAUSTED naming it) while
+            # other tenants' admissions keep flowing
+            release = self._tenant_quota.acquire()
+        except TenantQuotaError as e:
+            self.metrics.inc("requests_rejected_queue_full")
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        try:
             self.metrics.inc("requests_admitted")
-            return self._admit(ballot, spoil), None
+            fut = self._admit(ballot, spoil)
+            if release is not None:
+                fut.add_done_callback(release)
+            return fut, None
         except QueueFullError as e:
+            if release is not None:
+                release()
             self.metrics.inc("requests_admitted", -1)
             self.metrics.inc("requests_rejected_queue_full")
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except DrainingError as e:
+            if release is not None:
+                release()
             self.metrics.inc("requests_admitted", -1)
             self.metrics.inc("requests_rejected_draining")
             context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
@@ -337,14 +364,26 @@ class EncryptionService:
                 pending.append((None, "ballot id prefix '__pad-' is "
                                       "reserved"))
                 continue
+            release = None
             try:
+                release = self._tenant_quota.acquire()
                 self.metrics.inc("requests_admitted")
-                pending.append((self._admit(ballot, False), None))
+                fut = self._admit(ballot, False)
+                if release is not None:
+                    fut.add_done_callback(release)
+                pending.append((fut, None))
+            except TenantQuotaError as e:
+                self.metrics.inc("requests_rejected_queue_full")
+                pending.append((None, f"RESOURCE_EXHAUSTED: {e}"))
             except QueueFullError as e:
+                if release is not None:
+                    release()
                 self.metrics.inc("requests_admitted", -1)
                 self.metrics.inc("requests_rejected_queue_full")
                 pending.append((None, f"RESOURCE_EXHAUSTED: {e}"))
             except DrainingError as e:
+                if release is not None:
+                    release()
                 self.metrics.inc("requests_admitted", -1)
                 self.metrics.inc("requests_rejected_draining")
                 pending.append((None, f"UNAVAILABLE: {e}"))
@@ -382,6 +421,10 @@ class EncryptionService:
             self._stream = None
             if self.shard_id is not None:
                 self._write_shard_manifest(n_published)
+        if self.tenants is not None:
+            # tenant lanes own their streams; the worker has exited, so
+            # each per-election record is complete and publishable
+            self.tenants.close()
         with self._adm_lock:
             # the admission lock keeps a straggler _admit from appending
             # to a journal we are about to close

@@ -1,0 +1,184 @@
+"""Per-tenant election contexts over one shared serving process.
+
+The multi-tenant serving model: ONE process (one device owner, one
+admission queue, one compiled program set) serves N overlapping
+elections.  What is per-tenant is deliberately small and listed here —
+
+* an ``ElectionContext``: the election's ``ElectionInitialized`` record
+  (its joint key, base hash, guardians), a ``BatchEncryptor`` bound to
+  it, an optional publisher/record stream, and the worker ``Lane``
+  carrying the tenant's seed and confirmation-code chain;
+* metric series: every counter/histogram carries ``election=<id>``
+  (resolved ambiently — ``obs.tenant``);
+* an admission quota (``EGTPU_TENANT_QUOTA``): the max in-flight
+  requests ONE election may hold, so a flooding tenant exhausts its own
+  quota (RESOURCE_EXHAUSTED naming it) instead of the fleet.
+
+Everything else is shared.  In particular the compiled device programs:
+the election key table, seed row, and hash prefix are traced runtime
+arguments of the fused encrypt programs (``encrypt/fused.py``), and the
+PowRadix/NTT setup tables are cached by group digest alone
+(``core/table_cache``), so N tenants over one group cause ZERO
+cross-tenant compile churn — the N-tenant drill pins ``device_compiles``
+flat after warmup.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import threading
+from typing import Optional
+
+from electionguard_tpu.crypto import validate
+from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+from electionguard_tpu.obs import tenant as _tenant
+from electionguard_tpu.publish.election_record import ElectionInitialized
+from electionguard_tpu.publish.publisher import Publisher
+from electionguard_tpu.serve.worker import Lane
+from electionguard_tpu.utils import errors, knobs
+
+
+class TenantQuotaError(Exception):
+    """One election's in-flight admission quota is exhausted — shed THAT
+    tenant's load (RESOURCE_EXHAUSTED naming it), not the fleet's."""
+
+
+def tenant_record_dir(base: str, election_id: str) -> str:
+    """A filesystem-safe per-election record dir under ``base``: a
+    sanitized slug for humans plus an id digest for uniqueness (hostile
+    election ids — quotes, newlines, path separators — collapse to the
+    digest, never to a path traversal)."""
+    slug = re.sub(r"[^A-Za-z0-9_-]+", "_", election_id)[:24].strip("_")
+    digest = hashlib.sha256(election_id.encode()).hexdigest()[:12]
+    return os.path.join(base, f"{slug or 'election'}-{digest}")
+
+
+class ElectionContext:
+    """One tenant's election state over the shared serving process."""
+
+    def __init__(self, election_id: str, init: ElectionInitialized,
+                 group=None, out_dir: Optional[str] = None,
+                 seed=None, mesh=None,
+                 encryptor: Optional[BatchEncryptor] = None):
+        _tenant.admit(election_id)
+        self.election_id = election_id
+        self.init = init
+        self.group = group if group is not None else \
+            init.joint_public_key.group
+        # same ingestion gate the single-tenant service runs at startup:
+        # a smuggled non-subgroup key in ANY tenant's record is rejected
+        # before its encryptor exists
+        validate.gate_elements(
+            self.group,
+            [("joint public key", init.joint_public_key.value)]
+            + [(f"{gr.guardian_id} commitment[{j}]", k.value)
+               for gr in init.guardians
+               for j, k in enumerate(gr.coefficient_commitments)],
+            "serve")
+        # shares jax_ops(group)/the fused program set with every other
+        # tenant on this group; only the key table is per-election
+        self.encryptor = encryptor if encryptor is not None else \
+            BatchEncryptor(init, self.group, mesh=mesh)
+        self.publisher = Publisher(out_dir) if out_dir else None
+        self.stream = None
+        if self.publisher is not None:
+            self.publisher.write_election_initialized(init)
+            self.stream = self.publisher.open_encrypted_ballots(
+                append=True)
+        self.seed = seed if seed is not None else self.group.rand_q()
+        self.lane = Lane(election_id, self.encryptor, self.seed,
+                         self.stream)
+
+    @property
+    def record_dir(self) -> Optional[str]:
+        return self.publisher.dir if self.publisher is not None else None
+
+    def close(self) -> None:
+        """Flush and close the tenant's record stream (idempotent)."""
+        if self.stream is not None:
+            self.stream.close()
+            self.stream = None
+            self.lane.stream = None
+
+
+class TenantRegistry:
+    """The elections one serving process hosts, keyed by election id.
+    Bounded implicitly by ``EGTPU_TENANT_MAX`` (every ``add`` runs the
+    ``obs.tenant`` cardinality guard via ElectionContext)."""
+
+    def __init__(self):
+        self._by_id: dict[str, ElectionContext] = {}
+
+    def add(self, ctx: ElectionContext) -> ElectionContext:
+        if ctx.election_id in self._by_id:
+            raise ValueError(errors.named(
+                "tenant.duplicate",
+                f"election {ctx.election_id!r} already registered"))
+        self._by_id[ctx.election_id] = ctx
+        return ctx
+
+    def get(self, election_id: str) -> Optional[ElectionContext]:
+        return self._by_id.get(election_id)
+
+    def elections(self) -> tuple:
+        return tuple(self._by_id)
+
+    def lanes(self) -> dict:
+        """{election_id: Lane} for the EncryptionWorker."""
+        return {eid: ctx.lane for eid, ctx in self._by_id.items()}
+
+    def close(self) -> None:
+        for ctx in self._by_id.values():
+            ctx.close()
+
+
+class TenantQuota:
+    """Per-election in-flight admission accounting.
+
+    ``acquire()`` charges the AMBIENT election one in-flight slot and
+    returns a release callable (attach it to the request future), or
+    raises ``TenantQuotaError`` at the cap.  Quota 0 (the default)
+    disables accounting entirely — ``acquire`` returns None."""
+
+    def __init__(self, quota: Optional[int] = None):
+        self.quota = quota if quota is not None else \
+            knobs.get_int("EGTPU_TENANT_QUOTA")
+        self._lock = threading.Lock()
+        self._inflight: dict[str, int] = {}
+
+    def inflight(self, election: str) -> int:
+        with self._lock:
+            return self._inflight.get(election, 0)
+
+    def acquire(self, election: Optional[str] = None):
+        if self.quota <= 0:
+            return None
+        if election is None:
+            election = _tenant.current_election()
+        with self._lock:
+            n = self._inflight.get(election, 0)
+            if n >= self.quota:
+                raise TenantQuotaError(errors.named(
+                    "tenant.quota",
+                    f"election {election!r} has {n} in-flight requests "
+                    f"(quota {self.quota})"))
+            self._inflight[election] = n + 1
+
+        released = threading.Event()
+
+        def release(_fut=None) -> None:
+            # idempotent: a future resolved twice (or released by both
+            # an error path and a done-callback) must not undercount
+            if released.is_set():
+                return
+            released.set()
+            with self._lock:
+                left = self._inflight.get(election, 1) - 1
+                if left <= 0:
+                    self._inflight.pop(election, None)
+                else:
+                    self._inflight[election] = left
+
+        return release

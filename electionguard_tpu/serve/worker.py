@@ -47,6 +47,25 @@ class InvalidBallotError(Exception):
     (unknown contest/selection, overvote, duplicate id, ...)."""
 
 
+class Lane:
+    """Per-election encryption state over the SHARED device programs:
+    the tenant's encryptor (same group and manifest shapes — so the
+    same jitted bucket programs, only the traced key table differs),
+    its record stream, and its own seed and confirmation-code chain.
+    One worker drains one batcher into N lanes; each device batch is
+    single-lane, so every tenant's published stream stays exactly what
+    the offline BatchEncryptor would produce for its ballots."""
+
+    __slots__ = ("election", "enc", "seed", "stream", "code_seed")
+
+    def __init__(self, election, enc, seed, stream=None, code_seed=None):
+        self.election = election
+        self.enc = enc
+        self.seed = seed
+        self.stream = stream
+        self.code_seed = code_seed
+
+
 class EncryptionWorker(threading.Thread):
     def __init__(self, batcher: DynamicBatcher, encryptor: BatchEncryptor,
                  metrics: ServiceMetrics,
@@ -55,7 +74,8 @@ class EncryptionWorker(threading.Thread):
                  stream=None,
                  hold: Optional[threading.Event] = None,
                  code_seed: Optional[bytes] = None,
-                 hold_after: Optional[int] = None):
+                 hold_after: Optional[int] = None,
+                 lanes: Optional[dict] = None):
         """``stream``: optional ``EncryptedBallotStream`` every real
         encrypted ballot is appended to (the growing record).
         ``timestamp``: pin the ballot timestamp (tests/differential runs);
@@ -67,7 +87,13 @@ class EncryptionWorker(threading.Thread):
         ``hold_after``: chaos hook — once this many ballots are
         encrypted, the worker stops pulling forever (a deterministic
         stand-in for "the device owner wedged/died mid-stream" that the
-        SIGKILL chaos test arms via EGTPU_CHAOS_HOLD_AFTER_BALLOTS)."""
+        SIGKILL chaos test arms via EGTPU_CHAOS_HOLD_AFTER_BALLOTS).
+        ``lanes``: {election_id: Lane} for multi-tenant serving — a
+        drained flush is regrouped by each request's election and every
+        group encrypts on its own lane; requests whose election has no
+        lane run on the default lane (this worker's own encryptor/
+        stream/chain), which is the entire story when ``lanes`` is
+        None (single-tenant, the legacy behavior)."""
         super().__init__(name="encryption-worker", daemon=True)
         self.batcher = batcher
         self.enc = encryptor
@@ -80,7 +106,9 @@ class EncryptionWorker(threading.Thread):
         from electionguard_tpu.utils import knobs
         self._emulate_device_s = knobs.get_float(
             "EGTPU_FABRIC_EMULATE_DEVICE_MS") / 1e3
-        self._code_seed: Optional[bytes] = code_seed
+        self._default_lane = Lane("", encryptor, self.seed, stream,
+                                  code_seed)
+        self.lanes: dict[str, Lane] = dict(lanes) if lanes else {}
         self._pad_counter = 0
         self._filler_proto = self._make_filler_proto()
         self.error: Optional[BaseException] = None
@@ -110,9 +138,11 @@ class EncryptionWorker(threading.Thread):
         """Encrypt one all-filler batch per bucket: compiles every
         (program, bucket shape) pair up front.  Filler-only batches have
         no real ballots, so neither the code chain nor the record stream
-        moves."""
+        moves.  One prewarm covers EVERY lane: the election key is a
+        traced argument of the fused programs, so tenant lanes reuse
+        the same compiled bucket set (device_compiles stays flat)."""
         for bucket in self.batcher.buckets:
-            self._encrypt([], bucket)
+            self._encrypt([], bucket, self._default_lane)
 
     def run(self) -> None:
         while True:
@@ -137,12 +167,13 @@ class EncryptionWorker(threading.Thread):
                 log.exception("batch processing failed")
 
     # ---- the hot path ------------------------------------------------
-    def _encrypt(self, real: list[PendingRequest], bucket: int):
+    def _encrypt(self, real: list[PendingRequest], bucket: int,
+                 lane: Lane):
         ballots = [p.ballot for p in real]
         fillers = [self._filler() for _ in range(bucket - len(ballots))]
         spoiled = {p.ballot.ballot_id for p in real if p.spoil}
-        encrypted, invalid = self.enc.encrypt_ballots(
-            ballots + fillers, seed=self.seed, code_seed=self._code_seed,
+        encrypted, invalid = lane.enc.encrypt_ballots(
+            ballots + fillers, seed=lane.seed, code_seed=lane.code_seed,
             spoiled_ids=spoiled, timestamp=self.timestamp)
         filler_ids = {f.ballot_id for f in fillers}
         # fillers sit at the tail of the valid list, so the real prefix
@@ -163,39 +194,77 @@ class EncryptionWorker(threading.Thread):
         return real_encrypted, invalid, spoiled
 
     def _process(self, batch: list[PendingRequest], clock) -> None:
-        bucket = self.batcher.bucket_for(len(batch))
         depth = self.batcher.depth()
+        # regroup one drained flush by election (first-seen tenant
+        # order, FIFO within a tenant): each group is a single-lane
+        # device batch, so every tenant's code chain and record stream
+        # stay contiguous.  Single-tenant services see exactly one
+        # group — the legacy path.
+        groups: dict[str, list[PendingRequest]] = {}
+        for p in batch:
+            groups.setdefault(p.tenant, []).append(p)
+        err: Optional[BaseException] = None
+        for election, group in groups.items():
+            try:
+                self._process_group(election, group, depth, clock)
+            except BaseException as e:  # noqa: BLE001 — per-lane blast
+                # radius: one lane's failure must not strand the other
+                # lanes' futures in the same flush
+                if err is None:
+                    err = e
+        if err is not None:
+            raise err
+
+    def _process_group(self, election: str,
+                       group: list[PendingRequest], depth: int,
+                       clock) -> None:
+        lane = self.lanes.get(election, self._default_lane)
+        bucket = self.batcher.bucket_for(len(group))
+        t0 = clock()
         try:
             # the device leg of one flush: compile time inside this span
             # is attributed to it by the obs.jaxmon listener; when
             # tracing is off this is the shared no-op (zero allocation
             # beyond the guarded attrs dict)
-            attrs = ({"bucket": bucket, "n_real": len(batch)}
+            attrs = ({"bucket": bucket, "n_real": len(group),
+                      "election": election or lane.election or "default"}
                      if trace.enabled() else None)
             with trace.span("worker.batch", attrs):
                 real_encrypted, invalid, spoiled = \
-                    self._encrypt(batch, bucket)
+                    self._encrypt(group, bucket, lane)
         except BaseException as e:
-            for p in batch:
+            for p in group:
                 if not p.future.set_running_or_notify_cancel():
                     continue
                 p.future.set_exception(e)
-            self.metrics.inc("requests_failed", len(batch))
+            self.metrics.inc("requests_failed", len(group),
+                             election=election)
             raise
+        # per-tenant device-time attribution: the raw material the
+        # noisy-neighbor detector joins against per-tenant SLO burn
+        self.metrics.inc_device_ms((clock() - t0) * 1e3, election)
         if real_encrypted:
-            self._code_seed = real_encrypted[-1].code
-            if self.stream is not None:
+            lane.code_seed = real_encrypted[-1].code
+            # the default lane reads ``self.stream`` at flush time, not
+            # the handle captured at construction — callers (the sim
+            # harness) rebind ``worker.stream`` after the fact; tenant
+            # lanes own their stream for their whole lifetime
+            stream = (self.stream if lane is self._default_lane
+                      else lane.stream)
+            if stream is not None:
                 for b in real_encrypted:
-                    self.stream.write(b)
+                    stream.write(b)
                 # batch-boundary durability: a crash after this point
                 # loses nothing from this batch; a crash before it is
                 # covered by the admission journal's replay
-                self.stream.flush()
+                stream.flush()
         by_id = {b.ballot_id: b for b in real_encrypted}
         inv_by_id = {b.ballot_id: reason for b, reason in invalid}
         now = clock()
-        for p in batch:
-            self.metrics.latency_ms.observe((now - p.t_enqueue) * 1e3)
+        latency = self.metrics.histogram_for("request_latency_ms",
+                                             election)
+        for p in group:
+            latency.observe((now - p.t_enqueue) * 1e3)
             if not p.future.set_running_or_notify_cancel():
                 continue
             # pop, not get: of two same-id requests in one batch, only
@@ -207,16 +276,20 @@ class EncryptionWorker(threading.Thread):
             else:
                 reason = inv_by_id.get(p.ballot.ballot_id,
                                        "not returned by encryptor")
-                self.metrics.inc("ballots_invalid")
+                self.metrics.inc("ballots_invalid", election=election)
                 p.future.set_exception(InvalidBallotError(reason))
-        self.metrics.inc("ballots_encrypted", len(real_encrypted))
+        self.metrics.inc("ballots_encrypted", len(real_encrypted),
+                         election=election)
         self.metrics.inc("ballots_spoiled",
                          sum(1 for b in real_encrypted
-                             if b.ballot_id in spoiled))
-        self.metrics.observe_flush(len(batch), bucket, depth)
+                             if b.ballot_id in spoiled),
+                         election=election)
+        self.metrics.observe_flush(len(group), bucket, depth,
+                                   election=election)
 
     @property
     def code_seed(self) -> Optional[bytes]:
-        """The last real ballot's confirmation code (the chain head the
-        next batch continues from); None before any real ballot."""
-        return self._code_seed
+        """The last real ballot's confirmation code on the DEFAULT lane
+        (the chain head the next batch continues from); None before any
+        real ballot.  Tenant lanes hold their own chain heads."""
+        return self._default_lane.code_seed

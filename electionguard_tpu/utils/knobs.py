@@ -283,6 +283,25 @@ KNOBS: tuple[Knob, ...] = (
          "runnable tasks) or 'pct' (priority-based probabilistic "
          "concurrency testing, own RNG stream) (sim/explore; "
          "sim/scheduler)."),
+    Knob("EGTPU_TENANT_MAX", "int", "64",
+         "Max distinct election ids one process will label metric "
+         "series with — the label-cardinality bound; past it "
+         "tenant_scope raises the named tenant.cardinality error "
+         "(obs/tenant)."),
+    Knob("EGTPU_TENANT_NOISY_SHARE", "float", "0.5",
+         "Noisy-neighbor detection threshold: a tenant whose share of "
+         "fleet device time over the trailing window exceeds this while "
+         "ANOTHER tenant burns its SLO is named the offender "
+         "(obs/slo)."),
+    Knob("EGTPU_TENANT_NOISY_WINDOW", "float", "30.0",
+         "Trailing window, seconds, over which per-tenant device-time "
+         "share is computed for noisy-neighbor attribution (obs/slo)."),
+    Knob("EGTPU_TENANT_QUOTA", "int", "0",
+         "Per-tenant admission quota: max in-flight encrypt requests "
+         "one election may hold in a serving process or router shard "
+         "before its OWN requests are rejected RESOURCE_EXHAUSTED "
+         "(other tenants keep flowing); 0 = no per-tenant cap "
+         "(serve/tenants; fabric/router)."),
     Knob("EGTPU_TABLE_CACHE", "path", None,
          "On-disk cache dir for host-precomputed setup tables (NttCtx "
          "constants, PowRadix tables), keyed by group fingerprint; "

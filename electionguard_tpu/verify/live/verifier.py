@@ -40,7 +40,7 @@ import os
 from typing import Optional
 
 from electionguard_tpu.crypto import validate
-from electionguard_tpu.obs import REGISTRY, span
+from electionguard_tpu.obs import REGISTRY, election_labels, span
 from electionguard_tpu.publish import framing, pb, serialize
 from electionguard_tpu.publish.election_record import ElectionRecord
 from electionguard_tpu.publish.publisher import _BALLOTS, Consumer
@@ -134,7 +134,7 @@ class LiveVerifier:
         self.verified_frames = 0
 
         self._chunks_counter = REGISTRY.counter(
-            "live_chunks_verified_total")
+            "live_chunks_verified_total", election_labels())
         self._lag_gauge = REGISTRY.gauge("live_audit_lag_frames")
         self._restore_checkpoint()
 

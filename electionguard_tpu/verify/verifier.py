@@ -43,7 +43,8 @@ from electionguard_tpu.core.hash import _encode, hash_digest, hash_elems
 from electionguard_tpu.crypto.cp_batch import batch_cp_verify
 from electionguard_tpu.decrypt.decryption import lagrange_coefficient
 from electionguard_tpu.keyceremony.trustee import commitment_product
-from electionguard_tpu.obs import REGISTRY, span
+from electionguard_tpu.obs import REGISTRY, election_labels, span
+from electionguard_tpu.obs import tenant as _tenant
 from electionguard_tpu.publish.election_record import ElectionRecord
 from electionguard_tpu.utils import devicetime, knobs
 from electionguard_tpu.verify import rlc
@@ -325,11 +326,14 @@ class Verifier:
         verify/rlc.py module docstring)."""
         S = len(alphas)
         eo = self.ops
-        with span("verify.batch", {"family": "V4", "n": S}):
-            REGISTRY.counter("verify_rlc_batches_total").inc()
+        with span("verify.batch", {"family": "V4", "n": S,
+                           "election": _tenant.current_election()}):
+            REGISTRY.counter("verify_rlc_batches_total",
+                 election_labels()).inc()
             if any(len(h) != 4 or not all(0 < x < g.p for x in h)
                    for h in sel_hints):
-                REGISTRY.counter("verify_rlc_fallbacks_total").inc()
+                REGISTRY.counter("verify_rlc_fallbacks_total",
+                 election_labels()).inc()
                 return False
             if sha256_jax.supports(g):
                 h_l = [eo.to_limbs_p([h[j] for h in sel_hints])
@@ -353,7 +357,8 @@ class Verifier:
                   and rlc.rlc_check_v4(eo, K, alphas, betas,
                                        c0s, v0s, c1s, v1s, sel_hints))
         if not ok:
-            REGISTRY.counter("verify_rlc_fallbacks_total").inc()
+            REGISTRY.counter("verify_rlc_fallbacks_total",
+                 election_labels()).inc()
         return ok
 
     def _v5_rlc_batch(self, g, qbar, K, CA_l, CB_l, consts, ccs, cvs,
@@ -363,11 +368,14 @@ class Verifier:
         the hash binding and the equation RLC run here."""
         C = len(ccs)
         eo = self.ops
-        with span("verify.batch", {"family": "V5", "n": C}):
-            REGISTRY.counter("verify_rlc_batches_total").inc()
+        with span("verify.batch", {"family": "V5", "n": C,
+                           "election": _tenant.current_election()}):
+            REGISTRY.counter("verify_rlc_batches_total",
+                 election_labels()).inc()
             if any(len(h) != 2 or not all(0 < x < g.p for x in h)
                    for h in con_hints):
-                REGISTRY.counter("verify_rlc_fallbacks_total").inc()
+                REGISTRY.counter("verify_rlc_fallbacks_total",
+                 election_labels()).inc()
                 return False
             CA_np, CB_np = np.asarray(CA_l), np.asarray(CB_l)
             CA_i = eo.from_limbs(CA_np)
@@ -399,7 +407,8 @@ class Verifier:
                   and rlc.rlc_check_v5(eo, K, CA_i, CB_i,
                                        consts, ccs, cvs, con_hints))
         if not ok:
-            REGISTRY.counter("verify_rlc_fallbacks_total").inc()
+            REGISTRY.counter("verify_rlc_fallbacks_total",
+                 election_labels()).inc()
         return ok
 
     # ==================================================================

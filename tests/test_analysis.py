@@ -24,7 +24,8 @@ from electionguard_tpu.utils import knobs as knobs_mod
 
 ALL_PASSES = {"env-knob-registry", "ingestion-validation", "jit-hygiene",
               "lock-discipline", "no-bare-print", "rpc-contract",
-              "secret-taint", "trace-coverage", "wall-clock-discipline"}
+              "secret-taint", "tenant-label", "trace-coverage",
+              "wall-clock-discipline"}
 
 
 # ---------------------------------------------------------------------------
@@ -565,3 +566,37 @@ def test_ingestion_validation_gated_and_exempt_paths_clean(tmp_path):
     })
     report = _run(project, ["ingestion-validation"])
     assert _lines(report, "ingestion-validation") == []
+
+
+def test_tenant_label_fires_on_unlabeled_series(tmp_path):
+    project = _project(tmp_path, {
+        "serve/mod.py": """\
+            from electionguard_tpu.obs.registry import election_labels
+
+
+            def good_direct(registry):
+                registry.counter("ballots_encrypted", election_labels())
+                registry.histogram("request_latency_ms", (1.0,),
+                                   election_labels({"election": "x"}))
+
+
+            def good_indirect(registry):
+                labels = election_labels()
+                registry.counter("requests_admitted", labels)
+
+
+            def bad(registry):
+                registry.counter("ballots_encrypted")
+                registry.histogram("request_latency_ms", (1.0,))
+                registry.gauge("queue_depth")
+        """,
+        "core/other.py": """\
+            def outside_tenant_dirs(registry):
+                registry.counter("ballots_encrypted")
+        """,
+    })
+    report = _run(project, ["tenant-label"])
+    # only the unlabeled counter/histogram in a tenant dir fire; gauges
+    # (process-scoped) and non-tenant dirs are exempt
+    assert [(f.path, f.line) for f in report.findings] \
+        == [("pkg/serve/mod.py", 16), ("pkg/serve/mod.py", 17)]

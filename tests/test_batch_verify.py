@@ -33,6 +33,7 @@ from electionguard_tpu.crypto.schnorr import (batch_schnorr_verify,
                                               make_schnorr_proof)
 from electionguard_tpu.mixnet import verify_mix
 from electionguard_tpu.obs import REGISTRY
+from electionguard_tpu.obs.registry import election_labels
 from electionguard_tpu.publish.election_record import ElectionRecord
 from electionguard_tpu.verify import rlc
 from electionguard_tpu.verify.verifier import VerificationResult, Verifier
@@ -100,10 +101,12 @@ def test_batch_rejects_v4_ciphertext_swap(batch_election):
             dataclasses.replace(s0, ciphertext=s1.ciphertext),
             dataclasses.replace(s1, ciphertext=s0.ciphertext))
             + tuple(c.selections[2:])),) + tuple(b.contests[1:]))
-    falls0 = REGISTRY.counter("verify_rlc_fallbacks_total").value
+    falls = REGISTRY.counter("verify_rlc_fallbacks_total",
+                             election_labels())
+    falls0 = falls.value
     res = _verify_on(record, g)
     assert not res.checks["V4.selection_proofs"]
-    assert REGISTRY.counter("verify_rlc_fallbacks_total").value > falls0
+    assert falls.value > falls0
 
 
 def test_batch_rejects_v4_response_tamper(batch_election):

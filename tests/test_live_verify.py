@@ -239,7 +239,13 @@ def test_bulletin_board_roundtrip(election, tmp_path):
         assert s.status == "DONE" and s.verdict_ok
         assert s.frames_verified == 20 and s.audit_lag_frames == 0
         m = client.metrics()
-        assert m.counters["live_chunks_verified_total"] >= 5
+        # the live verifier's series is election-labeled now (the
+        # ambient "default" tenant)
+        from electionguard_tpu.obs.registry import (election_labels,
+                                                    flat_name)
+        chunks_key = flat_name("live_chunks_verified_total",
+                               election_labels())
+        assert m.counters[chunks_key] >= 5
         client.close()
     finally:
         board.shutdown()

@@ -1,0 +1,550 @@
+"""Multi-tenant serving tests: N overlapping elections over ONE worker
+pool, per-tenant observability, and the starved-tenant chaos drill.
+
+The heavyweight invariants pinned here:
+
+* **shared programs** — 4 elections with 4 distinct key ceremonies run
+  through one EncryptionService; ``device_compiles`` stays flat across
+  the interleaved load (the election key is a traced argument, so tenant
+  lanes reuse the prewarmed bucket programs), every tenant's published
+  record is chain-contiguous and verifier-green, and its codes are
+  bit-for-bit the offline BatchEncryptor's for the same ballots in the
+  same order;
+* **per-tenant quotas** — a flooding election exhausts ITS OWN
+  admission quota (RESOURCE_EXHAUSTED naming it) while the victim's
+  requests keep flowing and its p99 stays inside the fleet SLO;
+* **noisy-neighbor attribution** — the SLO engine joins per-election
+  device time against per-election SLO burn and names the OFFENDER,
+  not the victim that paged;
+* **hostile tenant ids** — ids containing ``,``, ``=``, ``"`` and
+  newlines round-trip losslessly through the metrics registry, the
+  Prometheus exposition, ``slo.parse_labels``, span attrs and the
+  trace analyzer's tenant buckets; the per-process cardinality guard
+  bounds the distinct-id set with a named error;
+* **group-keyed table cache** — PowRadix entries are fingerprinted by
+  (group digest, base digest) with NO election component, so a second
+  worker joining the fleet reuses every tenant's tables (cross-tenant
+  hit rate > 0).
+"""
+
+import json
+import os
+import threading
+
+import grpc
+import pytest
+
+from electionguard_tpu.ballot.plaintext import (PlaintextBallot,
+                                                PlaintextBallotContest,
+                                                PlaintextBallotSelection)
+from electionguard_tpu.obs import analyze as analyze_mod
+from electionguard_tpu.obs import registry as registry_mod
+from electionguard_tpu.obs import slo as slo_mod
+from electionguard_tpu.obs import tenant
+from electionguard_tpu.publish.election_record import ElectionConfig
+from electionguard_tpu.serve import tenants as tenants_mod
+from electionguard_tpu.serve.tenants import (ElectionContext, TenantQuota,
+                                             TenantQuotaError,
+                                             TenantRegistry)
+from tests.test_keyceremony import tiny_manifest
+
+TS = 1754_000_000
+
+#: election ids chosen to break naive label quoting, CSV-ish parsers,
+#: and line-oriented formats — every surface must carry them losslessly
+HOSTILE_IDS = ('acme,fall-2026', 'general="2026"', 'line1\nline2',
+               'eq=and\\slash')
+
+
+def _ceremony(tgroup, tag: str, n: int = 1, quorum: int = 1):
+    """One election's ElectionInitialized: its own trustees, its own
+    joint key — tenants share manifest SHAPES, never key material."""
+    from electionguard_tpu.keyceremony.exchange import key_ceremony_exchange
+    from electionguard_tpu.keyceremony.trustee import KeyCeremonyTrustee
+    trustees = [KeyCeremonyTrustee(tgroup, f"{tag}-guardian-{i}", i + 1,
+                                   quorum) for i in range(n)]
+    return key_ceremony_exchange(trustees, tgroup).make_election_initialized(
+        ElectionConfig(tiny_manifest(), n, quorum),
+        {"created_by": f"tenant-test-{tag}"})
+
+
+def _ballot(election: str, i: int) -> PlaintextBallot:
+    return PlaintextBallot(
+        f"{election}-ballot-{i:04d}", "style-0",
+        (PlaintextBallotContest(
+            "contest-0", (PlaintextBallotSelection("sel-0", i % 2),
+                          PlaintextBallotSelection("sel-1", 0))),))
+
+
+class _RegistryStub:
+    """egtop-facing stand-in for the obs collector: answers getMetrics
+    with ``proto_of`` over a live registry snapshot — the same
+    flat-named wire shape the collector's fleet merge serves."""
+
+    def __init__(self, registry):
+        self._registry = registry
+
+    def call(self, method, request, timeout=None):
+        assert method == "getMetrics"
+        return registry_mod.proto_of(self._registry.snapshot())
+
+
+# =====================================================================
+# the N-tenant drill: 4 overlapping elections, one worker pool
+# =====================================================================
+
+
+def test_n_tenant_drill_one_pool_four_elections(tgroup, tmp_path,
+                                                monkeypatch):
+    """Acceptance drill: 4 virtual elections with distinct key
+    ceremonies through ONE service; compiles flat, per-tenant records
+    chain-contiguous + verifier-green, table cache cross-tenant."""
+    from electionguard_tpu.core import group_jax, table_cache
+    from electionguard_tpu.encrypt.encryptor import BatchEncryptor
+    from electionguard_tpu.publish.election_record import ElectionRecord
+    from electionguard_tpu.publish.publisher import Consumer
+    from electionguard_tpu.serve.service import (EncryptionClient,
+                                                 EncryptionService)
+    from electionguard_tpu.verify.verifier import Verifier
+    import tools.egtop as egtop
+
+    monkeypatch.setenv("EGTPU_TABLE_CACHE", str(tmp_path / "tables"))
+    elections = [f"city-{c}" for c in "abcd"]
+    inits = {el: _ceremony(tgroup, el) for el in elections}
+    seeds = {el: tgroup.int_to_q(101 + i)
+             for i, el in enumerate(elections)}
+    registry = TenantRegistry()
+    for el in elections:
+        registry.add(ElectionContext(
+            el, inits[el], group=tgroup,
+            out_dir=tenants_mod.tenant_record_dir(str(tmp_path), el),
+            seed=seeds[el]))
+    house = _ceremony(tgroup, "house")
+    svc = EncryptionService(house, tgroup, max_batch=8, max_wait_ms=15,
+                            seed=tgroup.int_to_q(42), timestamp=TS,
+                            tenants=registry)
+    submitted = {el: [_ballot(el, i) for i in range(6)]
+                 for el in elections}
+    try:
+        # warmup: one ballot per tenant builds each election's host-side
+        # key table; the device bucket programs were all compiled by the
+        # prewarm (the key is a traced argument, shared across lanes)
+        warm = EncryptionClient(f"localhost:{svc.port}", tgroup)
+        results = {el: {} for el in elections}
+        for el in elections:
+            with tenant.tenant_scope(el):
+                enc = warm.encrypt(submitted[el][0])
+            results[el][enc.ballot_id] = enc
+        warm.close()
+        compiles0 = svc.metrics.counters()["device_compiles"]
+
+        errs: list = []
+
+        def run_tenant(el):
+            client = EncryptionClient(f"localhost:{svc.port}", tgroup)
+            try:
+                with tenant.tenant_scope(el):
+                    for b in submitted[el][1:]:
+                        results[el][b.ballot_id] = client.encrypt(b)
+            except BaseException as e:  # noqa: BLE001
+                errs.append((el, e))
+            finally:
+                client.close()
+
+        threads = [threading.Thread(target=run_tenant, args=(el,))
+                   for el in elections]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs, errs
+
+        # the tentpole: N tenants' overlapping traffic compiled NOTHING
+        # after warmup — device_compiles is flat across the drill
+        compiles1 = svc.metrics.counters()["device_compiles"]
+        assert compiles1 == compiles0, (
+            f"cross-tenant traffic recompiled: {compiles0} -> {compiles1}")
+
+        # per-tenant series split the shared fleet's metrics
+        snap = svc.metrics.registry.snapshot()
+        for el in elections:
+            flat = registry_mod.flat_name("ballots_encrypted",
+                                          {"election": el})
+            assert snap["counters"][flat] == 6
+            dflat = registry_mod.flat_name("tenant_device_ms_total",
+                                           {"election": el})
+            assert snap["counters"][dflat] > 0
+
+        # egtop's tenant pane renders one row per election with an SLO
+        # verdict off the same flat-named wire shape the collector serves
+        pane = egtop.render_tenants(_RegistryStub(svc.metrics.registry))
+        for el in elections:
+            assert el in pane
+        assert "OK" in pane and "ELECTION" in pane
+    finally:
+        svc.drain()
+
+    # every tenant's record: complete, tenant-pure, verifier-green, and
+    # bit-for-bit the offline encryptor's chain for the same ballots
+    for el in elections:
+        cons = Consumer(registry.get(el).record_dir, tgroup)
+        record = ElectionRecord(cons.read_election_initialized())
+        record.encrypted_ballots = list(cons.iterate_encrypted_ballots())
+        ids = [b.ballot_id for b in record.encrypted_ballots]
+        assert len(ids) == 6
+        assert all(i.startswith(el) for i in ids), ids  # no bleed
+        res = Verifier(record, tgroup).verify()
+        assert res.ok, f"{el}: {res.summary()}"
+        by_id = {b.ballot_id: b for b in submitted[el]}
+        offline, invalid = BatchEncryptor(inits[el], tgroup).encrypt_ballots(
+            [by_id[i] for i in ids], seed=seeds[el], timestamp=TS)
+        assert not invalid
+        assert offline == record.encrypted_ballots
+        for off in offline:
+            assert results[el][off.ballot_id].code == off.code
+
+    # table-cache: entries are (group, base)-keyed — election-blind —
+    # so a SECOND worker joining the fleet rebuilds nothing: it reads
+    # every tenant's key table from the cache the first worker wrote
+    table_cache.reset_stats()
+    joiner = group_jax.JaxGroupOps(tgroup)
+    for el in elections:
+        joiner.fixed_table(inits[el].joint_public_key.value)
+    stats = table_cache.stats()
+    assert stats["hits"] >= len(elections), stats
+
+
+def test_table_cache_fingerprint_is_election_blind(tgroup, tmp_path,
+                                                   monkeypatch):
+    """The cross-tenant reuse above is structural: the cache fingerprint
+    has a group component and a base component, and NO tenant one."""
+    from electionguard_tpu.core import group_jax
+    monkeypatch.setenv("EGTPU_TABLE_CACHE", str(tmp_path / "tables"))
+    ops = group_jax.JaxGroupOps(tgroup)
+    with tenant.tenant_scope("fp-tenant-a"):
+        fp_a = ops._table_fingerprint("powradix", tgroup.g)
+    with tenant.tenant_scope("fp-tenant-b"):
+        fp_b = ops._table_fingerprint("powradix", tgroup.g)
+    assert fp_a == fp_b
+    assert fp_a != ops._table_fingerprint("powradix", tgroup.g + 1)
+
+
+# =====================================================================
+# starved-tenant chaos drill: quotas + noisy-neighbor attribution
+# =====================================================================
+
+
+def test_starved_tenant_quota_names_flooder(tgroup, monkeypatch):
+    """Chaos drill: a flooding election is shed by ITS quota (the
+    rejection names it), the victim's requests flow and its p99 stays
+    inside the fleet SLO, and the SLO engine's noisy-neighbor join over
+    the drill's REAL metrics names the flooder as offender."""
+    from electionguard_tpu.serve.service import (EncryptionClient,
+                                                 EncryptionService)
+    import tools.egtop as egtop
+
+    monkeypatch.setenv("EGTPU_TENANT_QUOTA", "2")
+    hold = threading.Event()
+    registry = TenantRegistry()
+    registry.add(ElectionContext("victim", _ceremony(tgroup, "victim"),
+                                 group=tgroup, seed=tgroup.int_to_q(7)))
+    registry.add(ElectionContext("flooder", _ceremony(tgroup, "flooder"),
+                                 group=tgroup, seed=tgroup.int_to_q(8)))
+    svc = EncryptionService(_ceremony(tgroup, "chaos-house"), tgroup,
+                            max_batch=8, max_wait_ms=15,
+                            seed=tgroup.int_to_q(42), timestamp=TS,
+                            hold=hold, tenants=registry)
+    try:
+        url = f"localhost:{svc.port}"
+        rejected: list = []
+        flood_ok: list = []
+        vic_ok: list = []
+        vic_errs: list = []
+
+        def flood(i):
+            client = EncryptionClient(url, tgroup)
+            try:
+                with tenant.tenant_scope("flooder"):
+                    flood_ok.append(client.encrypt(_ballot("flooder", i)))
+            except grpc.RpcError as e:
+                rejected.append(e)
+            finally:
+                client.close()
+
+        def victim(i):
+            client = EncryptionClient(url, tgroup)
+            try:
+                with tenant.tenant_scope("victim"):
+                    vic_ok.append(client.encrypt(_ballot("victim", i)))
+            except BaseException as e:  # noqa: BLE001
+                vic_errs.append(e)
+            finally:
+                client.close()
+
+        # phase 1 — worker held: the flooder bursts 6 concurrent
+        # requests against a quota of 2; exactly 4 shed immediately
+        flood_threads = [threading.Thread(target=flood, args=(i,))
+                         for i in range(6)]
+        for t in flood_threads:
+            t.start()
+        from electionguard_tpu.utils import clock
+        deadline = clock.monotonic() + 30
+        while len(rejected) < 4 and clock.monotonic() < deadline:
+            clock.sleep(0.005)
+        assert len(rejected) == 4, \
+            f"expected 4 quota rejections, saw {len(rejected)}"
+        for e in rejected:
+            assert e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+            assert "[tenant.quota]" in e.details()
+            assert "'flooder'" in e.details()   # the rejection NAMES it
+
+        # ... while the victim's admissions keep flowing under the
+        # flooder's pressure (quota accounting is per-election)
+        vic_threads = [threading.Thread(target=victim, args=(i,))
+                       for i in range(2)]
+        for t in vic_threads:
+            t.start()
+        deadline = clock.monotonic() + 30
+        while svc._tenant_quota.inflight("victim") < 2 \
+                and clock.monotonic() < deadline:
+            clock.sleep(0.005)
+        assert svc._tenant_quota.inflight("victim") == 2
+
+        # release the device owner: every admitted request completes
+        hold.set()
+        for t in flood_threads + vic_threads:
+            t.join(timeout=120)
+        assert not vic_errs, vic_errs
+        assert len(vic_ok) == 2 and len(flood_ok) == 2
+
+        # phase 2 — the flooder hogs the device INSIDE its quota: a
+        # sustained sequential pump dominates per-tenant device time
+        client = EncryptionClient(url, tgroup)
+        with tenant.tenant_scope("flooder"):
+            for i in range(6, 30):
+                client.encrypt(_ballot("flooder", i))
+        client.close()
+
+        # victim p99 stays inside the FLEET objective under quota
+        vic_p99 = svc.metrics.histogram_for(
+            "request_latency_ms", "victim").quantile(0.99)
+        fleet_obj = slo_mod.DEFAULT_SLO["serving_p99_ms"]["objective"]
+        assert 0 < vic_p99 <= fleet_obj
+
+        # the SLO engine over the drill's REAL metrics: the victim's
+        # tenant-scoped objective burns, the noisy-neighbor join names
+        # the flooder (the tenant to throttle), never the victim
+        engine = slo_mod.SLOEngine(config=slo_mod.load_config(json.dumps({
+            "serving_p99_ms": {"per_election": {"victim": 0.5}},
+            "noisy_neighbor": {"share": 0.5, "window_s": 60.0},
+        })))
+        zero = {"counters": {
+            registry_mod.flat_name("tenant_device_ms_total",
+                                   {"election": el}): 0.0
+            for el in ("victim", "flooder")}, "histograms": {},
+            "gauges": {}}
+        assert engine.evaluate(0.0, zero, []) == []
+        fired = engine.evaluate(5.0, svc.metrics.registry.snapshot(), [])
+        noisy = [a for a in fired if a.kind == "noisy_neighbor"]
+        assert len(noisy) == 1
+        assert noisy[0].subject == "flooder"
+        assert noisy[0].attrs["offender"] == "flooder"
+        assert noisy[0].attrs["victim"] == "victim"
+        assert noisy[0].attrs["share"] >= 0.5
+        burns = [a for a in fired if a.kind == "serving_p99"]
+        assert burns and all(a.attrs["election"] == "victim"
+                             for a in burns)
+
+        # egtop -once tenant pane: per-election rows with SLO verdicts,
+        # the flooder's shed requests visible in its own row
+        pane = egtop.render_tenants(_RegistryStub(svc.metrics.registry))
+        assert "victim" in pane and "flooder" in pane
+        vic_row = next(ln for ln in pane.splitlines()
+                       if ln.strip().startswith("victim"))
+        assert "OK" in vic_row
+        flood_row = next(ln for ln in pane.splitlines()
+                         if ln.strip().startswith("flooder"))
+        assert " 4" in flood_row   # the 4 quota rejections
+    finally:
+        hold.set()
+        svc.drain()
+
+
+def test_noisy_neighbor_detector_edge_triggers(tgroup):
+    """Detector unit: synthetic two-tick history — offender named once
+    (edge-triggered), low-share tenants never blamed."""
+    engine = slo_mod.SLOEngine(config=slo_mod.load_config(json.dumps({
+        "serving_p99_ms": {"objective": 100.0},
+        "noisy_neighbor": {"share": 0.5, "window_s": 30.0},
+    })))
+
+    def dev(el):
+        return registry_mod.flat_name("tenant_device_ms_total",
+                                      {"election": el})
+
+    lat = registry_mod.flat_name("request_latency_ms",
+                                 {"election": "quiet"})
+    m0 = {"counters": {dev("flood"): 0.0, dev("quiet"): 0.0},
+          "histograms": {}, "gauges": {}}
+    assert engine.evaluate(0.0, m0, []) == []
+    m1 = {"counters": {dev("flood"): 9000.0, dev("quiet"): 500.0},
+          "histograms": {lat: {"bounds": [1000.0], "counts": [0, 5],
+                               "sum": 9000.0, "count": 5}},
+          "gauges": {}}
+    fired = engine.evaluate(10.0, m1, [])
+    noisy = [a for a in fired if a.kind == "noisy_neighbor"]
+    assert [a.subject for a in noisy] == ["flood"]
+    assert noisy[0].attrs["victims"] == ["quiet"]
+    assert noisy[0].attrs["share"] > 0.9
+    assert "'flood'" in noisy[0].detail
+    # edge trigger: the same condition one tick later re-fires nothing
+    again = engine.evaluate(11.0, m1, [])
+    assert [a for a in again if a.kind == "noisy_neighbor"] == []
+
+
+# =====================================================================
+# tenant plumbing units: quota, scope, cardinality, record dirs
+# =====================================================================
+
+
+def test_tenant_quota_accounting_and_idempotent_release():
+    q = TenantQuota(quota=2)
+    r1 = q.acquire("el-x")
+    q.acquire("el-x")
+    with pytest.raises(TenantQuotaError, match=r"\[tenant\.quota\].*el-x"):
+        q.acquire("el-x")
+    # per-election isolation: another tenant is not starved
+    assert q.acquire("el-y") is not None
+    r1()
+    r1()   # double release must not undercount
+    assert q.inflight("el-x") == 1
+    q.acquire("el-x")
+    with pytest.raises(TenantQuotaError):
+        q.acquire("el-x")
+    # quota 0 disables accounting entirely
+    assert TenantQuota(quota=0).acquire("anyone") is None
+
+
+def test_tenant_scope_sets_ambient_election():
+    assert tenant.current_election() == "default"   # the knob fallback
+    with tenant.tenant_scope("scoped-el"):
+        assert tenant.current_election() == "scoped-el"
+        assert registry_mod.election_labels() == {"election": "scoped-el"}
+        with tenant.tenant_scope("inner-el"):
+            assert tenant.current_election() == "inner-el"
+        assert tenant.current_election() == "scoped-el"
+    assert tenant.current_election() == "default"
+
+
+def test_tenant_cardinality_guard_named_error(monkeypatch):
+    monkeypatch.setenv("EGTPU_TENANT_MAX", "2")
+    tenant._reset_for_tests()
+    try:
+        with tenant.tenant_scope("card-1"):
+            pass
+        with tenant.tenant_scope("card-2"):
+            pass
+        with tenant.tenant_scope("card-1"):   # re-admission is free
+            pass
+        with pytest.raises(tenant.TenantCardinalityError,
+                           match=r"\[tenant\.cardinality\].*card-3"):
+            with tenant.tenant_scope("card-3"):
+                pass
+        assert tenant.seen_elections() == frozenset({"card-1", "card-2"})
+    finally:
+        tenant._reset_for_tests()
+
+
+def test_tenant_registry_rejects_duplicate_election(tgroup):
+    init = _ceremony(tgroup, "dup")
+    registry = TenantRegistry()
+    registry.add(ElectionContext("dup-el", init, group=tgroup,
+                                 seed=tgroup.int_to_q(3)))
+    with pytest.raises(ValueError, match=r"\[tenant\.duplicate\]"):
+        registry.add(ElectionContext("dup-el", init, group=tgroup,
+                                     seed=tgroup.int_to_q(4)))
+
+
+def test_tenant_record_dir_contains_hostile_ids(tmp_path):
+    base = str(tmp_path)
+    dirs = set()
+    for hid in HOSTILE_IDS + ("../../etc/passwd", "", "plain-election"):
+        d = tenants_mod.tenant_record_dir(base, hid)
+        # never a traversal, never a raw hostile byte in the path
+        assert os.path.dirname(d) == base
+        assert ".." not in os.path.basename(d)
+        assert "\n" not in d and '"' not in d
+        assert d == tenants_mod.tenant_record_dir(base, hid)  # stable
+        dirs.add(d)
+    assert len(dirs) == len(HOSTILE_IDS) + 3   # digest keeps ids distinct
+
+
+# =====================================================================
+# hostile tenant ids through every observability surface
+# =====================================================================
+
+
+def test_hostile_ids_roundtrip_registry_and_parse_labels():
+    reg = registry_mod.MetricsRegistry("hostile")
+    for hid in HOSTILE_IDS:
+        with tenant.tenant_scope(hid):
+            reg.counter("ballots_encrypted",
+                        registry_mod.election_labels()).inc()
+            reg.histogram("request_latency_ms",
+                          (1.0, 10.0),
+                          registry_mod.election_labels()).observe(2.0)
+    snap = reg.snapshot()
+    seen = {slo_mod.parse_labels(flat)[1]["election"]
+            for flat in snap["counters"]}
+    assert seen == set(HOSTILE_IDS)
+    seen_h = {slo_mod.parse_labels(flat)[1]["election"]
+              for flat in snap["histograms"]}
+    assert seen_h == set(HOSTILE_IDS)
+    # the Prometheus exposition stays line-oriented: embedded newlines
+    # are escaped, one series per line
+    text = reg.prometheus_text()
+    series = [ln for ln in text.splitlines()
+              if ln.startswith("egtpu_ballots_encrypted{")]
+    assert len(series) == len(HOSTILE_IDS)
+    assert any(r'line1\nline2' in ln for ln in series)
+    assert any(r'general=\"2026\"' in ln for ln in series)
+
+
+def test_hostile_ids_in_span_attrs_and_analyzer_buckets(tmp_path):
+    spans = [{"trace_id": "t1", "span_id": "root", "parent_id": "",
+              "name": "process", "ts": 0, "dur": 10_000,
+              "proc": "serve-0"}]
+    for i, hid in enumerate(HOSTILE_IDS):
+        spans.append({"trace_id": "t1", "span_id": f"b{i}",
+                      "parent_id": "root", "name": "worker.batch",
+                      "ts": 100 + i * 200, "dur": 100, "proc": "serve-0",
+                      "attrs": {"election": hid, "bucket": 1,
+                                "n_real": 1}})
+    (tmp_path / "spans-serve-0-1.jsonl").write_text(
+        "".join(json.dumps(s) + "\n" for s in spans))
+    a = analyze_mod.analyze(str(tmp_path))
+    assert set(a.tenants) == set(HOSTILE_IDS)
+    for stats in a.tenants.values():
+        assert stats["n_batches"] == 1 and stats["device_us"] == 100
+    assert abs(sum(s["share"] for s in a.tenants.values()) - 1.0) < 1e-6
+    # the analysis artifact serializes the hostile ids losslessly
+    doc = json.loads(json.dumps(a.to_json()))
+    assert {row["election"] for row in doc["tenants"]} == set(HOSTILE_IDS)
+
+
+def test_hostile_ids_respected_by_per_election_objectives():
+    """A per_election SLO override keyed by a hostile id matches the
+    series parsed back out of the flat snapshot name."""
+    hid = HOSTILE_IDS[0]
+    engine = slo_mod.SLOEngine(config=slo_mod.load_config(json.dumps({
+        "serving_p99_ms": {"objective": 10_000.0,
+                           "per_election": {hid: 0.5}},
+    })))
+    lat = registry_mod.flat_name("request_latency_ms", {"election": hid})
+    fired = engine.evaluate(
+        0.0, {"counters": {}, "gauges": {},
+              "histograms": {lat: {"bounds": [1000.0], "counts": [0, 3],
+                                   "sum": 4000.0, "count": 3}}}, [])
+    burns = [a for a in fired if a.kind == "serving_p99"]
+    assert len(burns) == 1 and burns[0].attrs["election"] == hid
+    assert burns[0].attrs["objective_ms"] == 0.5

@@ -4,6 +4,9 @@ Polls the obs collector's ``getFleetStatus`` rpc (obs/collector.py) and
 redraws a terminal status board: fleet health, one row per process
 (state, liveness, heartbeat age, queue depth, current phase, serving
 p99, spans streamed, client-side drops), and the recent SLO alerts.
+When the fleet serves multiple elections, a tenant pane follows: one
+row per election with its ballot counts, request p99 against ITS SLO
+objective (OK/BURN verdict), and its share of fleet device time.
 
 With ``-trace <dir>`` the board gains a critical-path pane: each frame
 re-analyzes the span dir (the collector's receive dir, or the run's
@@ -136,6 +139,71 @@ def render_critical_path(trace_dir: str, rows: int = 5) -> str:
     return "\n".join(lines)
 
 
+def render_tenants(stub, timeout: float = 5.0) -> str:
+    """Tenant pane: one row per election over the fleet-merged metrics
+    (``getMetrics``): ballots encrypted/admitted/rejected, request p99
+    vs that tenant's SLO objective (``per_election`` override, else the
+    fleet default) with an OK/BURN verdict, and the tenant's share of
+    total device time (the noisy-neighbor detector's raw material).
+    Degrades to a one-line notice, never breaks the board."""
+    try:
+        from electionguard_tpu.obs import slo as slo_mod
+        from electionguard_tpu.publish import pb
+        resp = stub.call("getMetrics", pb.msg("MetricsRequest")(),
+                         timeout=timeout)
+        cfg = slo_mod.load_config()["serving_p99_ms"]
+    except Exception as e:  # noqa: BLE001 — the pane must never kill the board
+        return f"tenant pane unavailable: {e}"
+    counts: dict[str, dict[str, int]] = {}
+    for flat, v in resp.counters.items():
+        name, labels = slo_mod.parse_labels(flat)
+        el = labels.get("election")
+        if el is None:
+            continue
+        if name in ("ballots_encrypted", "requests_admitted",
+                    "requests_rejected_queue_full",
+                    "tenant_device_ms_total"):
+            per = counts.setdefault(el, {})
+            per[name] = per.get(name, 0) + v
+    hists: dict[str, list] = {}
+    for h in resp.histograms:
+        name, labels = slo_mod.parse_labels(h.name)
+        el = labels.get("election")
+        if name == "request_latency_ms" and el is not None:
+            hists.setdefault(el, []).append(h)
+    elections = sorted(set(counts) | set(hists))
+    if not elections:
+        return "tenants: none (no election-labeled series yet)"
+    total_ms = sum(per.get("tenant_device_ms_total", 0)
+                   for per in counts.values())
+    lines = [f"{'':1} {'ELECTION':<22}{'ENCRYPTED':>10}{'ADMITTED':>9}"
+             f"{'REJECTED':>9}{'P99MS':>8}{'OBJ':>7} {'SLO':<5}"
+             f"{'DEV%':>5}"]
+    for el in elections:
+        per = counts.get(el, {})
+        # merged per-tenant p99 across the fleet's processes
+        merged = {"bounds": (), "counts": [], "count": 0}
+        for h in hists.get(el, ()):
+            if not merged["bounds"]:
+                merged["bounds"] = tuple(h.bounds)
+                merged["counts"] = [0] * len(h.counts)
+            for i, c in enumerate(h.counts):
+                merged["counts"][i] += c
+            merged["count"] += h.count
+        p99 = slo_mod.histogram_quantile(merged, 0.99)
+        objective = cfg.get("per_election", {}).get(el, cfg["objective"])
+        verdict = "OK" if p99 <= objective else "BURN"
+        share = (100.0 * per.get("tenant_device_ms_total", 0) / total_ms
+                 if total_ms else 0.0)
+        label = el if len(el) <= 21 else el[:18] + "..."
+        lines.append(
+            f"  {label:<22}{per.get('ballots_encrypted', 0):>10}"
+            f"{per.get('requests_admitted', 0):>9}"
+            f"{per.get('requests_rejected_queue_full', 0):>9}"
+            f"{p99:>8.0f}{objective:>7.0f} {verdict:<5}{share:>4.0f}%")
+    return "\n".join(lines)
+
+
 def render_capacity(capacity_path: str) -> str:
     """Capacity pane: headline chips-for-deadline per backend and the
     last validation verdict from the tracked CAPACITY.json
@@ -200,6 +268,7 @@ def main(argv=None) -> int:
             status = None
         else:
             frame = render(status, color=color)
+            frame += "\n" + render_tenants(stub)
         if args.trace_dir:
             frame += "\n" + render_critical_path(args.trace_dir)
         if args.capacity_path:
